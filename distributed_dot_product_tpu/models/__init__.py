@@ -1,0 +1,4 @@
+# -*- coding: utf-8 -*-
+from distributed_dot_product_tpu.models.attention import (  # noqa: F401
+    DistributedDotProductAttn, apply_seq_parallel,
+)

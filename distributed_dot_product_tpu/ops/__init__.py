@@ -1,0 +1,9 @@
+# -*- coding: utf-8 -*-
+from distributed_dot_product_tpu.ops.functions import (  # noqa: F401
+    distributed_matmul_nt, distributed_matmul_tn, distributed_matmul_all,
+)
+from distributed_dot_product_tpu.ops.ops import (  # noqa: F401
+    matmul_nt, matmul_all, matmul_tn,
+    RightTransposeMultiplication, FullMultiplication,
+    LeftTransposeMultiplication,
+)

@@ -1,0 +1,169 @@
+# -*- coding: utf-8 -*-
+"""
+Differentiable distributed matmul operators (custom-gradient layer).
+
+TPU-native rebuild of the reference L3 layer (reference
+multiplication/ops.py), which wraps each distributed matmul in a
+``torch.autograd.Function`` whose backward is expressed in terms of the
+other two kernels. Here each is a :func:`jax.custom_vjp` with the same VJP
+pairings:
+
+- ``matmul_nt``  (= ``RightTransposeMultiplication``, reference ops.py:19-37)
+  fwd ``out = A·Bᵀ``; bwd ``dA = all(dOut, B)``, ``dB = tn(dOut, A)``.
+- ``matmul_all`` (= ``FullMultiplication``, reference ops.py:40-54)
+  fwd ``out = A·B``;  bwd ``dA = nt(dOut, B)``,  ``dB = tn(A, dOut)``.
+- ``matmul_tn``  (= ``LeftTransposeMultiplication``, reference ops.py:57-71)
+  fwd ``out = Aᵀ·B``; bwd ``dA = nt(B, dOut)``,  ``dB = all(A, dOut)``.
+
+Two deliberate fixes over the reference (documented in SURVEY §2.1):
+
+1. **Forward ``offset`` propagation.** The reference saves ``offset`` in
+   ``ctx`` but silently drops it on the *forward* calls of both
+   ``RightTransposeMultiplication`` (reference ops.py:25) and
+   ``FullMultiplication`` (reference ops.py:45), which therefore always run
+   with the default 32. Here ``offset`` applies to forward and backward.
+2. **The ``LeftTransposeMultiplication`` left-gradient.** For
+   ``out = AᵀB``: ``out_{ij} = Σ_k A_{ki} B_{kj}`` so
+   ``dA = B·dOutᵀ = nt(B, dOut)``. The reference computes
+   ``nt(dOut, B)`` (reference ops.py:69) — the transpose of the correct
+   cotangent — and no reference test exercises it (SURVEY §4). We implement
+   the correct VJP and verify it against full-array autodiff in
+   ``tests/test_ops_grad.py``.
+
+The ``offset`` and ``axis_name`` arguments are non-differentiable static
+configuration (``nondiff_argnums``) — the analog of the reference's
+``return grad_left, grad_right, None`` convention (reference ops.py:37).
+"""
+
+from functools import partial
+
+import jax
+
+from distributed_dot_product_tpu.ops.functions import (
+    distributed_matmul_all, distributed_matmul_nt, distributed_matmul_tn,
+)
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+__all__ = [
+    'matmul_nt', 'matmul_all', 'matmul_tn',
+    'RightTransposeMultiplication', 'FullMultiplication',
+    'LeftTransposeMultiplication',
+]
+
+
+# --- A·Bᵀ -------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _nt(left, right, offset, axis_name, impl):
+    return distributed_matmul_nt(left, right, offset, axis_name=axis_name,
+                                 impl=impl)
+
+
+def _nt_fwd(left, right, offset, axis_name, impl):
+    return _nt(left, right, offset, axis_name, impl), (left, right)
+
+
+def _nt_bwd(offset, axis_name, impl, residuals, g):
+    left, right = residuals
+    # out = L·Rᵀ  ⇒  dL = dOut·R,  dR = dOutᵀ·L  (reference ops.py:29-37).
+    grad_left = distributed_matmul_all(g, right, offset, axis_name=axis_name,
+                                       impl=impl)
+    grad_right = distributed_matmul_tn(g, left, axis_name=axis_name)
+    return grad_left, grad_right
+
+
+_nt.defvjp(_nt_fwd, _nt_bwd)
+
+
+def matmul_nt(left, right, offset=32, axis_name=SEQ_AXIS, impl='allgather'):
+    """Differentiable ``A·Bᵀ`` on sequence shards ``(*, T/N, D)`` →
+    ``(*, T/N, T)``. Reference ``RightTransposeMultiplication.apply``
+    (reference ops.py:19-37)."""
+    return _nt(left, right, offset, axis_name, impl)
+
+
+# --- A·B --------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _full(left, right, offset, axis_name, impl):
+    return distributed_matmul_all(left, right, offset, axis_name=axis_name,
+                                  impl=impl)
+
+
+def _full_fwd(left, right, offset, axis_name, impl):
+    return _full(left, right, offset, axis_name, impl), (left, right)
+
+
+def _full_bwd(offset, axis_name, impl, residuals, g):
+    left, right = residuals
+    # out = L·R  ⇒  dL = dOut·Rᵀ,  dR = Lᵀ·dOut  (reference ops.py:49-54).
+    grad_left = distributed_matmul_nt(g, right, offset, axis_name=axis_name,
+                                      impl=impl)
+    grad_right = distributed_matmul_tn(left, g, axis_name=axis_name)
+    return grad_left, grad_right
+
+
+_full.defvjp(_full_fwd, _full_bwd)
+
+
+def matmul_all(left, right, offset=32, axis_name=SEQ_AXIS,
+               impl='allgather'):
+    """Differentiable ``A·B`` on sequence shards ``(*, T/N, T) × (*, T/N, D)``
+    → ``(*, T/N, D)``. Reference ``FullMultiplication.apply``
+    (reference ops.py:40-54)."""
+    return _full(left, right, offset, axis_name, impl)
+
+
+# --- Aᵀ·B -------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _tn(left, right, offset, axis_name, impl):
+    return distributed_matmul_tn(left, right, axis_name=axis_name)
+
+
+def _tn_fwd(left, right, offset, axis_name, impl):
+    return _tn(left, right, offset, axis_name, impl), (left, right)
+
+
+def _tn_bwd(offset, axis_name, impl, residuals, g):
+    left, right = residuals
+    # out = Lᵀ·R  ⇒  dL = R·dOutᵀ = nt(R, dOut)  — operand order fixed
+    # vs the reference's nt(dOut, R) (reference ops.py:69, see module
+    # docstring);  dR = L·dOut = all(L, dOut)  (reference ops.py:70).
+    grad_left = distributed_matmul_nt(right, g, offset, axis_name=axis_name,
+                                      impl=impl)
+    grad_right = distributed_matmul_all(left, g, offset,
+                                        axis_name=axis_name, impl=impl)
+    return grad_left, grad_right
+
+
+_tn.defvjp(_tn_fwd, _tn_bwd)
+
+
+def matmul_tn(left, right, offset=32, axis_name=SEQ_AXIS, impl='allgather'):
+    """Differentiable ``Aᵀ·B`` on sequence shards ``(*, T/N, T) × (*, T/N, D)``
+    → ``(*, T/N, D)``. Reference ``LeftTransposeMultiplication.apply``
+    (reference ops.py:57-71); ``offset`` feeds the backward kernels only
+    (the tn forward has no chunk knob, reference functions.py:103)."""
+    return _tn(left, right, offset, axis_name, impl)
+
+
+# ---------------------------------------------------------------------------
+# API-parity aliases: the reference exposes these as autograd.Function
+# classes used via ``.apply(left, right, offset)`` (reference module.py:61,
+# 69). Thin shims so reference call sites read the same.
+# ---------------------------------------------------------------------------
+
+class RightTransposeMultiplication:
+    """``.apply(left, right, offset)`` → ``matmul_nt`` (reference ops.py:19)."""
+    apply = staticmethod(matmul_nt)
+
+
+class FullMultiplication:
+    """``.apply(left, right, offset)`` → ``matmul_all`` (reference ops.py:40)."""
+    apply = staticmethod(matmul_all)
+
+
+class LeftTransposeMultiplication:
+    """``.apply(left, right, offset)`` → ``matmul_tn`` (reference ops.py:57)."""
+    apply = staticmethod(matmul_tn)

@@ -1,0 +1,4 @@
+# -*- coding: utf-8 -*-
+from distributed_dot_product_tpu.parallel.mesh import (  # noqa: F401
+    seq_mesh, data_seq_mesh, seq_spec, replicated_spec, shard_seq,
+)

@@ -1,0 +1,84 @@
+# -*- coding: utf-8 -*-
+"""
+Device-mesh construction and sharding-spec helpers.
+
+The reference has no equivalent component: its "mesh" is the MPI world
+created by ``horovodrun -np N`` (reference README.md:77) and its "sharding"
+is the convention that every process holds a ``(*, T/N, d)`` slice
+(reference functions.py:49-54). Here both become explicit, first-class
+objects: a :class:`jax.sharding.Mesh` with a ``'seq'`` axis, and
+:class:`~jax.sharding.PartitionSpec`s placing the time axis on it. Sharded
+code is topology-agnostic — the same program runs on 8 forced-CPU devices,
+one v5e chip, a v5e-8 ICI mesh, or a multi-host pod slice (DCN), with XLA
+choosing the collective implementation.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+
+def seq_mesh(num_devices=None, axis_name=SEQ_AXIS, devices=None):
+    """1-D mesh over the sequence axis — the topology of the whole library
+    (replaces the N-process Horovod world, reference comm.py:6-18).
+
+    ``num_devices=None`` uses every visible device.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f'requested {num_devices} devices, only '
+                    f'{len(devices)} visible')
+            devices = devices[:num_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def data_seq_mesh(data, seq, axis_names=('data', SEQ_AXIS), devices=None):
+    """2-D (data, seq) mesh for batch (DP) × sequence (SP) parallelism.
+
+    The reference leaves data parallelism to the user (weights replicated,
+    grad-sum identity tested at reference test_gradient.py:116-121); here it
+    is one more mesh axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if data * seq > len(devices):
+        raise ValueError(f'mesh {data}x{seq} needs {data * seq} devices, '
+                         f'only {len(devices)} visible')
+    arr = np.array(devices[:data * seq]).reshape(data, seq)
+    return Mesh(arr, axis_names)
+
+
+def seq_spec(ndim, seq_axis=-2, mesh_axis=SEQ_AXIS, batch_axis=None,
+             batch_mesh_axis='data'):
+    """PartitionSpec for a rank-``ndim`` array sharded along its time axis
+    (the ``(*, T/N, d)`` convention, reference functions.py:49-54), and
+    optionally along a batch axis for DP."""
+    seq_axis = seq_axis % ndim
+    names = [None] * ndim
+    names[seq_axis] = mesh_axis
+    if batch_axis is not None:
+        names[batch_axis % ndim] = batch_mesh_axis
+    return P(*names)
+
+
+def replicated_spec():
+    """Spec for replicated values (model weights — the reference replicates
+    them per rank via ``hvd.broadcast_parameters``, reference
+    test_gradient.py:48; with a NamedSharding this is just ``P()``)."""
+    return P()
+
+
+def shard_seq(x, mesh, seq_axis=-2, mesh_axis=SEQ_AXIS):
+    """Place a global array on ``mesh`` sharded along its time axis.
+
+    Replaces the reference's manual per-rank slicing (``tensor[rank]``,
+    reference test_multiplication.py:127-128) — here the global array stays
+    a single ``jax.Array`` whose shards live on the devices.
+    """
+    spec = seq_spec(x.ndim, seq_axis=seq_axis, mesh_axis=mesh_axis)
+    return jax.device_put(x, NamedSharding(mesh, spec))

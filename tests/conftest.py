@@ -1,0 +1,31 @@
+# -*- coding: utf-8 -*-
+"""
+Test-session setup: force an 8-device CPU JAX platform.
+
+Replaces the reference's distributed test harness — ``horovodrun -np N
+--mpi pytest ...`` launching N OS processes that must collect tests in
+identical order or deadlock (reference README.md:171-179) — with a single
+pytest process over 8 virtual CPU devices (SURVEY §4 "TPU-native test
+translation"): no collective-ordering flakiness, plain ``pytest`` runs it.
+
+JAX backend selection is lazy, so even if a sitecustomize already imported
+jax pinned to a TPU plugin, flipping the config here (before any
+``jax.devices()`` call) is sufficient — equivalent to
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+import jax
+import pytest
+
+_N_DEVICES = 8
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', _N_DEVICES)
+
+
+@pytest.fixture(scope='session')
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= _N_DEVICES, (
+        f'expected >= {_N_DEVICES} CPU devices, got {devs}')
+    return devs

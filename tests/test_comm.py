@@ -1,0 +1,63 @@
+# -*- coding: utf-8 -*-
+"""Process/topology layer tests (reference has no comm tests; its comm.py is
+exercised implicitly by every distributed test, SURVEY §2.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_dot_product_tpu import (
+    SEQ_AXIS, get_rank, get_world_size, is_main_process, seq_mesh,
+    synchronize,
+)
+from distributed_dot_product_tpu.parallel.mesh import (
+    data_seq_mesh, seq_spec, shard_seq,
+)
+
+
+def test_host_level_rank_world():
+    # Single-process: process-level rank/world (reference comm.py:13-19
+    # semantics, minus the MPI world).
+    assert get_rank() == 0
+    assert is_main_process()
+    assert get_world_size() == len(jax.devices())
+    synchronize()  # no-op single-host, must not raise
+
+
+def test_mesh_and_axis_introspection():
+    mesh = seq_mesh(4)
+    assert mesh.shape == {SEQ_AXIS: 4}
+
+    def body(x):
+        # world size is static inside shard_map; rank is per-shard.
+        assert get_world_size(SEQ_AXIS) == 4
+        return x + get_rank(SEQ_AXIS)
+
+    out = jax.shard_map(body, mesh=mesh, in_specs=P(SEQ_AXIS),
+                        out_specs=P(SEQ_AXIS))(jnp.zeros(4))
+    np.testing.assert_array_equal(np.asarray(out), [0, 1, 2, 3])
+
+
+def test_seq_spec_and_shard_seq():
+    mesh = seq_mesh(4)
+    assert seq_spec(3) == P(None, SEQ_AXIS, None)
+    assert seq_spec(4) == P(None, None, SEQ_AXIS, None)
+    assert seq_spec(4, batch_axis=0) == P('data', None, SEQ_AXIS, None)
+    x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+    sx = shard_seq(x, mesh)
+    assert sx.sharding.spec == P(None, SEQ_AXIS, None)
+    np.testing.assert_array_equal(np.asarray(sx), np.asarray(x))
+
+
+def test_data_seq_mesh():
+    mesh = data_seq_mesh(2, 4)
+    assert mesh.shape == {'data': 2, SEQ_AXIS: 4}
+    with pytest.raises(ValueError):
+        data_seq_mesh(4, 4)  # 16 > 8 devices
+
+
+def test_seq_mesh_too_many_devices():
+    with pytest.raises(ValueError):
+        seq_mesh(1024)
