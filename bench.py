@@ -1,0 +1,90 @@
+# -*- coding: utf-8 -*-
+"""
+Driver benchmark: ONE JSON line with the headline metric.
+
+Metric (BASELINE.json): ``A·Bᵀ`` (nt) GFLOP/s per chip on the reference
+workload T=75000, d=768. Baseline of record: the reference's best nt
+configuration — offset=25000 on 3× Quadro RTX 6000 over Horovod/NCCL —
+at **2287 GFLOP/s per chip** (BASELINE.md, nt_benchmark_25000.json; its
+per-chip useful FLOPs are ``2·(T/3)·T·768 / t``). ``vs_baseline`` is
+ours / theirs.
+
+Runs the sequence-sharded kernel over every visible device (on the driver's
+hardware: one TPU v5e chip, a W=1 mesh — per-chip FLOPs are directly
+comparable). bf16 inputs: the MXU-native dtype is the point of a TPU
+rebuild; the fp32 number is also measured and included in the JSON line.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.ops.functions import \
+    distributed_matmul_nt_global
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh, shard_seq
+from distributed_dot_product_tpu.utils.tracing import time_fn
+
+BASELINE_GFLOPS_PER_CHIP = 2287.0  # BASELINE.md nt offset=25000
+DIM = 768
+
+
+def measure(t, dtype, mesh, offset, iters=3, inner=5, precision=None):
+    world = mesh.devices.size
+    k1, k2 = jax.random.split(jax.random.key(111))
+    left = shard_seq(jax.random.normal(k1, (t, DIM), dtype), mesh)
+    right = shard_seq(jax.random.normal(k2, (t, DIM), dtype), mesh)
+    # Reduce to a scalar inside the jit: keeps queued async dispatches from
+    # each holding an 11 GiB output buffer, and stops XLA dead-code-
+    # eliminating the matmul. The extra full-output HBM pass is charged to
+    # us (conservative).
+    fn = jax.jit(lambda l, r: jnp.sum(distributed_matmul_nt_global(
+        l, r, offset=offset, mesh=mesh, precision=precision),
+        dtype=jnp.float32))
+    best, _ = time_fn(fn, left, right, iters=iters, inner=inner)
+    return 2.0 * t * t * DIM / world / best / 1e9, best
+
+
+def main():
+    mesh = seq_mesh()
+    world = mesh.devices.size
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ('cpu',)
+
+    # Reference workload T=75000 when an accelerator is present; the nt
+    # output alone is T^2 elements, so fp32 uses T/2 (22.5 GiB would not
+    # fit a 16 GiB chip — the same reason the reference needed 3 GPUs).
+    t_bf16 = 75000 if on_accel else 2048
+    t_f32 = 75000 // 2 if on_accel else 2048
+    t_bf16 -= t_bf16 % world
+    t_f32 -= t_f32 % world
+    offset = 25000  # the baseline's best config
+
+    gflops_bf16, time_bf16 = measure(t_bf16, jnp.bfloat16, mesh, offset)
+    # True fp32 accumulate-and-multiply (the reference baseline is fp32
+    # cuBLAS; TPU 'float32' matmuls otherwise default to bf16 compute).
+    gflops_f32, time_f32 = measure(t_f32, jnp.float32, mesh, offset,
+                                   precision='highest')
+
+    print(json.dumps({
+        'metric': 'nt_gflops_per_chip',
+        'value': round(gflops_bf16, 1),
+        'unit': 'GFLOP/s/chip',
+        'vs_baseline': round(gflops_bf16 / BASELINE_GFLOPS_PER_CHIP, 2),
+        'detail': {
+            'T_bf16': t_bf16, 'time_bf16_s': round(time_bf16, 4),
+            'f32_gflops_per_chip': round(gflops_f32, 1),
+            'T_f32': t_f32, 'time_f32_s': round(time_f32, 4),
+            'f32_vs_baseline': round(
+                gflops_f32 / BASELINE_GFLOPS_PER_CHIP, 2),
+            'world': world, 'platform': platform,
+            'baseline': 'reference nt offset=25000, 3x RTX6000/NCCL, '
+                        '2287 GFLOP/s/chip (BASELINE.md)',
+        },
+    }))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

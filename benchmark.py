@@ -1,0 +1,161 @@
+# -*- coding: utf-8 -*-
+"""
+Benchmark CLI for the distributed sequence matmuls.
+
+Port of the reference benchmark harness (reference benchmark.py:1-258) with
+the same flags and JSON-append result files, minus its two measurement
+defects (SURVEY §6 / BASELINE.md): timings here block on device completion
+(the reference never called ``torch.cuda.synchronize()``, reference
+benchmark.py:56-67) and ``--offset`` is honored by every mode (the
+reference's nt path hardcoded offset=1000, reference benchmark.py:95).
+
+Workload (reference benchmark.py:72-102): sequence length ``T =
+75000/scale``, feature dim ``d = 768``; the "local" baseline is the
+full-size matmul on ONE device; the "distributed" measurement runs the
+sequence-sharded kernel over all visible devices. Extra TPU-native knobs:
+``--dtype bf16`` (MXU-native) and ``--impl ring`` (ppermute ring instead of
+chunked all-gather).
+
+    python benchmark.py --mode nt --offset 1000 --scale 2 --file out.json
+"""
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.ops.functions import (
+    distributed_matmul_all_global, distributed_matmul_nt_global,
+    distributed_matmul_tn_global,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh, shard_seq
+from distributed_dot_product_tpu.utils.tracing import (
+    device_peak_bytes, time_fn,
+)
+
+FULL_T = 75000   # reference benchmark.py:73
+DIM = 768        # reference benchmark.py:74
+
+
+def parse_args():
+    # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--mode', choices=['nt', 'all', 'tn'], default='nt')
+    parser.add_argument('--offset', type=int, default=32)
+    parser.add_argument('--scale', type=int, default=1,
+                        help='T = 75000 // scale')
+    parser.add_argument('--file', default='benchmark_results.json')
+    parser.add_argument('--dtype', choices=['f32', 'bf16'], default='f32')
+    parser.add_argument('--impl', choices=['allgather', 'ring'],
+                        default='allgather')
+    parser.add_argument('--devices', type=int, default=None,
+                        help='mesh width (default: all visible)')
+    parser.add_argument('--iters', type=int, default=5)
+    parser.add_argument('--skip-local', action='store_true',
+                        help='skip the single-device full-size baseline')
+    parser.add_argument('--profile-dir', default=None,
+                        help='write a jax.profiler trace here')
+    return parser.parse_args()
+
+
+def make_inputs(mode, t, dtype, key=111):  # seed: reference benchmark.py:47
+    k1, k2 = jax.random.split(jax.random.key(key))
+    if mode == 'nt':
+        left = jax.random.normal(k1, (t, DIM), dtype)
+        right = jax.random.normal(k2, (t, DIM), dtype)
+    else:  # 'all' and 'tn': left is a score-shaped (T, T) operand
+        left = jax.random.normal(k1, (t, t), dtype)
+        right = jax.random.normal(k2, (t, DIM), dtype)
+    return left, right
+
+
+LOCAL = {
+    'nt': lambda l, r: jnp.matmul(l, r.T),
+    'all': lambda l, r: jnp.matmul(l, r),
+    'tn': lambda l, r: jnp.matmul(l.T, r),
+}
+
+
+def _summed(fn):
+    """Reduce the op's output to a scalar inside the jit: timing queues many
+    async dispatches, and full outputs (up to GiBs for nt) would all stay
+    live at once. The extra reduction pass is charged to both the local and
+    distributed measurements equally (and biases *against* us vs the
+    reference, whose timings exclude any output read)."""
+    return jax.jit(lambda l, r: jnp.sum(fn(l, r), dtype=jnp.float32))
+
+
+def run(args):
+    mesh = seq_mesh(args.devices)
+    world = mesh.devices.size
+    t = FULL_T // args.scale
+    t -= t % world  # shard evenly (reference assumes divisibility)
+    dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
+    flops = 2.0 * t * t * DIM  # same count for all three ops (BASELINE.md)
+
+    left, right = make_inputs(args.mode, t, dtype)
+    record = {
+        'mode': args.mode, 'offset': args.offset, 'scale': args.scale,
+        'T': t, 'dim': DIM, 'world': world, 'dtype': args.dtype,
+        'impl': args.impl,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+    }
+
+    if not args.skip_local:
+        # Single-device full-size baseline (reference benchmark.py:72-86).
+        local = _summed(LOCAL[args.mode])
+        best, mean = time_fn(local, left, right, iters=args.iters)
+        record.update(local_time=best, local_time_mean=mean,
+                      local_gflops=flops / best / 1e9)
+        print(f"local 1-device {args.mode}: {best:.4f}s "
+              f"({record['local_gflops']:.0f} GFLOP/s)")
+
+    # Distributed: global arrays sharded over the mesh, shard_map kernel.
+    gleft, gright = shard_seq(left, mesh), shard_seq(right, mesh)
+    kw = {'mesh': mesh}
+    if args.mode == 'nt':
+        fn = lambda l, r: distributed_matmul_nt_global(  # noqa: E731
+            l, r, offset=args.offset, impl=args.impl, **kw)
+    elif args.mode == 'all':
+        fn = lambda l, r: distributed_matmul_all_global(  # noqa: E731
+            l, r, offset=args.offset, impl=args.impl, **kw)
+    else:
+        fn = lambda l, r: distributed_matmul_tn_global(  # noqa: E731
+            l, r, **kw)
+    fn = _summed(fn)
+
+    if args.profile_dir:
+        jax.block_until_ready(fn(gleft, gright))  # compile outside trace
+        with jax.profiler.trace(args.profile_dir):
+            jax.block_until_ready(fn(gleft, gright))
+
+    best, mean = time_fn(fn, gleft, gright, iters=args.iters)
+    peak = device_peak_bytes()
+    record.update(
+        dist_time=best, dist_time_mean=mean,
+        dist_gflops_per_chip=flops / world / best / 1e9,
+        dist_peak_bytes_per_chip=peak,
+    )
+    print(f"dist {world}-device {args.mode} offset={args.offset} "
+          f"impl={args.impl}: {best:.4f}s "
+          f"({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip, "
+          f"peak {peak / 2**30:.2f} GiB)" if peak else
+      f"dist {world}-device {args.mode}: {best:.4f}s "
+          f"({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip)")
+
+    # Append-to-JSON-file convention (reference benchmark.py:42-44,241-253).
+    results = []
+    if os.path.exists(args.file):
+        with open(args.file) as f:
+            results = json.load(f)
+    results.append(record)
+    with open(args.file, 'w') as f:
+        json.dump(results, f, indent=2)
+    return record
+
+
+if __name__ == '__main__':
+    run(parse_args())

@@ -22,9 +22,9 @@ Behavioral parity with the reference forward (reference module.py:41-76):
   ``1/√(key_dim/num_heads)`` (reference module.py:35,65);
 - boolean mask → ``-inf`` fill, then softmax over the **full global-T last
   axis** (reference module.py:66-67). Score rows ``(T/N, T)`` are fully
-  materialized — O(T²/N) per shard, the reference's memory behavior; the
-  O(T/N·block) online-softmax path lives in
-  :mod:`distributed_dot_product_tpu.models.ring_attention`;
+  materialized — O(T²/N) per shard, the reference's memory behavior (an
+  online-softmax ring-attention path with O(T/N·block) score memory is the
+  framework's long-context upgrade, shipped separately);
 - context = ``matmul_all(attn, values, offset)`` (reference module.py:68-69),
   head merge, output projection (reference module.py:72-75);
 - ``distributed=False`` computes the identical math with local matmuls — the
@@ -82,6 +82,12 @@ class DistributedDotProductAttn(nn.Module):
                 f'{self.num_heads} (reference module.py:29)')
         value_dim = self.value_dim if self.value_dim is not None \
             else self.key_dim
+        if value_dim % self.num_heads:
+            # The reference only checks key_dim and fails later with an
+            # opaque view() error; validate up front.
+            raise ValueError(
+                f'value_dim {value_dim} must be divisible by num_heads '
+                f'{self.num_heads}')
         self.head_dim = self.key_dim // self.num_heads
         self._value_dim = value_dim
         dense = lambda feat, name: nn.Dense(  # noqa: E731

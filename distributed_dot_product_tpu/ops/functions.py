@@ -118,7 +118,6 @@ def distributed_matmul_nt(left, right, offset=32, axis_name=SEQ_AXIS,
     W = _axis_size(axis_name)
     Tn = right.shape[-2]
     offset = Tn if offset is None else min(int(offset), Tn)
-    out_rows = left.shape[-2]
 
     if offset >= Tn:
         # Single step: tiled all-gather puts rows in global order already.

@@ -28,12 +28,12 @@ def seq_mesh(num_devices=None, axis_name=SEQ_AXIS, devices=None):
     """
     if devices is None:
         devices = jax.devices()
-        if num_devices is not None:
-            if num_devices > len(devices):
-                raise ValueError(
-                    f'requested {num_devices} devices, only '
-                    f'{len(devices)} visible')
-            devices = devices[:num_devices]
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f'requested {num_devices} devices, only '
+                f'{len(devices)} available')
+        devices = devices[:num_devices]
     return Mesh(np.array(devices), (axis_name,))
 
 

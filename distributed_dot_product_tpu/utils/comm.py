@@ -67,18 +67,22 @@ def get_world_size(axis_name=None):
     """Total parallel width (replaces ``hvd.size()``, reference comm.py:13-15).
 
     Inside a ``shard_map`` body pass ``axis_name`` to get the (static) size
-    of that mesh axis; outside, returns the global device count.
+    of that mesh axis. Outside, returns the host **process** count, coherent
+    with :func:`get_rank` — the reference's process==GPU identity does not
+    hold in JAX, where one process drives many devices; the device-level
+    world is a mesh property (``mesh.shape[axis]`` or ``jax.device_count()``).
     """
     if axis_name is not None:
         return lax.psum(1, axis_name)
-    return jax.device_count()
+    return jax.process_count()
 
 
 def get_rank(axis_name=None):
     """This shard's index (replaces ``hvd.rank()``, reference comm.py:17-19).
 
     Inside a ``shard_map`` body pass ``axis_name`` for the per-shard mesh
-    position (traced value); outside, returns the host process index.
+    position (traced value); outside, returns the host **process** index
+    (coherent with :func:`get_world_size`'s process count).
     """
     if axis_name is not None:
         return lax.axis_index(axis_name)

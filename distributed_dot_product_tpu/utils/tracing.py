@@ -11,16 +11,16 @@ Differences, deliberate:
 
 - **Honest timing.** The reference never called ``torch.cuda.synchronize()``
   before stopping the clock (noted in SURVEY §5 / BASELINE.md), so its GPU
-  numbers are enqueue-biased. We call ``jax.block_until_ready`` on the
-  result before reading the clock.
+  numbers are enqueue-biased. We fence with :func:`hard_sync` (a host
+  readback — ``jax.block_until_ready`` alone is not a reliable fence on
+  tunneled PJRT backends) before reading the clock.
 - **Memory** comes from ``device.memory_stats()`` (TPU/GPU); on backends
   without stats (CPU) it is reported as ``None``.
-- Tracing a *jitted* function measures whole-call latency, including compile
-  on first hit; we report ``compiled=False`` on a call where tracing
-  happened so the first (compile) sample can be discarded.
-- For deep kernel profiles use ``jax.profiler.trace`` (see
-  ``benchmark.py --profile-dir``); this decorator is the lightweight,
-  print-based path matching the reference's ergonomics.
+- ``measure`` on a function *called inside jit/shard_map* times the trace,
+  not the execution (the result is a tracer, which cannot be synced) — the
+  printed line is tagged ``traced`` in that case. For execution numbers use
+  :func:`time_fn` on the jitted callable, or ``jax.profiler.trace`` (see
+  ``benchmark.py --profile-dir``).
 """
 
 import functools
@@ -67,13 +67,17 @@ def measure(fn):
             return fn(*args, **kwargs)
         start = time.perf_counter()
         result = fn(*args, **kwargs)
-        result = jax.block_until_ready(result)
+        traced = ''
+        try:
+            hard_sync(result)
+        except Exception:  # tracer under jit/shard_map: trace time only
+            traced = ' (traced)'
         elapsed = time.perf_counter() - start
         shapes = [_shape_of(a) for a in args if _shape_of(a) is not None]
         peak = device_peak_bytes()
         peak_s = f'{peak / 2 ** 30:.3f} GiB' if peak is not None else 'n/a'
-        print(f'[{DEBUG_ENV_VAR}] {fn.__name__}: {elapsed * 1000:.3f} ms '
-              f'shapes={shapes} peak_mem={peak_s}')
+        print(f'[{DEBUG_ENV_VAR}] {fn.__name__}: {elapsed * 1000:.3f} ms'
+              f'{traced} shapes={shapes} peak_mem={peak_s}')
         return result
 
     return wrapper
@@ -96,16 +100,64 @@ class timed:
         return False
 
 
-def time_fn(fn, *args, iters=10, warmup=2, **kwargs):
-    """Run ``fn`` ``warmup`` + ``iters`` times, blocking on results, and
-    return (best_seconds, mean_seconds). The benchmark harness's honest
-    replacement for the reference's ``measure()`` (reference
-    benchmark.py:56-67)."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args, **kwargs))
+@jax.jit
+def _sync_probe(x):
+    return x.ravel()[0]
+
+
+def hard_sync(out):
+    """Synchronize with the device by reading one element back to the host.
+
+    ``jax.block_until_ready`` alone is not a reliable fence on remote /
+    tunneled PJRT backends (observed: it returns in ~0.1 ms while the
+    computation is still in flight); a host readback is. The probe is a
+    cached tiny jit so steady-state cost is one small RPC.
+    """
+    leaves = jax.tree.leaves(out)
+    if not leaves:
+        return  # nothing to sync on (fn returned None / empty pytree)
+    import numpy as np
+    np.asarray(_sync_probe(leaves[0]))
+
+
+def time_fn(fn, *args, iters=5, warmup=2, inner=None, max_inner=512,
+            **kwargs):
+    """Honest wall-clock timing of ``fn(*args)``: returns
+    ``(best_seconds, mean_seconds)`` per call.
+
+    The reference's ``measure()`` never synchronized the device (reference
+    benchmark.py:56-67), so its GPU numbers are enqueue-biased. Here each
+    sample queues ``inner`` async dispatches (the device executes them
+    serially), hard-syncs once via a host readback, and subtracts the
+    separately-measured sync overhead. ``inner=None`` auto-scales so the
+    measured window dominates that overhead (~70 ms on a tunneled TPU) —
+    without this, sub-millisecond ops disappear into sync noise.
+    """
+    out = fn(*args, **kwargs)
+    hard_sync(out)
+    for _ in range(max(warmup - 1, 0)):
+        out = fn(*args, **kwargs)
+    hard_sync(out)
+    # Steady-state sync overhead on an already-materialized result.
+    overhead = min(_timed_sync(out) for _ in range(3))
+    if inner is None:
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        hard_sync(out)
+        est = max(time.perf_counter() - t0 - overhead, 1e-5)
+        inner = max(1, min(max_inner, int(8 * overhead / est)))
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args, **kwargs))
-        times.append(time.perf_counter() - t0)
+        for _ in range(inner):
+            out = fn(*args, **kwargs)
+        hard_sync(out)
+        dt = time.perf_counter() - t0 - overhead
+        times.append(max(dt, 1e-9) / inner)
     return min(times), sum(times) / len(times)
+
+
+def _timed_sync(out):
+    t0 = time.perf_counter()
+    hard_sync(out)
+    return time.perf_counter() - t0

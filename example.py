@@ -1,0 +1,71 @@
+# -*- coding: utf-8 -*-
+"""
+End-to-end example: sequence-parallel multi-head attention, forward +
+backward + optimizer step on a device mesh.
+
+TPU-native rebuild of the reference example (reference example.py:1-33),
+which needed ``horovodrun -np N --mpi python example.py`` to spawn N
+processes, each pinning one GPU and feeding its own ``(1, T/N, 768)`` shard.
+Here it is ONE program: a 1-D ``'seq'`` mesh over every visible device, the
+global ``(1, T, 768)`` batch sharded across it, and a single jitted SPMD
+train step. Run it anywhere:
+
+    python example.py                      # real devices (e.g. 1 TPU chip)
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python example.py                  # simulate an 8-device mesh
+
+Matches the reference's workload: T=4096 global, model dim 768, 2 heads,
+offset=64, zero boolean mask, MSE loss against a random target, seed 111
+(reference example.py:12,20,25-29).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_dot_product_tpu import DistributedDotProductAttn, seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+
+
+def main():
+    mesh = seq_mesh()
+    n = mesh.devices.size
+    print(f'mesh: {n} x {jax.devices()[0].platform} '
+          f'(axis {tuple(mesh.axis_names)})')
+
+    dim, heads, t_global, offset = 768, 2, 4096, 64
+    model = DistributedDotProductAttn(key_dim=dim, num_heads=heads,
+                                      offset=offset)
+
+    key = jax.random.key(111)  # reference example.py:12
+    k_in, k_tgt, k_init = jax.random.split(key, 3)
+    x = jax.random.normal(k_in, (1, t_global, dim), jnp.float32)
+    target = jax.random.normal(k_tgt, (1, t_global, dim), jnp.float32)
+    mask = jnp.zeros((1, t_global, t_global), dtype=bool)  # example.py:29
+
+    params = model.init(k_init, x, x, x, mask)
+    optimizer = optax.adam(1e-4)
+    opt_state = optimizer.init(params)
+
+    step = make_train_step(model, optimizer, mesh)
+    batch = (x, x, x, mask, target)
+
+    print('compiling + first step...')
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    print(f'step 0: loss={float(loss):.6f} '
+          f'({time.perf_counter() - t0:.1f}s incl. compile)')
+
+    for i in range(1, 4):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        print(f'step {i}: loss={float(loss):.6f} '
+              f'({(time.perf_counter() - t0) * 1000:.1f} ms)')
+
+
+if __name__ == '__main__':
+    main()

@@ -19,10 +19,10 @@ from distributed_dot_product_tpu.parallel.mesh import (
 
 def test_host_level_rank_world():
     # Single-process: process-level rank/world (reference comm.py:13-19
-    # semantics, minus the MPI world).
+    # semantics; rank and world must describe the same unit — processes).
     assert get_rank() == 0
     assert is_main_process()
-    assert get_world_size() == len(jax.devices())
+    assert get_world_size() == jax.process_count() == 1
     synchronize()  # no-op single-host, must not raise
 
 

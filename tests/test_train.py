@@ -1,0 +1,75 @@
+# -*- coding: utf-8 -*-
+"""
+Training-step + driver-entry tests.
+
+The reference has no optimizer/training-step component (its example stops at
+``loss.backward()``, reference example.py:31-33); these cover the
+framework's sharded train step (DP×SP) and the driver entry points.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_dot_product_tpu import DistributedDotProductAttn
+from distributed_dot_product_tpu.parallel.mesh import data_seq_mesh, seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+
+
+def _setup(mesh_kind):
+    if mesh_kind == 'seq':
+        mesh, data_axis = seq_mesh(8), None
+    else:
+        mesh, data_axis = data_seq_mesh(2, 4), 'data'
+    dim, heads, t, b = 32, 4, 16, 4
+    model = DistributedDotProductAttn(key_dim=dim, num_heads=heads, offset=2)
+    x = jax.random.normal(jax.random.key(0), (b, t, dim), jnp.float32)
+    target = jax.random.normal(jax.random.key(1), (b, t, dim), jnp.float32)
+    mask = jnp.zeros((b, t, t), dtype=bool)
+    params = model.init(jax.random.key(2), x, x, x, mask)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    step = make_train_step(model, optimizer, mesh, data_axis=data_axis,
+                           donate=False)
+    return step, params, opt_state, (x, x, x, mask, target)
+
+
+@pytest.mark.parametrize('mesh_kind', ['seq', 'data_seq'])
+def test_loss_decreases(mesh_kind):
+    step, params, opt_state, batch = _setup(mesh_kind)
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_sp_and_dpsp_agree():
+    """The same data through a pure-SP mesh and a DP×SP mesh must produce
+    the same loss trajectory (the sharding must not change the math)."""
+    step_a, params, opt_a, batch = _setup('seq')
+    step_b, _, opt_b, _ = _setup('data_seq')
+    _, _, loss_a = step_a(params, opt_a, batch)
+    _, _, loss_b = step_b(params, opt_b, batch)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+
+
+def test_graft_entry_single_chip():
+    sys.path.insert(0, '/root/repo')
+    import __graft_entry__
+    fn, args = __graft_entry__.entry()
+    out = jax.block_until_ready(fn(*args))
+    assert out.shape == (1, 1024, 512)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_graft_dryrun_multichip():
+    sys.path.insert(0, '/root/repo')
+    import __graft_entry__
+    __graft_entry__.dryrun_multichip(8)   # asserts internally
+    __graft_entry__.dryrun_multichip(5)   # odd -> pure SP path
