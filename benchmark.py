@@ -14,7 +14,8 @@ Workload (reference benchmark.py:72-102): sequence length ``T =
 full-size matmul on ONE device; the "distributed" measurement runs the
 sequence-sharded kernel over all visible devices. Extra TPU-native knobs:
 ``--dtype bf16`` (MXU-native) and ``--impl ring`` (ppermute ring instead of
-chunked all-gather).
+chunked all-gather). ``--offset``/``--impl`` apply to nt and all; tn has
+neither knob (reference functions.py:103) and records them as null.
 
     python benchmark.py --mode nt --offset 1000 --scale 2 --file out.json
 """
@@ -95,11 +96,33 @@ def run(args):
     dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
     flops = 2.0 * t * t * DIM  # same count for all three ops (BASELINE.md)
 
+    # Largest single-buffer estimate: the (T, T) score-shaped operand/output
+    # (nt's output; all/tn's input). Refuse configs that cannot fit one
+    # device rather than dying in an opaque device OOM mid-run — e.g. the
+    # T=75000 fp32 default is 22.5 GiB against a 16 GiB v5e chip (use
+    # --scale 2 or --dtype bf16 there; the reference needed 3 GPUs for the
+    # same reason, reference benchmark.py:6-7).
+    stats = {}
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    limit = stats.get('bytes_limit')
+    score_bytes = t * t * jnp.dtype(dtype).itemsize
+    if limit and score_bytes > 0.9 * limit:
+        raise SystemExit(
+            f'workload needs a {score_bytes / 2**30:.1f} GiB (T,T) buffer '
+            f'per device but the device limit is {limit / 2**30:.1f} GiB; '
+            f'raise --scale or use --dtype bf16')
+
     left, right = make_inputs(args.mode, t, dtype)
     record = {
-        'mode': args.mode, 'offset': args.offset, 'scale': args.scale,
+        'mode': args.mode, 'scale': args.scale,
+        # tn has no chunk/impl knobs (reference functions.py:103); record
+        # null rather than attributing knobs that never executed.
+        'offset': args.offset if args.mode != 'tn' else None,
+        'impl': args.impl if args.mode != 'tn' else None,
         'T': t, 'dim': DIM, 'world': world, 'dtype': args.dtype,
-        'impl': args.impl,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
     }

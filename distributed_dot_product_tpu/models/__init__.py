@@ -2,3 +2,6 @@
 from distributed_dot_product_tpu.models.attention import (  # noqa: F401
     DistributedDotProductAttn, apply_seq_parallel,
 )
+from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
+    local_attention_reference, ring_attention,
+)

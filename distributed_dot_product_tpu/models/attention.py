@@ -22,9 +22,10 @@ Behavioral parity with the reference forward (reference module.py:41-76):
   ``1/√(key_dim/num_heads)`` (reference module.py:35,65);
 - boolean mask → ``-inf`` fill, then softmax over the **full global-T last
   axis** (reference module.py:66-67). Score rows ``(T/N, T)`` are fully
-  materialized — O(T²/N) per shard, the reference's memory behavior (an
-  online-softmax ring-attention path with O(T/N·block) score memory is the
-  framework's long-context upgrade, shipped separately);
+  materialized — O(T²/N) per shard, the reference's memory behavior; pass
+  ``softmax_impl='online'`` to route through
+  :mod:`distributed_dot_product_tpu.models.ring_attention` instead
+  (O((T/N)²) score memory, no full-row materialization);
 - context = ``matmul_all(attn, values, offset)`` (reference module.py:68-69),
   head merge, output projection (reference module.py:72-75);
 - ``distributed=False`` computes the identical math with local matmuls — the
@@ -44,6 +45,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from distributed_dot_product_tpu.models.ring_attention import (
+    local_attention_reference, ring_attention,
+)
 from distributed_dot_product_tpu.ops.ops import matmul_all, matmul_nt
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
@@ -72,6 +76,7 @@ class DistributedDotProductAttn(nn.Module):
     distributed: bool = True
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
+    softmax_impl: str = 'full'   # 'full' (reference parity) | 'online'
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -80,6 +85,13 @@ class DistributedDotProductAttn(nn.Module):
             raise ValueError(
                 f'key_dim {self.key_dim} must be divisible by num_heads '
                 f'{self.num_heads} (reference module.py:29)')
+        if self.softmax_impl not in ('full', 'online'):
+            raise ValueError(
+                f"softmax_impl must be 'full' or 'online', got "
+                f"{self.softmax_impl!r}")
+        if self.impl not in ('allgather', 'ring'):
+            raise ValueError(
+                f"impl must be 'allgather' or 'ring', got {self.impl!r}")
         value_dim = self.value_dim if self.value_dim is not None \
             else self.key_dim
         if value_dim % self.num_heads:
@@ -119,6 +131,28 @@ class DistributedDotProductAttn(nn.Module):
         # bound), and parameter shapes don't depend on the comm pattern —
         # use the local math path so plain ``model.init(...)`` works.
         distributed = self.distributed and not self.is_initializing()
+
+        if self.softmax_impl == 'online':
+            # Long-context path: ring attention with online softmax — the
+            # module's K-first scoring + softmax over the gathered axis
+            # (reference module.py:61,67) is standard attention with
+            # q := keys, k := queries (see ring_attention docstring), so no
+            # (T/N, T) score block is ever materialized. Fully-masked rows
+            # give 0 here (reference: NaN).
+            scale = 1.0 / math.sqrt(self.head_dim)
+            if distributed:
+                outputs = ring_attention(
+                    keys, queries, values, attn_mask,
+                    axis_name=self.axis_name, scale=scale)
+            else:
+                outputs = local_attention_reference(
+                    keys, queries, values, attn_mask, scale=scale)
+            if self.num_heads > 1:
+                outputs = jnp.swapaxes(outputs, -3, -2)
+                outputs = outputs.reshape(*outputs.shape[:-2],
+                                          self._value_dim)
+            return self.composition(outputs)
+
         if distributed:
             scores = matmul_nt(keys, queries, self.offset,
                                axis_name=self.axis_name, impl=self.impl)

@@ -1,0 +1,157 @@
+# -*- coding: utf-8 -*-
+"""
+Ring attention with online softmax — the framework's long-context path.
+
+The reference (and this framework's parity module,
+:class:`~distributed_dot_product_tpu.models.attention.DistributedDotProductAttn`)
+materializes full ``(T/N, T)`` score rows per shard before the softmax
+(reference module.py:66-67) — O(T²/N) memory, with the ``offset`` knob only
+bounding the *gathered-operand* memory (reference functions.py:64-68,
+SURVEY §5). This module removes that ceiling: K/V shards rotate around the
+mesh ring (``lax.ppermute`` neighbour hops riding the ICI torus) while a
+numerically-stable *online softmax* folds one ``(T/N, T/N)`` score block at
+a time into running ``(max, denominator, weighted-sum)`` accumulators —
+score memory O((T/N)²), independent of world size, so maximum sequence
+length scales linearly with the number of chips.
+
+No reference analog: its communication is chunked allgather, its softmax is
+full-row (SURVEY §2.2 "Ring attention: No"). The algorithm is the standard
+flash/ring-attention recurrence (online softmax per block, rescale-and-
+accumulate), laid out for the TPU: each step is one large MXU batched
+matmul pair, and XLA overlaps the ``ppermute`` transfer of the next block
+with compute on the current one.
+
+Convention: this API is standard attention — ``out[i] = Σ_t
+softmax_t(q_i·k_t·scale) v_t`` with softmax over the *gathered* axis. The
+reference module's K-first scoring (scores = K·Qᵀ, softmax over the
+gathered axis, reference module.py:61,67) is this same computation with
+``q := projected keys, k := projected queries`` — which is how
+``DistributedDotProductAttn(softmax_impl='online')`` routes into it.
+
+Masking: boolean ``mask``, True = masked out, matching the reference's
+``(B, T/N, T)`` layout (reference README.md:67): rows are this shard's
+query positions, columns global. The mask must carry the same leading dims
+as ``q`` (insert a head axis yourself, as the module does). Masked logits
+use a large-finite negative instead of ``-inf``, and fully-masked rows are
+explicitly zeroed after the recurrence — where the reference yields NaN
+(SURVEY §4 notes it never tests that case), this path yields 0 with clean
+gradients.
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+__all__ = ['ring_attention', 'local_attention_reference']
+
+
+def _mask_bias(mask, dtype):
+    # Large-finite rather than -inf: keeps the online recurrence and its
+    # VJP NaN-free even for fully-masked rows.
+    big_neg = jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+    return jnp.where(mask, big_neg, jnp.zeros((), dtype))
+
+
+def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
+                   scale=None, precision=None):
+    """Sequence-parallel attention with O((T/N)²) score memory.
+
+    ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
+    dims; ``v`` may have a different feature dim). ``mask``: optional
+    boolean ``(..., T/N, T)``, True = masked. ``causal``: apply the causal
+    triangle over *global* positions (composes with ``mask``).
+
+    Returns ``(..., T/N, d_v)``. Differentiable (the K/V ring is carried
+    through a ``lax.scan``); each step is rematerialized in the backward
+    pass (``jax.checkpoint``) so backward score memory stays O((T/N)²).
+    """
+    W = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    tn = q.shape[-2]
+    dtype = jnp.promote_types(q.dtype, jnp.float32)
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+
+    acc_shape = (*q.shape[:-1], v.shape[-1])        # (..., Tn, dv)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, dtype)    # running max (..., Tn)
+    l0 = jnp.zeros(q.shape[:-1], dtype)             # running denom
+    o0 = jnp.zeros(acc_shape, dtype)                # running numerator
+    perm = [(i, (i - 1) % W) for i in range(W)]
+
+    mask_bias = None if mask is None else _mask_bias(mask, dtype)
+    q_scaled = q.astype(dtype) * scale
+    row_pos = idx * tn + jnp.arange(tn)             # global query positions
+
+    @jax.checkpoint
+    def fold_block(acc, k_buf, v_buf, s):
+        """Online-softmax update with the K/V block of owner (rank+s)%W."""
+        m, l, o = acc
+        owner = (idx + s) % W
+        scores = jnp.einsum('...td,...od->...to', q_scaled,
+                            k_buf.astype(dtype), precision=precision)
+        if mask_bias is not None:
+            block = lax.dynamic_slice_in_dim(mask_bias, owner * tn, tn,
+                                             axis=-1)
+            scores = scores + block
+        if causal:
+            col_pos = owner * tn + jnp.arange(tn)
+            future = row_pos[:, None] < col_pos[None, :]
+            scores = jnp.where(future, jnp.finfo(dtype).min / 2, scores)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # exp(-inf - -inf) never occurs: masked logits are large-finite.
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            '...to,...od->...td', p, v_buf.astype(dtype),
+            precision=precision)
+        return m_new, l, o
+
+    def step(carry, s):
+        k_buf, v_buf, acc = carry
+        acc = fold_block(acc, k_buf, v_buf, s)
+        k_buf = lax.ppermute(k_buf, axis_name, perm)
+        v_buf = lax.ppermute(v_buf, axis_name, perm)
+        return (k_buf, v_buf, acc), None
+
+    # W-1 rotated steps, then the final resident block folded without the
+    # trailing ppermute pair (it would only feed the discarded carry —
+    # two full shard transfers per call, replayed again under checkpoint).
+    (k_last, v_last, acc), _ = lax.scan(
+        step, (k, v, (m0, l0, o0)), jnp.arange(W - 1))
+    _, l, o = fold_block(acc, k_last, v_last, W - 1)
+    # l >= 1 always (each row's max logit contributes exp(0)); the guard is
+    # belt-and-braces only.
+    out = o / jnp.where(l == 0, jnp.ones_like(l), l)[..., None]
+    if mask is not None:
+        # With large-finite (not -inf) mask bias, a fully-masked row would
+        # otherwise degenerate to a softmax over its raw q·k logits; zero
+        # it explicitly (the reference produces NaN here).
+        any_valid = jnp.any(~mask, axis=-1)
+        out = jnp.where(any_valid[..., None], out, jnp.zeros((), out.dtype))
+    return out.astype(v.dtype)
+
+
+def local_attention_reference(q, k, v, mask=None, causal=False, scale=None):
+    """Unsharded oracle: same math on full arrays (for tests/benchmarks)."""
+    dtype = jnp.promote_types(q.dtype, jnp.float32)
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    scores = jnp.einsum('...td,...od->...to', q.astype(dtype) * scale,
+                        k.astype(dtype))
+    if mask is not None:
+        scores = scores + _mask_bias(mask, dtype)
+    if causal:
+        t = q.shape[-2]
+        future = jnp.arange(t)[:, None] < jnp.arange(k.shape[-2])[None, :]
+        scores = jnp.where(future, jnp.finfo(dtype).min / 2, scores)
+    attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('...to,...od->...td', attn, v.astype(dtype))
+    if mask is not None:
+        out = jnp.where(jnp.any(~mask, axis=-1)[..., None], out,
+                        jnp.zeros((), out.dtype))
+    return out.astype(v.dtype)

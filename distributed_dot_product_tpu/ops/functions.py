@@ -161,20 +161,23 @@ def _matmul_nt_ring(left, right, axis_name, precision):
     out_shape = (*left.shape[:-1], W * Tn)
     perm = [(i, (i - 1) % W) for i in range(W)]
 
-    def body(s, carry):
-        buf, out = carry
+    def compute(s, buf, out):
         owner = (idx + s) % W
         block = jnp.einsum('...td,...od->...to', left, buf,
                            precision=precision)  # (*, T/N, T/N)
-        out = lax.dynamic_update_slice_in_dim(
+        return lax.dynamic_update_slice_in_dim(
             out, block.astype(out.dtype), owner * Tn, axis=-1)
-        buf = lax.ppermute(buf, axis_name, perm)
-        return buf, out
+
+    def body(s, carry):
+        buf, out = carry
+        out = compute(s, buf, out)
+        return lax.ppermute(buf, axis_name, perm), out
 
     dtype = jnp.result_type(left.dtype, right.dtype)
-    _, out = lax.fori_loop(
-        0, W, body, (right, jnp.zeros(out_shape, dtype)))
-    return out
+    # W-1 rotated steps; the last resident block needs no trailing permute.
+    buf, out = lax.fori_loop(
+        0, W - 1, body, (right, jnp.zeros(out_shape, dtype)))
+    return compute(W - 1, buf, out)
 
 
 @measure
@@ -260,18 +263,21 @@ def _matmul_all_ring(left, right, axis_name, precision):
     perm = [(i, (i - 1) % W) for i in range(W)]
     acc_dtype = jnp.result_type(left.dtype, right.dtype)
 
-    def body(s, carry):
-        buf, acc = carry
+    def compute(s, buf, acc):
         owner = (idx + s) % W
         block = lax.dynamic_slice_in_dim(left, owner * Tn, Tn, axis=-1)
-        acc = acc + jnp.matmul(block, buf, precision=precision)
-        buf = lax.ppermute(buf, axis_name, perm)
-        return buf, acc
+        return acc + jnp.matmul(block, buf, precision=precision)
+
+    def body(s, carry):
+        buf, acc = carry
+        acc = compute(s, buf, acc)
+        return lax.ppermute(buf, axis_name, perm), acc
 
     out_shape = (*left.shape[:-1], right.shape[-1])
-    _, acc = lax.fori_loop(
-        0, W, body, (right, jnp.zeros(out_shape, acc_dtype)))
-    return acc
+    # W-1 rotated steps; the last resident block needs no trailing permute.
+    buf, acc = lax.fori_loop(
+        0, W - 1, body, (right, jnp.zeros(out_shape, acc_dtype)))
+    return compute(W - 1, buf, acc)
 
 
 # ---------------------------------------------------------------------------

@@ -70,7 +70,9 @@ def measure(fn):
         traced = ''
         try:
             hard_sync(result)
-        except Exception:  # tracer under jit/shard_map: trace time only
+        except jax.errors.ConcretizationTypeError:
+            # Tracer under jit/shard_map: only trace time is observable.
+            # (Real runtime errors — OOM, RPC failures — propagate.)
             traced = ' (traced)'
         elapsed = time.perf_counter() - start
         shapes = [_shape_of(a) for a in args if _shape_of(a) is not None]

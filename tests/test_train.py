@@ -7,6 +7,7 @@ The reference has no optimizer/training-step component (its example stops at
 framework's sharded train step (DP×SP) and the driver entry points.
 """
 
+import os
 import sys
 
 import jax
@@ -59,8 +60,11 @@ def test_sp_and_dpsp_agree():
     np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def test_graft_entry_single_chip():
-    sys.path.insert(0, '/root/repo')
+    sys.path.insert(0, _REPO_ROOT)
     import __graft_entry__
     fn, args = __graft_entry__.entry()
     out = jax.block_until_ready(fn(*args))
@@ -69,7 +73,7 @@ def test_graft_entry_single_chip():
 
 
 def test_graft_dryrun_multichip():
-    sys.path.insert(0, '/root/repo')
+    sys.path.insert(0, _REPO_ROOT)
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)   # asserts internally
     __graft_entry__.dryrun_multichip(5)   # odd -> pure SP path
