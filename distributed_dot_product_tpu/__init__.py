@@ -28,9 +28,9 @@ Version parity note: the reference exposes ``VERSION_INFO`` in its
 ``__init__.py`` (reference __init__.py:9-10); we keep the same convention.
 """
 
-VERSION_INFO = (0, 1, 0, 'dev0')
-__version__ = '.'.join(map(str, VERSION_INFO[:3])) + (
-    '.' + VERSION_INFO[3] if len(VERSION_INFO) > 3 else '')
+from distributed_dot_product_tpu._version import (  # noqa: F401
+    VERSION_INFO, __version__,
+)
 
 from distributed_dot_product_tpu.utils.comm import (  # noqa: F401
     SEQ_AXIS, get_rank, get_world_size, is_main_process, synchronize, init,
@@ -51,4 +51,7 @@ from distributed_dot_product_tpu.models.attention import (  # noqa: F401
 )
 from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,
+)
+from distributed_dot_product_tpu.ops.pallas_attention import (  # noqa: F401
+    flash_attention,
 )

@@ -1,0 +1,8 @@
+# -*- coding: utf-8 -*-
+"""Dependency-free version info (importable by setuptools' metadata build
+without jax present). The reference keeps VERSION_INFO in its __init__
+(reference __init__.py:9-10); same convention, re-exported there."""
+
+VERSION_INFO = (0, 1, 0, 'dev0')
+__version__ = '.'.join(map(str, VERSION_INFO[:3])) + (
+    '.' + VERSION_INFO[3] if len(VERSION_INFO) > 3 else '')

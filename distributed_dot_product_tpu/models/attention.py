@@ -48,6 +48,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_dot_product_tpu.models.ring_attention import (
     local_attention_reference, ring_attention,
 )
+from distributed_dot_product_tpu.ops.pallas_attention import flash_attention
 from distributed_dot_product_tpu.ops.ops import matmul_all, matmul_nt
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
@@ -76,7 +77,7 @@ class DistributedDotProductAttn(nn.Module):
     distributed: bool = True
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
-    softmax_impl: str = 'full'   # 'full' (reference parity) | 'online'
+    softmax_impl: str = 'full'   # 'full' (parity) | 'online' | 'flash'
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -85,9 +86,9 @@ class DistributedDotProductAttn(nn.Module):
             raise ValueError(
                 f'key_dim {self.key_dim} must be divisible by num_heads '
                 f'{self.num_heads} (reference module.py:29)')
-        if self.softmax_impl not in ('full', 'online'):
+        if self.softmax_impl not in ('full', 'online', 'flash'):
             raise ValueError(
-                f"softmax_impl must be 'full' or 'online', got "
+                f"softmax_impl must be 'full', 'online' or 'flash', got "
                 f"{self.softmax_impl!r}")
         if self.impl not in ('allgather', 'ring'):
             raise ValueError(
@@ -131,6 +132,34 @@ class DistributedDotProductAttn(nn.Module):
         # bound), and parameter shapes don't depend on the comm pattern —
         # use the local math path so plain ``model.init(...)`` works.
         distributed = self.distributed and not self.is_initializing()
+
+        if self.softmax_impl == 'flash':
+            # Fused-kernel path: the module's K-first scoring + softmax over
+            # the gathered axis (reference module.py:61,67) is standard
+            # attention with q := keys, k := queries, v := values.
+            # Distributed, the *small* O(T·d) operands (queries, values) are
+            # all-gathered — one tiled collective each — and the whole
+            # score/mask/softmax/context chain runs as one Pallas kernel
+            # with no (T/N, T) score materialization
+            # (:mod:`..ops.pallas_attention`). Fully-masked rows give 0
+            # (reference: NaN).
+            scale = 1.0 / math.sqrt(self.head_dim)
+            if distributed:
+                q_full = jax.lax.all_gather(
+                    queries, self.axis_name, axis=queries.ndim - 2,
+                    tiled=True)
+                v_full = jax.lax.all_gather(
+                    values, self.axis_name, axis=values.ndim - 2,
+                    tiled=True)
+            else:
+                q_full, v_full = queries, values
+            outputs = flash_attention(keys, q_full, v_full, attn_mask,
+                                      scale=scale)
+            if self.num_heads > 1:
+                outputs = jnp.swapaxes(outputs, -3, -2)
+                outputs = outputs.reshape(*outputs.shape[:-2],
+                                          self._value_dim)
+            return self.composition(outputs)
 
         if self.softmax_impl == 'online':
             # Long-context path: ring attention with online softmax — the

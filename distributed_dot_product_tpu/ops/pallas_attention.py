@@ -1,0 +1,280 @@
+# -*- coding: utf-8 -*-
+"""
+Fused flash-attention Pallas TPU kernel (the hot-op fusion layer).
+
+The reference computes attention as four separate eager ops — scores matmul,
+mask fill, softmax, context matmul (reference module.py:60-69) — each
+reading/writing the full ``(*, T/N, T)`` score tensor through device memory.
+XLA fuses the elementwise pieces; this kernel fuses the *whole* chain in
+VMEM with an online softmax, so score blocks never touch HBM: traffic drops
+from O(T²) to O(T·d) and live score memory from O(Tq·Tk) to
+O(BLOCK_Q·BLOCK_K).
+
+No reference analog (SURVEY §7 step 6 names this as the post-parity
+performance pass). Layout, per the TPU Pallas playbook:
+
+- grid = (batch·heads, Tq/BLOCK_Q, Tk/BLOCK_K) with the K sweep innermost —
+  TPU grids run sequentially, so the running ``(max, denom, numerator)``
+  accumulators live in VMEM scratch across K steps; only one
+  ``(BLOCK, d)`` tile of K/V is resident at a time (Pallas double-buffers
+  the HBM→VMEM streams), so sequence length is bounded by HBM, not VMEM;
+- both matmuls hit the MXU with fp32 accumulation
+  (``preferred_element_type``) whatever the input dtype; block shapes are
+  lane(128)/sublane aligned;
+- causal programs whose whole K block lies in the masked future skip the
+  matmuls entirely (``pl.when``) — ~2× for causal attention;
+- masked logits use a large-finite negative (not ``-inf``) and fully-masked
+  rows return 0, matching
+  :mod:`distributed_dot_product_tpu.models.ring_attention` semantics (the
+  reference NaNs on fully-masked rows, SURVEY §4);
+- backward is the recompute strategy: residuals are ``(q, k, v, mask)``
+  only, gradients re-derive the softmax via plain jnp (XLA fuses it); this
+  keeps forward memory O(T·d) without a second hand-written kernel.
+
+On non-TPU backends (the 8-virtual-device CPU test mesh) the kernel runs in
+Pallas interpreter mode, so the identical code path is covered by the
+regular test suite.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ['flash_attention']
+
+_NEG_BIG = -0.7 * 3.4e38  # large-finite fp32; keeps exp()/VJP NaN-free
+
+
+def _block_sizes(tq, tk, dtype):
+    sub = 16 if dtype == jnp.bfloat16 else 8
+    bq = min(512, max(sub, -(-tq // sub) * sub))
+    bk = min(512, max(128 if tk >= 128 else sub,
+                      -(-tk // sub) * sub))
+    return bq, bk
+
+
+def _pad_dim(x, axis, mult):
+    size = x.shape[axis]
+    target = -(-size // mult) * mult
+    if target == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - size)
+    return jnp.pad(x, pad)
+
+
+def _make_kernel(scale, causal, bq, bk, kv_len, has_mask):
+    def kernel(*refs):
+        if has_mask:
+            q_ref, k_ref, v_ref, mask_ref, o_ref, m_s, l_s, acc_s = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
+            mask_ref = None
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+        last_k = pl.num_programs(2) - 1
+
+        @pl.when(ki == 0)
+        def _():
+            m_s[:] = jnp.full_like(m_s, _NEG_BIG)
+            l_s[:] = jnp.zeros_like(l_s)
+            acc_s[:] = jnp.zeros_like(acc_s)
+
+        # Causal block skip: the whole K block is strictly in the future of
+        # every query row of this program → contributes nothing.
+        if causal:
+            run = (qi + 1) * bq - 1 >= ki * bk
+        else:
+            run = True
+
+        @pl.when(run)
+        def _():
+            q = q_ref[0].astype(jnp.float32) * scale        # (BQ, d)
+            k = k_ref[0].astype(jnp.float32)                # (BK, d)
+            v = v_ref[0].astype(jnp.float32)                # (BK, dv)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, BK)
+            if mask_ref is not None:
+                s = jnp.where(mask_ref[0], _NEG_BIG, s)
+            if causal:
+                rows = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                cols = ki * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                s = jnp.where(rows < cols, _NEG_BIG, s)
+            if kv_len % bk:
+                cols = ki * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                s = jnp.where(cols >= kv_len, _NEG_BIG, s)
+
+            m_prev = m_s[:]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_s[:] = m_new
+            l_s[:] = l_s[:] * corr + p.sum(axis=-1, keepdims=True)
+            acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(ki == last_k)
+        def _():
+            l = l_s[:]
+            out = acc_s[:] / jnp.where(l == 0.0, 1.0, l)
+            # l == 0 happens only for causal rows before any valid column of
+            # a fully-skipped prefix (impossible: block (qi,0) always runs)
+            # or for fully-masked rows, which must return 0 (parity with
+            # ring_attention; the reference NaNs here, SURVEY §4). With
+            # large-finite mask bias, fully-masked rows have l >= eps but
+            # garbage weights — zero them via the mask below in the wrapper.
+            o_ref[0] = out.astype(o_ref.dtype)
+
+    return kernel
+
+
+def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret):
+    *batch, tq, d = q.shape
+    tk = k.shape[-2]
+    d_v = v.shape[-1]
+    nb = int(math.prod(batch)) if batch else 1
+
+    bq, bk = _block_sizes(tq, tk, q.dtype)
+    qf = _pad_dim(q.reshape(nb, tq, d), 1, bq)
+    kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
+    vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
+    grid = (nb, tq_p // bq, tk_p // bk)
+
+    specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if mask is not None:
+        # The mask may broadcast over leading dims (the module passes
+        # (B, 1, T/N, T) for H heads). Never materialize the broadcast —
+        # keep the mask at its true size and fold the broadcast into the
+        # BlockSpec index map: flat batch index b -> flat mask index,
+        # skipping axes where the mask has size 1.
+        mlead = (1,) * (len(batch) - (mask.ndim - 2)) + mask.shape[:-2]
+        if mask.shape[-2:] != (tq, tk):
+            raise ValueError(
+                f'mask trailing dims {mask.shape[-2:]} must equal '
+                f'(Tq, Tk) = {(tq, tk)}')
+        for db, dm in zip(batch, mlead):
+            if dm not in (1, db):
+                raise ValueError(
+                    f'mask leading dims {mask.shape[:-2]} do not broadcast '
+                    f'against q/k/v leading dims {tuple(batch)}')
+        nm = int(math.prod(mlead)) if mlead else 1
+        maskf = jnp.pad(mask.reshape(nm, tq, tk),
+                        ((0, 0), (0, tq_p - tq), (0, tk_p - tk)),
+                        constant_values=True)  # padded K cols masked out
+
+        # Row-major strides of the mask's leading dims inside the batch.
+        midx_strides = []
+        stride = 1
+        for db, dm in zip(reversed(batch), reversed(mlead)):
+            midx_strides.append(0 if dm == 1 else stride)
+            stride *= dm
+        midx_strides.reverse()
+
+        def mask_batch_index(b):
+            out = 0
+            rem = b
+            for db, st in zip(reversed(batch), reversed(midx_strides)):
+                out = out + (rem % db) * st
+                rem = rem // db
+            return out
+
+        specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (mask_batch_index(b), i, j)))
+        args.append(maskf)
+
+    kernel = _make_kernel(scale, causal, bq, bk, tk, mask is not None)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=specs,
+        out_specs=pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype),
+        scratch_shapes=_scratch(bq, d_v),
+        interpret=interpret,
+    )(*args)
+    out = out[:, :tq].reshape(*batch, tq, d_v)
+    if mask is not None:
+        any_valid = jnp.any(~mask, axis=-1, keepdims=True)
+        out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
+    return out
+
+
+def _scratch(bq, d_v):
+    # pltpu is importable (pure Python) even off-TPU; the interpreter
+    # emulates VMEM scratch on CPU.
+    from jax.experimental.pallas import tpu as pltpu
+    return [pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d_v), jnp.float32)]
+
+
+def _reference_math(q, k, v, mask, scale, causal):
+    """Identical math in jnp — the recompute backward and the test oracle."""
+    s = jnp.einsum('...td,...od->...to', q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask, _NEG_BIG, s)
+    if causal:
+        tq, tk = q.shape[-2], k.shape[-2]
+        future = jnp.arange(tq)[:, None] < jnp.arange(tk)[None, :]
+        s = jnp.where(future, _NEG_BIG, s)
+    attn = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('...to,...od->...td', attn, v.astype(jnp.float32))
+    if mask is not None:
+        out = jnp.where(jnp.any(~mask, axis=-1, keepdims=True), out, 0.0)
+    return out.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, scale, causal, interpret):
+    return _flash_fwd_impl(q, k, v, mask, scale, causal, interpret)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, interpret):
+    return _flash_fwd_impl(q, k, v, mask, scale, causal, interpret), \
+        (q, k, v, mask)
+
+
+def _flash_bwd(scale, causal, interpret, res, g):
+    q, k, v, mask = res
+
+    def f(q, k, v):
+        return _reference_math(q, k, v, mask, scale, causal)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, *, causal=False, scale=None,
+                    interpret=None):
+    """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as one TPU kernel.
+
+    ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
+    boolean ``mask (..., Tq, Tk)`` broadcastable over the leading dims
+    (True = masked out, the reference's convention, reference README.md:67).
+    Differentiable (recompute backward). ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU so the CPU test mesh runs the same code.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    return _flash(q, k, v, mask, float(scale), bool(causal), bool(interpret))
