@@ -32,6 +32,7 @@ from distributed_dot_product_tpu.ops.functions import (
     distributed_matmul_tn_global,
 )
 from distributed_dot_product_tpu.parallel.mesh import seq_mesh, shard_seq
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 from distributed_dot_product_tpu.utils.tracing import (
     device_peak_bytes, time_fn,
 )
@@ -43,7 +44,15 @@ DIM = 768        # reference benchmark.py:74
 def parse_args():
     # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument('--mode', choices=['nt', 'all', 'tn'], default='nt')
+    parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn'],
+                        default='nt')
+    parser.add_argument('--attn-impl',
+                        choices=['full', 'online', 'flash'], default='flash',
+                        help='attention softmax/fusion path (attn mode)')
+    parser.add_argument('--heads', type=int, default=8,
+                        help='attention heads (attn mode)')
+    parser.add_argument('--head-dim', type=int, default=64,
+                        help='per-head feature dim (attn mode)')
     parser.add_argument('--offset', type=int, default=32)
     parser.add_argument('--scale', type=int, default=1,
                         help='T = 75000 // scale')
@@ -85,10 +94,105 @@ def _summed(fn):
     live at once. The extra reduction pass is charged to both the local and
     distributed measurements equally (and biases *against* us vs the
     reference, whose timings exclude any output read)."""
-    return jax.jit(lambda l, r: jnp.sum(fn(l, r), dtype=jnp.float32))
+    return jax.jit(lambda *a: jnp.sum(fn(*a), dtype=jnp.float32))
+
+
+def run_attn(args):
+    """Attention-op benchmark (no reference analog — the reference only
+    benchmarks the L2 kernels, reference benchmark.py:23-26): time the
+    fused/online/full attention paths ``softmax(q·kᵀ/√d [+mask])·v`` at
+    ``T = 75000 // scale``, reporting the 2·matmul FLOP rate."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+    from distributed_dot_product_tpu.ops.functions import (
+        _shard_mapped, distributed_matmul_all, distributed_matmul_nt,
+    )
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    mesh = seq_mesh(args.devices)
+    world = mesh.devices.size
+    t = FULL_T // args.scale
+    t -= t % world
+    h, d = args.heads, args.head_dim
+    dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
+    flops = 4.0 * h * t * t * d
+
+    if args.attn_impl == 'full':
+        # Full softmax materializes the per-shard (H, T/N, T) scores —
+        # refuse what can't fit rather than dying in an opaque device OOM
+        # (the reference's module path has the same ceiling, SURVEY §5).
+        # Sized per device; ×2 for scores + softmax output both live.
+        try:
+            limit = (jax.devices()[0].memory_stats() or {}).get('bytes_limit')
+        except Exception:
+            limit = None
+        need = 2 * h * (t // world) * t * jnp.dtype(dtype).itemsize
+        if limit and need > 0.45 * limit:
+            raise SystemExit(
+                f'attn_impl=full needs ~{need / 2**30:.1f} GiB of score '
+                f'buffers per device; raise --scale or use more devices')
+
+    keys = jax.random.split(jax.random.key(111), 3)
+    shape = (1, h, t, d)
+    spec = P(None, None, SEQ_AXIS, None)
+    q, k, v = (jax.device_put(jax.random.normal(kk, shape, dtype),
+                              NamedSharding(mesh, spec)) for kk in keys)
+
+    # Every impl runs through shard_map (a W=1 mesh degenerates cleanly), so
+    # the recorded attn_impl always names the code path actually measured.
+    if args.attn_impl == 'online':
+        body = lambda q, k, v: ring_attention(q, k, v)  # noqa: E731
+    elif args.attn_impl == 'flash':
+        def body(q, k, v):
+            kf = jax.lax.all_gather(k, SEQ_AXIS, axis=2, tiled=True)
+            vf = jax.lax.all_gather(v, SEQ_AXIS, axis=2, tiled=True)
+            return flash_attention(q, kf, vf)
+    else:
+        def body(q, k, v):
+            s = distributed_matmul_nt(q, k, args.offset) / np.sqrt(d)
+            a = jax.nn.softmax(s, axis=-1)
+            return distributed_matmul_all(a, v, args.offset)
+    fn = _shard_mapped(body, mesh, (4, 4, 4), 4)
+
+    timed = _summed(fn)
+    best, mean = time_fn(timed, q, k, v, iters=args.iters)
+    peak = device_peak_bytes()
+    record = {
+        'mode': 'attn', 'attn_impl': args.attn_impl, 'scale': args.scale,
+        'T': t, 'heads': h, 'head_dim': d, 'world': world,
+        'dtype': args.dtype, 'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'dist_time': best, 'dist_time_mean': mean,
+        'dist_gflops_per_chip': flops / world / best / 1e9,
+        'dist_peak_bytes_per_chip': peak,
+    }
+    print(f"attn[{args.attn_impl}] T={t} H={h} d={d} {world}-device: "
+          f"{best:.4f}s ({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip"
+          + (f", peak {peak / 2**30:.2f} GiB)" if peak else ")"))
+    _append_record(args.file, record)
+    return record
+
+
+def _append_record(path, record):
+    # Append-to-JSON-file convention (reference benchmark.py:42-44,241-253).
+    results = []
+    if os.path.exists(path):
+        with open(path) as f:
+            results = json.load(f)
+    results.append(record)
+    with open(path, 'w') as f:
+        json.dump(results, f, indent=2)
 
 
 def run(args):
+    if args.mode == 'attn':
+        return run_attn(args)
     mesh = seq_mesh(args.devices)
     world = mesh.devices.size
     t = FULL_T // args.scale
@@ -169,14 +273,7 @@ def run(args):
       f"dist {world}-device {args.mode}: {best:.4f}s "
           f"({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip)")
 
-    # Append-to-JSON-file convention (reference benchmark.py:42-44,241-253).
-    results = []
-    if os.path.exists(args.file):
-        with open(args.file) as f:
-            results = json.load(f)
-    results.append(record)
-    with open(args.file, 'w') as f:
-        json.dump(results, f, indent=2)
+    _append_record(args.file, record)
     return record
 
 
