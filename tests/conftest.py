@@ -14,6 +14,9 @@ jax pinned to a TPU plugin, flipping the config here (before any
 ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
 """
 
+import os
+import tempfile
+
 import jax
 import pytest
 
@@ -21,6 +24,15 @@ _N_DEVICES = 8
 
 jax.config.update('jax_platforms', 'cpu')
 jax.config.update('jax_num_cpu_devices', _N_DEVICES)
+
+# Suite time is dominated by XLA:CPU compiles (~100 distinct jits), not by
+# the math — persist compiled executables across runs so the second and
+# later `pytest` invocations skip them. Keyed by jax version via the cache
+# itself; shared across workers.
+_CACHE = os.path.join(tempfile.gettempdir(), 'ddp_tpu_xla_cache')
+jax.config.update('jax_compilation_cache_dir', _CACHE)
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)
+jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
 
 
 @pytest.fixture(scope='session')

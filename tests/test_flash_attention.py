@@ -28,6 +28,9 @@ from distributed_dot_product_tpu.parallel.mesh import seq_mesh
 B, H, D = 2, 3, 16
 
 
+pytestmark = pytest.mark.slow  # Pallas-interpreter / lax.scan-heavy cases
+
+
 def _qkv(t, key=0, d_v=D):
     k1, k2, k3 = jax.random.split(jax.random.key(key), 3)
     q = jax.random.normal(k1, (B, H, t, D), jnp.float32)
@@ -113,6 +116,7 @@ def test_module_flash_impl_matches_local_oracle(devices):
     got = jax.shard_map(
         lambda p, k, q, v, mm: dist.apply(p, k, q, v, mm),
         mesh=mesh, in_specs=(P(), spec, spec, spec, spec),
+
         out_specs=spec, check_vma=False,
     )(params, x, x, x, m)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),

@@ -23,6 +23,9 @@ from distributed_dot_product_tpu.models.ring_attention import (
 )
 from distributed_dot_product_tpu.parallel.mesh import seq_mesh
 
+pytestmark = pytest.mark.slow  # Pallas-interpreter / lax.scan-heavy cases
+
+
 WORLD = 4
 TN = 6
 T = WORLD * TN
@@ -137,6 +140,7 @@ def test_module_online_softmax_matches_full(mesh):
         return lambda p: jnp.sum(
             apply_seq_parallel(mod, p, mesh, x, x, x, m) ** 2)
     g_full = jax.grad(loss(full))(params)
+
     g_online = jax.grad(loss(online))(params)
     for got, want in zip(jax.tree.leaves(g_online), jax.tree.leaves(g_full)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),

@@ -77,3 +77,28 @@ def test_graft_dryrun_multichip():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)   # asserts internally
     __graft_entry__.dryrun_multichip(5)   # odd -> pure SP path
+
+
+@pytest.mark.slow
+def test_graft_dryrun_self_provisions_from_single_device():
+    """Reproduce the driver's environment: a process whose JAX sees ONE
+    device calls ``dryrun_multichip(8)``. The dryrun must re-exec itself
+    onto an 8-device virtual CPU mesh and succeed — round 1 failed exactly
+    this (MULTICHIP_r01.json rc=1). Runs in a subprocess so the conftest's
+    8-device pin can't mask the condition."""
+    import subprocess
+    code = ("import jax; "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "jax.config.update('jax_num_cpu_devices', 1); "
+            "assert len(jax.devices()) == 1, jax.devices(); "
+            "import __graft_entry__ as g; g.dryrun_multichip(8)")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'DDP_TPU_DRYRUN_SUBPROCESS')}
+    proc = subprocess.run(
+        [sys.executable, '-c', code], cwd=_REPO_ROOT, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600)
+    assert proc.returncode == 0, proc.stdout
+    assert 'dryrun_multichip(8)' in proc.stdout and 'OK' in proc.stdout, \
+        proc.stdout
