@@ -1,38 +1,45 @@
 # -*- coding: utf-8 -*-
 """
-Fused flash-attention Pallas TPU kernel (the hot-op fusion layer).
+Fused flash-attention Pallas TPU kernels (the hot-op fusion layer).
 
 The reference computes attention as four separate eager ops — scores matmul,
 mask fill, softmax, context matmul (reference module.py:60-69) — each
 reading/writing the full ``(*, T/N, T)`` score tensor through device memory.
-XLA fuses the elementwise pieces; this kernel fuses the *whole* chain in
+XLA fuses the elementwise pieces; these kernels fuse the *whole* chain in
 VMEM with an online softmax, so score blocks never touch HBM: traffic drops
 from O(T²) to O(T·d) and live score memory from O(Tq·Tk) to
-O(BLOCK_Q·BLOCK_K).
+O(BLOCK_Q·BLOCK_K) — in BOTH directions. The backward is the standard
+flash recompute strategy as two Pallas kernels (a dq pass and a dk/dv
+pass): score blocks are re-derived from q/k and the saved row logsumexp,
+so training memory is O(T·d) too, not O(T²).
 
 No reference analog (SURVEY §7 step 6 names this as the post-parity
 performance pass). Layout, per the TPU Pallas playbook:
 
-- grid = (batch·heads, Tq/BLOCK_Q, Tk/BLOCK_K) with the K sweep innermost —
-  TPU grids run sequentially, so the running ``(max, denom, numerator)``
-  accumulators live in VMEM scratch across K steps; only one
-  ``(BLOCK, d)`` tile of K/V is resident at a time (Pallas double-buffers
-  the HBM→VMEM streams), so sequence length is bounded by HBM, not VMEM;
-- both matmuls hit the MXU with fp32 accumulation
+- forward grid = (batch·heads, Tq/BLOCK_Q, Tk/BLOCK_K) with the K sweep
+  innermost — TPU grids run sequentially, so the running
+  ``(max, denom, numerator)`` accumulators live in VMEM scratch across K
+  steps; only one ``(BLOCK, d)`` tile of K/V is resident at a time (Pallas
+  double-buffers the HBM→VMEM streams), so sequence length is bounded by
+  HBM, not VMEM;
+- backward dq grid sweeps K innermost with a dq accumulator; the dk/dv
+  grid transposes the sweep (Q innermost) with dk/dv accumulators — each
+  pass recomputes ``p = exp(s − lse)`` from the residuals ``(q, k, lse)``
+  and contracts with the standard flash-backward algebra
+  ``ds = p · (dp − Δ)``, ``Δ = rowsum(dO ⊙ O)``;
+- all matmuls hit the MXU with fp32 accumulation
   (``preferred_element_type``) whatever the input dtype; block shapes are
   lane(128)/sublane aligned;
 - causal programs whose whole K block lies in the masked future skip the
-  matmuls entirely (``pl.when``) — ~2× for causal attention;
+  matmuls entirely (``pl.when``) — ~2× for causal attention, forward and
+  backward;
 - masked logits use a large-finite negative (not ``-inf``) and fully-masked
-  rows return 0, matching
+  rows return 0 with zero gradients, matching
   :mod:`distributed_dot_product_tpu.models.ring_attention` semantics (the
-  reference NaNs on fully-masked rows, SURVEY §4);
-- backward is the recompute strategy: residuals are ``(q, k, v, mask)``
-  only, gradients re-derive the softmax via plain jnp (XLA fuses it); this
-  keeps forward memory O(T·d) without a second hand-written kernel.
+  reference NaNs on fully-masked rows, SURVEY §4).
 
-On non-TPU backends (the 8-virtual-device CPU test mesh) the kernel runs in
-Pallas interpreter mode, so the identical code path is covered by the
+On non-TPU backends (the 8-virtual-device CPU test mesh) the kernels run in
+Pallas interpreter mode, so the identical code paths are covered by the
 regular test suite.
 """
 
@@ -61,6 +68,18 @@ def _block_sizes(tq, tk, dtype, d_total=128):
     return bq, bk
 
 
+def _bwd_block_sizes(tq, tk, dtype, d_total=128):
+    """The backward keeps more tiles live per program (q, k, v, dO, plus
+    the p/dp/ds score blocks and the dk/dv accumulators), so cap blocks at
+    512×512 to stay inside VMEM at large head dims."""
+    sub = 16 if dtype == jnp.bfloat16 else 8
+    cap = 512 if d_total <= 256 else 256
+    bq = min(cap, max(sub, -(-tq // sub) * sub))
+    bk = min(512, max(128 if tk >= 128 else sub,
+                      -(-tk // sub) * sub))
+    return bq, bk
+
+
 def _pad_dim(x, axis, mult):
     size = x.shape[axis]
     target = -(-size // mult) * mult
@@ -71,13 +90,81 @@ def _pad_dim(x, axis, mult):
     return jnp.pad(x, pad)
 
 
-def _make_kernel(scale, causal, bq, bk, kv_len, has_mask):
+def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref):
+    """Shared logit masking: user mask block, causal future, Tk padding."""
+    if mask_ref is not None:
+        s = jnp.where(mask_ref[0], _NEG_BIG, s)
+    if causal:
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows < cols, _NEG_BIG, s)
+    if kv_len % bk:
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(cols >= kv_len, _NEG_BIG, s)
+    return s
+
+
+def _mask_setup(mask, batch, tq, tk, tq_p, tk_p):
+    """Validate mask broadcasting and flatten it WITHOUT materializing the
+    broadcast: returns the padded flat mask and a flat-batch-index map
+    (folded into the BlockSpec) that skips size-1 mask axes.
+
+    Padding rows/cols are set True (masked) so padded K columns never
+    contribute and padded Q rows recompute as fully-masked (their
+    cotangents are zero-padded anyway).
+    """
+    if mask.ndim - 2 > len(batch):
+        # More leading dims than q/k/v: the output batch shape comes solely
+        # from q/k/v, so NumPy-style broadcasting cannot apply — reject
+        # instead of silently indexing only mask[0].
+        raise ValueError(
+            f'mask has {mask.ndim - 2} leading dims but q/k/v have '
+            f'{len(batch)}; a mask may not add batch dims')
+    mlead = (1,) * (len(batch) - (mask.ndim - 2)) + mask.shape[:-2]
+    if mask.shape[-2:] != (tq, tk):
+        raise ValueError(
+            f'mask trailing dims {mask.shape[-2:]} must equal '
+            f'(Tq, Tk) = {(tq, tk)}')
+    for db, dm in zip(batch, mlead):
+        if dm not in (1, db):
+            raise ValueError(
+                f'mask leading dims {mask.shape[:-2]} do not broadcast '
+                f'against q/k/v leading dims {tuple(batch)}')
+    nm = int(math.prod(mlead)) if mlead else 1
+    maskf = jnp.pad(mask.reshape(nm, tq, tk),
+                    ((0, 0), (0, tq_p - tq), (0, tk_p - tk)),
+                    constant_values=True)
+
+    # Row-major strides of the mask's leading dims inside the batch.
+    midx_strides = []
+    stride = 1
+    for db, dm in zip(reversed(batch), reversed(mlead)):
+        midx_strides.append(0 if dm == 1 else stride)
+        stride *= dm
+    midx_strides.reverse()
+
+    def mask_batch_index(b):
+        out = 0
+        rem = b
+        for db, st in zip(reversed(batch), reversed(midx_strides)):
+            out = out + (rem % db) * st
+            rem = rem // db
+        return out
+
+    return maskf, mask_batch_index
+
+
+def _make_fwd_kernel(scale, causal, bq, bk, kv_len, has_mask, save_lse):
     def kernel(*refs):
         if has_mask:
-            q_ref, k_ref, v_ref, mask_ref, o_ref, m_s, l_s, acc_s = refs
+            q_ref, k_ref, v_ref, mask_ref, *rest = refs
         else:
-            q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
+            q_ref, k_ref, v_ref, *rest = refs
             mask_ref = None
+        if save_lse:
+            o_ref, lse_ref, m_s, l_s, acc_s = rest
+        else:
+            (o_ref, m_s, l_s, acc_s), lse_ref = rest, None
         qi = pl.program_id(1)
         ki = pl.program_id(2)
         last_k = pl.num_programs(2) - 1
@@ -103,18 +190,7 @@ def _make_kernel(scale, causal, bq, bk, kv_len, has_mask):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BQ, BK)
-            if mask_ref is not None:
-                s = jnp.where(mask_ref[0], _NEG_BIG, s)
-            if causal:
-                rows = qi * bq + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0)
-                cols = ki * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1)
-                s = jnp.where(rows < cols, _NEG_BIG, s)
-            if kv_len % bk:
-                cols = ki * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1)
-                s = jnp.where(cols >= kv_len, _NEG_BIG, s)
+            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -129,7 +205,8 @@ def _make_kernel(scale, causal, bq, bk, kv_len, has_mask):
         @pl.when(ki == last_k)
         def _():
             l = l_s[:]
-            out = acc_s[:] / jnp.where(l == 0.0, 1.0, l)
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            out = acc_s[:] / safe_l
             # l == 0 happens only for causal rows before any valid column of
             # a fully-skipped prefix (impossible: block (qi,0) always runs)
             # or for fully-masked rows, which must return 0 (parity with
@@ -137,11 +214,13 @@ def _make_kernel(scale, causal, bq, bk, kv_len, has_mask):
             # large-finite mask bias, fully-masked rows have l >= eps but
             # garbage weights — zero them via the mask below in the wrapper.
             o_ref[0] = out.astype(o_ref.dtype)
+            if save_lse:
+                lse_ref[0] = m_s[:] + jnp.log(safe_l)
 
     return kernel
 
 
-def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret):
+def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, save_lse=False):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -161,60 +240,38 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret):
     ]
     args = [qf, kf, vf]
     if mask is not None:
-        # The mask may broadcast over leading dims (the module passes
-        # (B, 1, T/N, T) for H heads). Never materialize the broadcast —
-        # keep the mask at its true size and fold the broadcast into the
-        # BlockSpec index map: flat batch index b -> flat mask index,
-        # skipping axes where the mask has size 1.
-        mlead = (1,) * (len(batch) - (mask.ndim - 2)) + mask.shape[:-2]
-        if mask.shape[-2:] != (tq, tk):
-            raise ValueError(
-                f'mask trailing dims {mask.shape[-2:]} must equal '
-                f'(Tq, Tk) = {(tq, tk)}')
-        for db, dm in zip(batch, mlead):
-            if dm not in (1, db):
-                raise ValueError(
-                    f'mask leading dims {mask.shape[:-2]} do not broadcast '
-                    f'against q/k/v leading dims {tuple(batch)}')
-        nm = int(math.prod(mlead)) if mlead else 1
-        maskf = jnp.pad(mask.reshape(nm, tq, tk),
-                        ((0, 0), (0, tq_p - tq), (0, tk_p - tk)),
-                        constant_values=True)  # padded K cols masked out
-
-        # Row-major strides of the mask's leading dims inside the batch.
-        midx_strides = []
-        stride = 1
-        for db, dm in zip(reversed(batch), reversed(mlead)):
-            midx_strides.append(0 if dm == 1 else stride)
-            stride *= dm
-        midx_strides.reverse()
-
-        def mask_batch_index(b):
-            out = 0
-            rem = b
-            for db, st in zip(reversed(batch), reversed(midx_strides)):
-                out = out + (rem % db) * st
-                rem = rem // db
-            return out
-
+        maskf, mask_batch_index = _mask_setup(mask, batch, tq, tk,
+                                              tq_p, tk_p)
         specs.append(pl.BlockSpec(
             (1, bq, bk), lambda b, i, j: (mask_batch_index(b), i, j)))
         args.append(maskf)
 
-    kernel = _make_kernel(scale, causal, bq, bk, tk, mask is not None)
-    out = pl.pallas_call(
+    out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0))
+    out_shape = jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype)
+    if save_lse:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((nb, tq_p, 1), jnp.float32)]
+
+    kernel = _make_fwd_kernel(scale, causal, bq, bk, tk, mask is not None,
+                              save_lse)
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=specs,
-        out_specs=pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=_scratch(bq, d_v),
         interpret=interpret,
     )(*args)
+    out, lse = res if save_lse else (res, None)
     out = out[:, :tq].reshape(*batch, tq, d_v)
     if mask is not None:
         any_valid = jnp.any(~mask, axis=-1, keepdims=True)
         out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
+    if save_lse:
+        return out, lse[:, :tq, 0].reshape(*batch, tq)
     return out
 
 
@@ -227,8 +284,196 @@ def _scratch(bq, d_v):
             pltpu.VMEM((bq, d_v), jnp.float32)]
 
 
+def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
+    def kernel(*refs):
+        if has_mask:
+            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+             dq_ref, dq_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+             dq_ref, dq_acc) = refs
+            mask_ref = None
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+        last_k = pl.num_programs(2) - 1
+
+        @pl.when(ki == 0)
+        def _():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        run = (qi + 1) * bq - 1 >= ki * bk if causal else True
+
+        @pl.when(run)
+        def _():
+            q = q_ref[0].astype(jnp.float32)                # (BQ, d)
+            k = k_ref[0].astype(jnp.float32)                # (BK, d)
+            v = v_ref[0].astype(jnp.float32)                # (BK, dv)
+            g = g_ref[0].astype(jnp.float32)                # (BQ, dv)
+            s = jax.lax.dot_general(
+                q * scale, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, BK)
+            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref)
+            p = jnp.exp(s - lse_ref[0])                     # (BQ, BK)
+            dp = jax.lax.dot_general(
+                g, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, BK)
+            ds = p * (dp - delta_ref[0])
+            dq_acc[:] += scale * jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, d)
+
+        @pl.when(ki == last_k)
+        def _():
+            dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
+    def kernel(*refs):
+        if has_mask:
+            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+            mask_ref = None
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+        last_q = pl.num_programs(2) - 1
+
+        @pl.when(qi == 0)
+        def _():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        run = (qi + 1) * bq - 1 >= kj * bk if causal else True
+
+        @pl.when(run)
+        def _():
+            q = q_ref[0].astype(jnp.float32)                # (BQ, d)
+            k = k_ref[0].astype(jnp.float32)                # (BK, d)
+            v = v_ref[0].astype(jnp.float32)                # (BK, dv)
+            g = g_ref[0].astype(jnp.float32)                # (BQ, dv)
+            s = jax.lax.dot_general(
+                q * scale, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, BK)
+            s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len, mask_ref)
+            p = jnp.exp(s - lse_ref[0])                     # (BQ, BK)
+            dv_acc[:] += jax.lax.dot_general(
+                p, g, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BK, dv)
+            dp = jax.lax.dot_general(
+                g, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BQ, BK)
+            ds = p * (dp - delta_ref[0])
+            dk_acc[:] += scale * jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # (BK, d)
+
+        @pl.when(qi == last_q)
+        def _():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
+    """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
+    memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
+    ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
+    ``dq = scale·ds·k``, ``dk = scale·dsᵀ·q``.
+    """
+    *batch, tq, d = q.shape
+    tk = k.shape[-2]
+    d_v = v.shape[-1]
+    nb = int(math.prod(batch)) if batch else 1
+
+    if mask is not None:
+        # Forward zeroed fully-masked rows, so their cotangent must not
+        # flow back through the (garbage-weight) softmax recompute.
+        any_valid = jnp.any(~mask, axis=-1, keepdims=True)
+        g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # (*batch, Tq, 1)
+
+    bq, bk = _bwd_block_sizes(tq, tk, q.dtype, d_total=d + d_v)
+    qf = _pad_dim(q.reshape(nb, tq, d), 1, bq)
+    kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
+    vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
+    gf = _pad_dim(g.reshape(nb, tq, d_v), 1, bq)            # zero-padded
+    lsef = _pad_dim(lse.reshape(nb, tq, 1), 1, bq)
+    deltaf = _pad_dim(delta.reshape(nb, tq, 1), 1, bq)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
+
+    args = [qf, kf, vf, gf, lsef, deltaf]
+    has_mask = mask is not None
+    if has_mask:
+        maskf, mask_batch_index = _mask_setup(mask, batch, tq, tk,
+                                              tq_p, tk_p)
+        args.append(maskf)
+
+    # --- dq pass: grid (batch, Q block, K block), K innermost ---
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    if has_mask:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (mask_batch_index(b), i, j)))
+    from jax.experimental.pallas import tpu as pltpu
+    dq = pl.pallas_call(
+        _make_dq_kernel(scale, causal, bq, bk, tk, has_mask),
+        grid=(nb, tq_p // bq, tk_p // bk),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    # --- dk/dv pass: grid (batch, K block, Q block), Q innermost ---
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d_v), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+    ]
+    if has_mask:
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i: (mask_batch_index(b), i, j)))
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(scale, causal, bq, bk, tk, has_mask),
+        grid=(nb, tk_p // bk, tq_p // bq),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((nb, tk_p, d_v), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d_v), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    dq = dq[:, :tq].reshape(q.shape)
+    dk = dk[:, :tk].reshape(k.shape)
+    dv = dv[:, :tk].reshape(v.shape)
+    return dq, dk, dv
+
+
 def _reference_math(q, k, v, mask, scale, causal):
-    """Identical math in jnp — the recompute backward and the test oracle."""
+    """Identical math in jnp — the test oracle."""
     s = jnp.einsum('...td,...od->...to', q.astype(jnp.float32) * scale,
                    k.astype(jnp.float32))
     if mask is not None:
@@ -250,18 +495,15 @@ def _flash(q, k, v, mask, scale, causal, interpret):
 
 
 def _flash_fwd(q, k, v, mask, scale, causal, interpret):
-    return _flash_fwd_impl(q, k, v, mask, scale, causal, interpret), \
-        (q, k, v, mask)
+    out, lse = _flash_fwd_impl(q, k, v, mask, scale, causal, interpret,
+                               save_lse=True)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, res, g):
-    q, k, v, mask = res
-
-    def f(q, k, v):
-        return _reference_math(q, k, v, mask, scale, causal)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, mask, out, lse, g, scale,
+                                 causal, interpret)
     return dq, dk, dv, None
 
 
@@ -270,13 +512,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, mask=None, *, causal=False, scale=None,
                     interpret=None):
-    """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as one TPU kernel.
+    """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
     boolean ``mask (..., Tq, Tk)`` broadcastable over the leading dims
     (True = masked out, the reference's convention, reference README.md:67).
-    Differentiable (recompute backward). ``interpret=None`` auto-selects the
-    Pallas interpreter off-TPU so the CPU test mesh runs the same code.
+    Differentiable end-to-end with blockwise Pallas kernels in both
+    directions — peak memory is O(T·d) for forward AND backward (the
+    backward recomputes score blocks from the saved row logsumexp).
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
+    CPU test mesh runs the same code.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])

@@ -103,23 +103,33 @@ class timed:
 
 
 @jax.jit
-def _sync_probe(x):
-    return x.ravel()[0]
+def _sync_probe(leaves):
+    # One scalar depending on EVERY leaf, so a single host readback fences
+    # all dispatches that produced them (multi-output computations may come
+    # from separate executables — probing only the first leaf would
+    # under-synchronize). Retraces per pytree structure; cached after.
+    import jax.numpy as jnp
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        acc = acc + leaf.ravel()[0].astype(jnp.float32)
+    return acc
 
 
 def hard_sync(out):
-    """Synchronize with the device by reading one element back to the host.
+    """Synchronize with the device by reading one element of every leaf
+    back to the host (as a single fused scalar → one RPC).
 
     ``jax.block_until_ready`` alone is not a reliable fence on remote /
     tunneled PJRT backends (observed: it returns in ~0.1 ms while the
     computation is still in flight); a host readback is. The probe is a
     cached tiny jit so steady-state cost is one small RPC.
     """
-    leaves = jax.tree.leaves(out)
+    leaves = [x for x in jax.tree.leaves(out)
+              if getattr(x, 'size', 1)]  # drop zero-size leaves
     if not leaves:
         return  # nothing to sync on (fn returned None / empty pytree)
     import numpy as np
-    np.asarray(_sync_probe(leaves[0]))
+    np.asarray(_sync_probe(leaves))
 
 
 def time_fn(fn, *args, iters=5, warmup=2, inner=None, max_inner=512,

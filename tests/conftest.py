@@ -29,7 +29,8 @@ jax.config.update('jax_num_cpu_devices', _N_DEVICES)
 # the math — persist compiled executables across runs so the second and
 # later `pytest` invocations skip them. Keyed by jax version via the cache
 # itself; shared across workers.
-_CACHE = os.path.join(tempfile.gettempdir(), 'ddp_tpu_xla_cache')
+_CACHE = os.path.join(tempfile.gettempdir(),
+                      f'ddp_tpu_xla_cache_{os.getuid()}')
 jax.config.update('jax_compilation_cache_dir', _CACHE)
 jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)
 jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)

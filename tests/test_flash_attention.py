@@ -77,15 +77,38 @@ def test_fully_masked_rows_zero_not_nan():
     assert np.isfinite(np.asarray(g)).all()
 
 
-def test_gradients_match_unfused():
-    q, k, v = _qkv(64)
-    m = _mask(64)
+@pytest.mark.parametrize('t', [64, 100])   # 100: blocks don't divide T
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('masked', [False, True])
+def test_gradients_match_unfused(t, causal, masked):
+    q, k, v = _qkv(t)
+    m = _mask(t) if masked else None
 
     def f_fused(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, m) ** 2)
+        return jnp.sum(flash_attention(q, k, v, m, causal=causal) ** 2)
 
     def f_ref(q, k, v):
         return jnp.sum(_reference_math(q, k, v, m, 1.0 / np.sqrt(D),
+                                       causal) ** 2)
+
+    g1 = jax.grad(f_fused, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_gradients_rectangular_and_dv():
+    """Backward with Tq != Tk and d_v != d (exercises both bwd kernels on
+    non-square grids)."""
+    q, _, _ = _qkv(48)
+    _, k, v = _qkv(80, key=1, d_v=24)
+
+    def f_fused(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference_math(q, k, v, None, 1.0 / np.sqrt(D),
                                        False) ** 2)
 
     g1 = jax.grad(f_fused, (0, 1, 2))(q, k, v)
@@ -93,6 +116,25 @@ def test_gradients_match_unfused():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_gradient_dtype_matches_primal():
+    """custom_vjp contract: cotangent dtypes equal primal dtypes (bf16)."""
+    q, k, v = _qkv(32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v).astype(jnp.float32) ** 2), (0, 1, 2))(
+            q, k, v)
+    assert all(x.dtype == jnp.bfloat16 for x in g)
+
+
+def test_mask_with_extra_leading_dims_rejected():
+    """A mask may broadcast over q/k/v leading dims but not ADD dims —
+    output batch shape comes solely from q/k/v."""
+    q, k, v = (x[0, 0] for x in _qkv(32))   # (T, d)
+    m = jnp.zeros((B, 32, 32), dtype=bool)
+    with pytest.raises(ValueError, match='may not add batch dims'):
+        flash_attention(q, k, v, m)
 
 
 def test_module_flash_impl_matches_local_oracle(devices):
@@ -116,7 +158,6 @@ def test_module_flash_impl_matches_local_oracle(devices):
     got = jax.shard_map(
         lambda p, k, q, v, mm: dist.apply(p, k, q, v, mm),
         mesh=mesh, in_specs=(P(), spec, spec, spec, spec),
-
         out_specs=spec, check_vma=False,
     )(params, x, x, x, m)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),

@@ -140,7 +140,6 @@ def test_module_online_softmax_matches_full(mesh):
         return lambda p: jnp.sum(
             apply_seq_parallel(mod, p, mesh, x, x, x, m) ** 2)
     g_full = jax.grad(loss(full))(params)
-
     g_online = jax.grad(loss(online))(params)
     for got, want in zip(jax.tree.leaves(g_online), jax.tree.leaves(g_full)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
