@@ -78,6 +78,10 @@ class DistributedDotProductAttn(nn.Module):
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
     softmax_impl: str = 'full'   # 'full' (parity) | 'online' | 'flash'
+    # For softmax_impl='flash': 'exact' running-max softmax, or 'bounded'
+    # (norm-bound shift — faster at small head dim; see
+    # ops.pallas_attention.flash_attention for the accuracy contract).
+    flash_softmax_mode: str = 'exact'
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -154,7 +158,8 @@ class DistributedDotProductAttn(nn.Module):
             else:
                 q_full, v_full = queries, values
             outputs = flash_attention(keys, q_full, v_full, attn_mask,
-                                      scale=scale)
+                                      scale=scale,
+                                      softmax_mode=self.flash_softmax_mode)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],

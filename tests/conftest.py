@@ -22,8 +22,13 @@ import pytest
 
 _N_DEVICES = 8
 
-jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', _N_DEVICES)
+# DDP_TPU_TESTS_ON_TPU=1 keeps the process on its real backend so the
+# `tpu`-marked hardware tests (Mosaic compile path) can run:
+#   DDP_TPU_TESTS_ON_TPU=1 pytest tests -m tpu
+# Everything else assumes the 8-device CPU mesh and is skipped/fails there.
+if not os.environ.get('DDP_TPU_TESTS_ON_TPU'):
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', _N_DEVICES)
 
 # Suite time is dominated by XLA:CPU compiles (~100 distinct jits), not by
 # the math — persist compiled executables across runs so the second and

@@ -128,6 +128,80 @@ def test_gradient_dtype_matches_primal():
     assert all(x.dtype == jnp.bfloat16 for x in g)
 
 
+@pytest.mark.parametrize('causal', [False, True])
+def test_bounded_softmax_mode_matches_exact(causal):
+    """softmax_mode='bounded' (norm-bound shift, no running max) must agree
+    with 'exact' to fp32 softmax tolerance, forward and gradients, including
+    masks and fully-masked rows."""
+    t = 100
+    q, k, v = _qkv(t)
+    m = _mask(t).at[:, :, 5, :].set(True)   # row 5 fully masked
+
+    out_b = flash_attention(q, k, v, m, causal=causal,
+                            softmax_mode='bounded')
+    out_e = flash_attention(q, k, v, m, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_e),
+                               atol=1e-5, rtol=1e-5)
+    gb = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, m, causal=causal, softmax_mode='bounded') ** 2),
+        (0, 1, 2))(q, k, v)
+    ge = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, m, causal=causal) ** 2), (0, 1, 2))(q, k, v)
+    for a, b in zip(gb, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_bounded_mode_safe_on_adversarial_norms():
+    """Huge-norm near-orthogonal q/k make the Cauchy-Schwarz bound exceed
+    fp32's exponent range; 'bounded' must auto-fall back to the exact
+    kernel instead of silently underflowing every weight to zero."""
+    t, d = 32, 64
+    q = jnp.zeros((1, t, d)).at[:, :, 0].set(35.0)
+    k = jnp.zeros((1, t, d)).at[:, :, 1].set(35.0)   # all scores exactly 0
+    v = jax.random.normal(jax.random.key(0), (1, t, d), jnp.float32)
+    out_b = flash_attention(q, k, v, softmax_mode='bounded')
+    out_e = flash_attention(q, k, v)
+    assert not np.allclose(np.asarray(out_b), 0.0)   # the failure mode
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_e),
+                               atol=1e-6, rtol=1e-6)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, softmax_mode='bounded') ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_bad_softmax_mode_rejected():
+    q, k, v = _qkv(32)
+    with pytest.raises(ValueError, match='softmax_mode'):
+        flash_attention(q, k, v, softmax_mode='fast')
+
+
+@pytest.mark.tpu
+def test_tpu_hardware_compile_path():
+    """Mosaic (real-TPU) compile coverage the interpreter can't give:
+    off-block-size T and bf16, forward + gradient, both softmax modes.
+    Skipped off-TPU; on TPU f32 matmuls default to bf16 compute, hence the
+    loose tolerance vs the fp32 oracle."""
+    import jax
+    if jax.default_backend() != 'tpu':
+        pytest.skip('requires a real TPU backend')
+    t = 777   # pads to non-trivial block multiple
+    q, k, v = _qkv(t)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    m = _mask(t)
+    ref = _reference_math(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), m, 1.0 / np.sqrt(D), False)
+    for mode in ('exact', 'bounded'):
+        out = flash_attention(q, k, v, m, softmax_mode=mode,
+                              interpret=False)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), atol=2e-2, rtol=2e-2)
+        g = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, m, softmax_mode=mode,
+            interpret=False).astype(jnp.float32) ** 2))(q)
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
 def test_mask_with_extra_leading_dims_rejected():
     """A mask may broadcast over q/k/v leading dims but not ADD dims —
     output batch shape comes solely from q/k/v."""
