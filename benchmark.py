@@ -47,13 +47,17 @@ def parse_args():
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn'],
                         default='nt')
     parser.add_argument('--attn-impl',
-                        choices=['full', 'online', 'flash'], default='flash',
+                        choices=['full', 'online', 'flash', 'flash_bounded'],
+                        default='flash',
                         help='attention softmax/fusion path (attn mode)')
     parser.add_argument('--heads', type=int, default=8,
                         help='attention heads (attn mode)')
     parser.add_argument('--head-dim', type=int, default=64,
                         help='per-head feature dim (attn mode)')
-    parser.add_argument('--offset', type=int, default=32)
+    parser.add_argument(
+        '--offset', default=32,
+        type=lambda s: None if s.lower() in ('none', 'full') else int(s),
+        help="gathered-chunk size; 'none' = single full gather")
     parser.add_argument('--scale', type=int, default=1,
                         help='T = 75000 // scale')
     parser.add_argument('--file', default='benchmark_results.json')
@@ -148,11 +152,13 @@ def run_attn(args):
     # the recorded attn_impl always names the code path actually measured.
     if args.attn_impl == 'online':
         body = lambda q, k, v: ring_attention(q, k, v)  # noqa: E731
-    elif args.attn_impl == 'flash':
+    elif args.attn_impl in ('flash', 'flash_bounded'):
+        smode = 'bounded' if args.attn_impl == 'flash_bounded' else 'exact'
+
         def body(q, k, v):
             kf = jax.lax.all_gather(k, SEQ_AXIS, axis=2, tiled=True)
             vf = jax.lax.all_gather(v, SEQ_AXIS, axis=2, tiled=True)
-            return flash_attention(q, kf, vf)
+            return flash_attention(q, kf, vf, softmax_mode=smode)
     else:
         def body(q, k, v):
             s = distributed_matmul_nt(q, k, args.offset) / np.sqrt(d)
@@ -160,7 +166,10 @@ def run_attn(args):
             return distributed_matmul_all(a, v, args.offset)
     fn = _shard_mapped(body, mesh, (4, 4, 4), 4)
 
-    timed = _summed(fn)
+    # AOT-compile once: the executable feeds both the timing loop and the
+    # memory analysis (a second .lower().compile() would double the
+    # per-config cost — compiles dominate the sweep).
+    timed = _summed(fn).lower(q, k, v).compile()
     best, mean = time_fn(timed, q, k, v, iters=args.iters)
     peak = device_peak_bytes()
     record = {
@@ -171,12 +180,36 @@ def run_attn(args):
         'dist_time': best, 'dist_time_mean': mean,
         'dist_gflops_per_chip': flops / world / best / 1e9,
         'dist_peak_bytes_per_chip': peak,
+        'dist_memory_analysis': _memory_analysis(timed),
     }
     print(f"attn[{args.attn_impl}] T={t} H={h} d={d} {world}-device: "
           f"{best:.4f}s ({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip"
           + (f", peak {peak / 2**30:.2f} GiB)" if peak else ")"))
     _append_record(args.file, record)
     return record
+
+
+def _memory_analysis(compiled):
+    """Compiler-reported per-device HBM footprint of the compiled program.
+
+    The reference records ``torch.cuda.max_memory_allocated`` (reference
+    benchmark.py:57-62); PJRT backends behind a tunnel expose no runtime
+    memory stats, so record XLA's own buffer assignment instead — exact,
+    reproducible, and it captures the offset↔memory trade the same way
+    (bigger gathered chunks = bigger temp buffers).
+    """
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            'argument_bytes': ma.argument_size_in_bytes,
+            'output_bytes': ma.output_size_in_bytes,
+            'temp_bytes': ma.temp_size_in_bytes,
+            'total_bytes': (ma.argument_size_in_bytes
+                            + ma.output_size_in_bytes
+                            + ma.temp_size_in_bytes),
+        }
+    except Exception:
+        return None
 
 
 def _append_record(path, record):
@@ -252,10 +285,12 @@ def run(args):
     else:
         fn = lambda l, r: distributed_matmul_tn_global(  # noqa: E731
             l, r, **kw)
-    fn = _summed(fn)
+    # AOT-compile once (see run_attn): one executable for profile, timing
+    # and memory analysis.
+    fn = _summed(fn).lower(gleft, gright).compile()
 
     if args.profile_dir:
-        jax.block_until_ready(fn(gleft, gright))  # compile outside trace
+        jax.block_until_ready(fn(gleft, gright))  # warm outside trace
         with jax.profiler.trace(args.profile_dir):
             jax.block_until_ready(fn(gleft, gright))
 
@@ -265,6 +300,7 @@ def run(args):
         dist_time=best, dist_time_mean=mean,
         dist_gflops_per_chip=flops / world / best / 1e9,
         dist_peak_bytes_per_chip=peak,
+        dist_memory_analysis=_memory_analysis(fn),
     )
     print(f"dist {world}-device {args.mode} offset={args.offset} "
           f"impl={args.impl}: {best:.4f}s "

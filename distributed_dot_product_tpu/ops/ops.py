@@ -143,8 +143,14 @@ _tn.defvjp(_tn_fwd, _tn_bwd)
 def matmul_tn(left, right, offset=32, axis_name=SEQ_AXIS, impl='allgather'):
     """Differentiable ``Aᵀ·B`` on sequence shards ``(*, T/N, T) × (*, T/N, D)``
     → ``(*, T/N, D)``. Reference ``LeftTransposeMultiplication.apply``
-    (reference ops.py:57-71); ``offset`` feeds the backward kernels only
-    (the tn forward has no chunk knob, reference functions.py:103)."""
+    (reference ops.py:57-71).
+
+    ``offset`` and ``impl`` configure the BACKWARD kernels only (the
+    gradients are an nt and an all matmul, which have both knobs); the tn
+    forward is a single fused matmul + ``psum_scatter`` with nothing to
+    chunk or ring-rotate (reference functions.py:103 likewise has no
+    offset). They are accepted so the three operators stay
+    call-compatible."""
     return _tn(left, right, offset, axis_name, impl)
 
 

@@ -109,6 +109,18 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref):
     return s
 
 
+def _row_has_valid(mask, causal, tq, tk):
+    """(..., Tq, 1) bool: does row i have ANY attendable key, counting the
+    causal restriction too? Rows without one output 0 with zero gradients
+    (in every softmax path — the kernels' semantics must not depend on
+    WHICH mask made the row empty)."""
+    valid = ~mask
+    if causal:
+        allowed = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        valid = jnp.logical_and(valid, allowed)
+    return jnp.any(valid, axis=-1, keepdims=True)
+
+
 def _mask_setup(mask, batch, tq, tk, tq_p, tk_p):
     """Validate mask broadcasting and flatten it WITHOUT materializing the
     broadcast: returns the padded flat mask and a flat-batch-index map
@@ -330,7 +342,7 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
     out, lse = res if save_lse else (res, None)
     out = out[:, :tq].reshape(*batch, tq, d_v)
     if mask is not None:
-        any_valid = jnp.any(~mask, axis=-1, keepdims=True)
+        any_valid = _row_has_valid(mask, causal, tq, tk)
         out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
     if save_lse:
         return out, lse[:, :tq, 0].reshape(*batch, tq)
@@ -523,9 +535,10 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
     nb = int(math.prod(batch)) if batch else 1
 
     if mask is not None:
-        # Forward zeroed fully-masked rows, so their cotangent must not
-        # flow back through the (garbage-weight) softmax recompute.
-        any_valid = jnp.any(~mask, axis=-1, keepdims=True)
+        # Forward zeroed rows with no attendable key (counting causal), so
+        # their cotangent must not flow back through the (garbage-weight)
+        # softmax recompute.
+        any_valid = _row_has_valid(mask, causal, tq, tk)
         g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # (*batch, Tq, 1)
@@ -609,18 +622,18 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
 
 def _reference_math(q, k, v, mask, scale, causal):
     """Identical math in jnp — the test oracle."""
+    tq, tk = q.shape[-2], k.shape[-2]
     s = jnp.einsum('...td,...od->...to', q.astype(jnp.float32) * scale,
                    k.astype(jnp.float32))
     if mask is not None:
         s = jnp.where(mask, _NEG_BIG, s)
     if causal:
-        tq, tk = q.shape[-2], k.shape[-2]
         future = jnp.arange(tq)[:, None] < jnp.arange(tk)[None, :]
         s = jnp.where(future, _NEG_BIG, s)
     attn = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum('...to,...od->...td', attn, v.astype(jnp.float32))
     if mask is not None:
-        out = jnp.where(jnp.any(~mask, axis=-1, keepdims=True), out, 0.0)
+        out = jnp.where(_row_has_valid(mask, causal, tq, tk), out, 0.0)
     return out.astype(v.dtype)
 
 

@@ -170,6 +170,30 @@ def test_bounded_mode_safe_on_adversarial_norms():
     assert np.isfinite(np.asarray(g)).all()
 
 
+@pytest.mark.parametrize('mode', ['exact', 'bounded'])
+def test_row_masked_only_by_causal_union_is_zero(mode):
+    """A row whose attendable keys are emptied only by the UNION of the
+    user mask and causality (neither alone) must behave like a
+    fully-masked row — 0 output, zero/finite grads — identically in both
+    softmax modes and in the oracle."""
+    t, row = 16, 5
+    q, k, v = _qkv(t)
+    m = jnp.zeros((B, H, t, t), dtype=bool)
+    m = m.at[:, :, row, :row + 1].set(True)   # user mask kills j<=row only
+    out = flash_attention(q, k, v, m, causal=True, softmax_mode=mode)
+    ref = _reference_math(q, k, v, m, 1.0 / np.sqrt(D), True)
+    assert (np.asarray(out)[:, :, row] == 0).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    g = jax.grad(lambda v: jnp.sum(flash_attention(
+        q, k, v, m, causal=True, softmax_mode=mode) ** 2))(v)
+    gr = jax.grad(lambda v: jnp.sum(_reference_math(
+        q, k, v, m, 1.0 / np.sqrt(D), True) ** 2))(v)
+    assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_bad_softmax_mode_rejected():
     q, k, v = _qkv(32)
     with pytest.raises(ValueError, match='softmax_mode'):
