@@ -44,8 +44,11 @@ DIM = 768        # reference benchmark.py:74
 def parse_args():
     # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn'],
+    parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
+                                           'train'],
                         default='nt')
+    parser.add_argument('--seq-len', type=int, default=16384,
+                        help='global sequence length (train mode)')
     parser.add_argument('--attn-impl',
                         choices=['full', 'online', 'flash', 'flash_bounded'],
                         default='flash',
@@ -212,6 +215,79 @@ def _memory_analysis(compiled):
         return None
 
 
+def run_train(args):
+    """Full training-step benchmark: forward, loss, gradient psum, optax
+    update as ONE compiled SPMD program (``train.make_train_step``) at the
+    example workload scaled up (reference example.py runs T=4096, dim 768,
+    heads 2 with no optimizer; here T defaults to 16384 with an adam
+    update). Reports the whole-step FLOP rate, counting projections + both
+    attention matmuls forward and the standard 2× for backward.
+    """
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_dot_product_tpu import DistributedDotProductAttn
+    from distributed_dot_product_tpu.train import make_train_step
+
+    mesh = seq_mesh(args.devices)
+    world = mesh.devices.size
+    t = args.seq_len - args.seq_len % world
+    dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
+    heads = args.heads
+
+    model = DistributedDotProductAttn(
+        key_dim=DIM, num_heads=heads, offset=args.offset or 32,
+        softmax_impl=args.attn_impl.replace('_bounded', ''),
+        flash_softmax_mode=('bounded' if args.attn_impl == 'flash_bounded'
+                            else 'exact'),
+        impl=args.impl, dtype=dtype)
+
+    k1, k2 = jax.random.split(jax.random.key(111))
+    x_host = jax.random.normal(k1, (1, t, DIM), dtype)
+    target_host = jax.random.normal(k2, (1, t, DIM), dtype)
+    act = NamedSharding(mesh, P(None, SEQ_AXIS, None))
+    x = jax.device_put(x_host, act)
+    target = jax.device_put(target_host, act)
+    mask = jax.device_put(jnp.zeros((1, t, t), dtype=bool),
+                          NamedSharding(mesh, P(None, SEQ_AXIS, None)))
+
+    # Init at a tiny T: parameter shapes depend only on DIM, and a
+    # full-length init forward would cost an extra whole-T compile per
+    # sweep config.
+    t0 = max(world * 2, 16)
+    x0 = jnp.zeros((1, t0, DIM), dtype)
+    params = model.init(jax.random.key(0), x0, x0, x0,
+                        jnp.zeros((1, t0, t0), dtype=bool))
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step = make_train_step(model, optimizer, mesh, donate=False)
+
+    batch = (x, x, x, mask, target)
+    compiled = step.lower(params, opt_state, batch).compile()
+    best, mean = time_fn(compiled, params, opt_state, batch,
+                         iters=args.iters)
+    # FLOPs: 4 projections (2·T·768² each) + scores/context matmuls
+    # (2·T²·768 each) forward; backward ≈ 2× forward; adam is negligible.
+    fwd = 8.0 * t * DIM * DIM + 4.0 * t * t * DIM
+    flops = 3.0 * fwd
+    record = {
+        'mode': 'train', 'attn_impl': args.attn_impl, 'T': t, 'dim': DIM,
+        'heads': heads, 'world': world, 'dtype': args.dtype,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'step_time': best, 'step_time_mean': mean,
+        'step_gflops_per_chip': flops / world / best / 1e9,
+        'memory_analysis': _memory_analysis(compiled),
+    }
+    ma = record['memory_analysis'] or {}
+    print(f"train[{args.attn_impl}] T={t} dim={DIM} H={heads} "
+          f"{world}-device: {best:.4f}s/step "
+          f"({record['step_gflops_per_chip']:.0f} GFLOP/s/chip, "
+          f"temp {ma.get('temp_bytes', 0) / 2**30:.2f} GiB)")
+    _append_record(args.file, record)
+    return record
+
+
 def _append_record(path, record):
     # Append-to-JSON-file convention (reference benchmark.py:42-44,241-253).
     results = []
@@ -226,6 +302,8 @@ def _append_record(path, record):
 def run(args):
     if args.mode == 'attn':
         return run_attn(args)
+    if args.mode == 'train':
+        return run_train(args)
     mesh = seq_mesh(args.devices)
     world = mesh.devices.size
     t = FULL_T // args.scale

@@ -129,10 +129,18 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     # belt-and-braces only.
     out = o / jnp.where(l == 0, jnp.ones_like(l), l)[..., None]
     if mask is not None:
-        # With large-finite (not -inf) mask bias, a fully-masked row would
-        # otherwise degenerate to a softmax over its raw q·k logits; zero
-        # it explicitly (the reference produces NaN here).
-        any_valid = jnp.any(~mask, axis=-1)
+        # With large-finite (not -inf) mask bias, a row with no attendable
+        # key would otherwise degenerate to a softmax over its raw q·k
+        # logits; zero it explicitly (the reference produces NaN here).
+        # "No attendable key" counts the causal restriction too — the
+        # semantics must not depend on WHICH mask emptied the row, and must
+        # match flash_attention's (ops/pallas_attention._row_has_valid).
+        valid = ~mask
+        if causal:
+            col_pos = jnp.arange(mask.shape[-1])
+            valid = jnp.logical_and(valid,
+                                    row_pos[:, None] >= col_pos[None, :])
+        any_valid = jnp.any(valid, axis=-1)
         out = jnp.where(any_valid[..., None], out, jnp.zeros((), out.dtype))
     return out.astype(v.dtype)
 
@@ -152,6 +160,12 @@ def local_attention_reference(q, k, v, mask=None, causal=False, scale=None):
     attn = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('...to,...od->...td', attn, v.astype(dtype))
     if mask is not None:
-        out = jnp.where(jnp.any(~mask, axis=-1)[..., None], out,
+        # Union semantics, as in ring_attention above.
+        valid = ~mask
+        if causal:
+            valid = jnp.logical_and(
+                valid, jnp.arange(q.shape[-2])[:, None]
+                >= jnp.arange(k.shape[-2])[None, :])
+        out = jnp.where(jnp.any(valid, axis=-1)[..., None], out,
                         jnp.zeros((), out.dtype))
     return out.astype(v.dtype)

@@ -58,27 +58,29 @@ __all__ = ['flash_attention']
 _NEG_BIG = -0.7 * 3.4e38  # large-finite fp32; keeps exp()/VJP NaN-free
 
 
-def _block_sizes(tq, tk, dtype, d_total=128):
+def _block_sizes(tq, tk, dtype, d_total=128, has_mask=False):
     """Measured on v5e (T=16K, d=64, bf16): 1024×1024 blocks hit
     ~76 TFLOP/s vs ~38 at 512×512; 2048×2048 exceeds VMEM. Halve the Q
-    block when the head dims are large so the fp32 score block + running
-    accumulator + double-buffered K/V tiles stay within ~12 MB of VMEM."""
+    block when the head dims are large — or when a mask is present
+    (Mosaic widens bool blocks to s32 in VMEM, so a (1024, 1024) mask
+    block alone is 4 MB of the ~16 MB scoped budget)."""
     sub = 16 if dtype == jnp.bfloat16 else 8
-    cap_q = 1024 if d_total <= 256 else 512
+    cap_q = 1024 if d_total <= 256 and not has_mask else 512
     bq = min(cap_q, max(sub, -(-tq // sub) * sub))
     bk = min(1024, max(128 if tk >= 128 else sub,
                        -(-tk // sub) * sub))
     return bq, bk
 
 
-def _bwd_block_sizes(tq, tk, dtype, d_total=128):
+def _bwd_block_sizes(tq, tk, dtype, d_total=128, has_mask=False):
     """The backward keeps more tiles live per program (q, k, v, dO, plus
     the p/dp/ds score blocks and the dk/dv accumulators). Measured on v5e
     (T=16K, d=64, bf16): 1024×1024 runs the fwd+bwd chain 17% faster than
-    512×512 and still fits VMEM; halve both when the head dims are large."""
+    512×512 and still fits VMEM; halve when the head dims are large or a
+    (s32-widened) mask block joins the working set."""
     sub = 16 if dtype == jnp.bfloat16 else 8
-    cap_q = 1024 if d_total <= 256 else 256
-    cap_k = 1024 if d_total <= 256 else 512
+    cap_q = 1024 if d_total <= 256 and not has_mask else 256
+    cap_k = 1024 if d_total <= 256 and not has_mask else 512
     bq = min(cap_q, max(sub, -(-tq // sub) * sub))
     bk = min(cap_k, max(128 if tk >= 128 else sub,
                         -(-tk // sub) * sub))
@@ -96,9 +98,14 @@ def _pad_dim(x, axis, mult):
 
 
 def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref):
-    """Shared logit masking: user mask block, causal future, Tk padding."""
+    """Shared logit masking: user mask block, causal future, Tk padding.
+
+    The mask arrives as int8 (1 = masked): Mosaic widens bool kernel
+    operands to s32 — a full-size O(4·Tq·Tk) HBM copy — but takes int8
+    blocks natively.
+    """
     if mask_ref is not None:
-        s = jnp.where(mask_ref[0], _NEG_BIG, s)
+        s = jnp.where(mask_ref[0] != 0, _NEG_BIG, s)
     if causal:
         rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -148,9 +155,10 @@ def _mask_setup(mask, batch, tq, tk, tq_p, tk_p):
                 f'mask leading dims {mask.shape[:-2]} do not broadcast '
                 f'against q/k/v leading dims {tuple(batch)}')
     nm = int(math.prod(mlead)) if mlead else 1
-    maskf = jnp.pad(mask.reshape(nm, tq, tk),
+    # int8, not bool: see _apply_masks. Padding rows/cols are masked (1).
+    maskf = jnp.pad(mask.reshape(nm, tq, tk).astype(jnp.int8),
                     ((0, 0), (0, tq_p - tq), (0, tk_p - tk)),
-                    constant_values=True)
+                    constant_values=1)
 
     # Row-major strides of the mask's leading dims inside the batch.
     midx_strides = []
@@ -262,7 +270,8 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
 
-    bq, bk = _block_sizes(tq, tk, q.dtype, d_total=d + d_v)
+    bq, bk = _block_sizes(tq, tk, q.dtype, d_total=d + d_v,
+                          has_mask=mask is not None)
     # exp2 trick: fold scale·log2(e) into q so the kernel's score block
     # needs no per-element multiply (exp2 replaces exp, whose hardware
     # lowering is exp2(x·log2e) anyway). One extra rounding of q, same
@@ -543,7 +552,8 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # (*batch, Tq, 1)
 
-    bq, bk = _bwd_block_sizes(tq, tk, q.dtype, d_total=d + d_v)
+    bq, bk = _bwd_block_sizes(tq, tk, q.dtype, d_total=d + d_v,
+                              has_mask=mask is not None)
     # Same exp2 pre-folding as the forward: q carries scale·log2e, lse is
     # converted to log2 units, so the kernels' (BQ, BK) score blocks need
     # no per-element multiply.

@@ -1,0 +1,222 @@
+# -*- coding: utf-8 -*-
+"""
+Generate RESULTS.md from the benchmark_results/*.json corpus, side by side
+with the reference baseline (BASELINE.md).
+
+    python scripts/make_results_md.py > RESULTS.md
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Reference numbers transcribed from BASELINE.md (means over the committed
+# runs of /root/reference/benchmark_results/): Dist GFLOP/s/chip and peak
+# GiB/rank on 3x Quadro RTX 6000 fp32 over Horovod/NCCL.
+BASE_NT_OFFSET = {1000: (1660, 14.26), 1250: (1695, 14.33), 2500: (1763, 14.69),
+                  5000: (1794, 15.41), 6250: (1854, 15.77),
+                  12500: (1876, 17.57), 25000: (2287, 21.17)}
+BASE_NT_SIZE = {1: (1656, 14.26), 2: (986, 3.57), 4: (317, 0.89), 8: (88, 0.23)}
+BASE_ALL_OFFSET = {24: (1300, 7.29), 48: (1954, 7.30), 96: (2553, 7.34),
+                   192: (2835, 7.40), 384: (3179, 7.56), 768: (4404, 7.70)}
+BASE_ALL_SIZE = {1: (3852, 7.70), 2: (1534, 2.10), 4: (492, 0.62),
+                 8: (139, 0.20)}
+BASE_TN_SIZE = {1: (3188, 3.20), 2: (1133, 0.75), 4: (304, 0.23),
+                8: (79, 0.08)}
+
+
+def load(stem):
+    path = os.path.join(REPO, 'benchmark_results', f'{stem}.json')
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        recs = json.load(f)
+    return recs[-1] if recs else None
+
+
+def gib(rec):
+    ma = rec.get('dist_memory_analysis') or {}
+    total = ma.get('total_bytes')
+    return f'{total / 2**30:.2f}' if total else 'n/a'
+
+
+def row(rec, base=None, pad=True):
+    if rec is None:
+        return None
+    ours = rec['dist_gflops_per_chip']
+    cells = [f"{rec['dist_time']:.4f}", f'{ours:,.0f}', gib(rec)]
+    if base:
+        b_gf, b_mem = base
+        cells += [f'{b_gf:,}', f'{b_mem:.2f}', f'{ours / b_gf:.1f}×']
+    elif pad:
+        cells += ['—', '—', '—']
+    return cells
+
+
+def table(title, header, rows):
+    print(f'\n### {title}\n')
+    print('| ' + ' | '.join(header) + ' |')
+    print('|' + '|'.join(['---'] * len(header)) + '|')
+    for label, cells in rows:
+        if cells is not None:
+            print('| ' + ' | '.join([label] + cells) + ' |')
+
+
+def main():
+    dev = None
+    for p in glob.glob(os.path.join(REPO, 'benchmark_results', '*.json')):
+        with open(p) as f:
+            recs = json.load(f)
+        if recs:
+            dev = recs[-1].get('device_kind')
+            break
+
+    print('# RESULTS — measured TPU benchmark corpus')
+    print(f"""
+All numbers measured on **one {dev or 'TPU'} chip** (the driver exposes a
+single chip; multi-chip correctness is exercised on the virtual 8-device
+CPU mesh and by `dryrun_multichip`). Method: `benchmark.py` per config via
+`scripts/run_sweeps.py`; timings block on device completion
+(`utils.tracing.time_fn` host-readback fence — the reference's timings
+never synchronized, BASELINE.md); memory is XLA's compiled buffer
+assignment (argument+output+temp bytes — the tunneled backend exposes no
+runtime stats). Reference baseline: 3× Quadro RTX 6000 (24 GB) fp32 over
+Horovod/NCCL, per-chip GFLOP/s from BASELINE.md. Our dtype is bf16 (the
+MXU-native choice — fp32 rows included where the (T,T) buffer fits one
+16 GiB chip). "ours/ref" compares per-chip throughput.
+
+Caveats: (a) sub-millisecond configs (scale=8 rows) sit at the resolution
+limit of the readback-fenced timer — treat rates above the 197 TF/s bf16
+device peak as timer floor, not physics; (b) the `mem GiB` column is the
+compiled footprint of the *timed* program, which reduces the op's output
+to a scalar — where XLA can fuse the whole pipeline into that reduction
+(nt with a single full gather / ring) the (T,T) product is never
+materialized and the footprint drops to the operands, which is a real
+property of compiled XLA programs, not an accounting trick.
+""")
+
+    hdr = ['config', 'time (s)', 'GFLOP/s/chip', 'mem GiB',
+           'ref GFLOP/s/chip', 'ref peak GiB', 'ours/ref']
+    table('nt (A·Bᵀ) — offset sweep, T=75000, d=768', hdr, [
+        *[(f'offset={o} bf16', row(load(f'nt_benchmark_{o}'),
+                                   BASE_NT_OFFSET.get(o)))
+          for o in (30, 750, 1000, 6250, 25000)],
+        ('offset=None (full gather) bf16', row(load('nt_benchmark_full'))),
+        ('impl=ring bf16', row(load('nt_benchmark_ring'))),
+    ])
+    table('nt — scale sweep (offset=1000)', hdr, [
+        *[(f'scale={s} (T={75000 // s}) bf16',
+           row(load(f'nt_benchmark_size_{s}'), BASE_NT_SIZE.get(s)))
+          for s in (1, 2, 4, 8)],
+        *[(f'scale={s} f32', row(load(f'nt_benchmark_f32_size_{s}'),
+                                 BASE_NT_SIZE.get(s)))
+          for s in (2, 4, 8)],
+    ])
+    table('all (A·B) — offset sweep, T=75000, d=768', hdr, [
+        *[(f'offset={o} bf16', row(load(f'all_benchmark_{o}'),
+                                   BASE_ALL_OFFSET.get(o)))
+          for o in (24, 48, 96, 192, 384, 768)],
+        ('offset=None (full gather) bf16', row(load('all_benchmark_full'))),
+        ('impl=ring bf16', row(load('all_benchmark_ring'))),
+    ])
+    table('all — scale sweep (offset=768)', hdr, [
+        *[(f'scale={s} bf16', row(load(f'all_benchmark_size_{s}'),
+                                  BASE_ALL_SIZE.get(s)))
+          for s in (1, 2, 4, 8)],
+        ('scale=2 f32', row(load('all_benchmark_f32_size_2'),
+                            BASE_ALL_SIZE.get(2))),
+    ])
+    table('tn (Aᵀ·B) — scale sweep', hdr, [
+        *[(f'scale={s} bf16', row(load(f'tn_benchmark_{s}'),
+                                  BASE_TN_SIZE.get(s)))
+          for s in (1, 2, 4, 8)],
+        ('scale=2 f32', row(load('tn_benchmark_f32_2'),
+                            BASE_TN_SIZE.get(2))),
+    ])
+
+    hdr_a = ['config', 'time (s)', 'GFLOP/s/chip', 'mem GiB']
+    table('attention op (H=8, d=64, softmax(q·kᵀ/√d)·v; no reference '
+          'analog — its module materializes full score rows)', hdr_a, [
+        *[(f'{impl} T=75000', row(load(f'attn_benchmark_{impl}'),
+                                  pad=False))
+          for impl in ('online', 'flash', 'flash_bounded')],
+        *[(f'{impl} T=18750', row(load(f'attn_benchmark_{impl}_size_4'),
+                                  pad=False))
+          for impl in ('full', 'online', 'flash', 'flash_bounded')],
+    ])
+
+    def trow(rec):
+        if rec is None:
+            return None
+        ma = rec.get('memory_analysis') or {}
+        temp = ma.get('temp_bytes')
+        return [f"{rec['step_time']:.4f}",
+                f"{rec['step_gflops_per_chip']:,.0f}",
+                f'{temp / 2**30:.2f}' if temp is not None else 'n/a']
+    print("""
+### Full train step (fwd + bwd + adam, one SPMD program; dim=768, H=8, bf16)
+
+The reference has no train-step analog (its example stops at
+`loss.backward()`, reference example.py:31-33). `temp GiB` is XLA's
+compiled temporary-buffer total — the training-memory story: the
+full/online softmax paths materialize (H, T/N, T) scores forward AND
+backward, flash recomputes blockwise from the saved row logsumexp.
+""")
+    print('| config | s/step | GFLOP/s/chip | temp GiB |')
+    print('|---|---|---|---|')
+    for label, stem in [
+            ('full T=8192', 'train_benchmark_full_8k'),
+            ('online T=8192', 'train_benchmark_online_8k'),
+            ('flash T=8192', 'train_benchmark_flash_8k'),
+            ('flash T=16384', 'train_benchmark_flash'),
+            ('flash_bounded T=16384', 'train_benchmark_flash_bounded'),
+            ('flash T=32768', 'train_benchmark_flash_32k')]:
+        cells = trow(load(stem))
+        if cells:
+            print('| ' + ' | '.join([label] + cells) + ' |')
+
+    print("""
+### Reading the numbers
+
+- **North star: beaten.** The driver baseline (BASELINE.json) asks ≥2× the
+  reference's best per-chip rate (2,287 GFLOP/s, nt offset=25000). The bf16
+  nt kernel at the same workload runs ~60× that on one v5e chip; even the
+  strict-fp32 runs at the scales that fit clear ~9×.
+- **The offset↔time trade survives the port, memory-side inverted by
+  design.** Larger offsets are faster here too (fewer, larger collectives →
+  fewer scan steps). The reference's memory grew with offset because each
+  `hvd.allgather` materialized a (W, *, offset, d) buffer per rank; our
+  compiled memory is dominated by the (T, T) operand/output, with the
+  gathered chunk a rounding error — the XLA totals are flat across offsets
+  (see nt rows). The knob still exists and still bounds gathered-operand
+  memory; it just no longer dominates at these shapes.
+- **Ring vs allgather (1 chip):** on a W=1 mesh the ring (and the
+  offset=None full gather) compile to ONE fused local matmul (~192 TF/s,
+  97% of bf16 peak), while the chunked-offset path pays for its `lax.scan`
+  structure (~142 TF/s) — the knob exists for multi-chip memory control,
+  and a W=1 chip shows its pure overhead. The variants only diverge on
+  real multi-chip ICI, which this driver cannot measure;
+  multi-device correctness of both paths is pinned by the 8-device
+  CPU-mesh tests (`tests/test_ops_grad.py`, parametrized over impl).
+- **Online/ring attention at T=75000 needs N>1 by design:** its score
+  memory is O((T/N)²) per step; at N=1 that is the full 180 GB (T,T) block,
+  so the scale=1 row is flash-only. At T=18750 (fits), online ≈ the full
+  path's rate on one chip — its win is *memory at scale-out*, not
+  single-chip speed; flash wins both (5.6× faster than full at T=18750,
+  ~86× less training temp memory at T=8192).
+- **Flash kernel at d=64**: exact-softmax ~76 TF/s at T=16K (the measured
+  matmul-only ceiling of the same grid is ~90; Google's splash-attention
+  kernel measures ~75 on this chip/shape). `softmax_mode='bounded'` trades
+  the running-max reduce for a norm bound (auto-falls back when unsafe) and
+  reaches ~85-90 TF/s. The VERDICT round-1 target of 100 TF/s at d=64
+  assumed nt-style full-MXU rates; at d=64 the score matmul runs the MXU at
+  half contraction depth, capping the theoretical mix at ~131 TF/s — the
+  kernel sits at ~95% of the chip's practical (0.72-efficiency) ceiling.
+""")
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -71,13 +71,32 @@ SWEEPS = [
     # --- attention op: full vs online(ring) vs flash vs flash_bounded ---
     # (no reference analog; T = 75000/scale, H=8, d=64.) 'full'
     # materializes (H, T/N, T) scores, so it only fits at larger scales.
+    # 'online' (ring) is absent at scale=1: on a W=1 mesh the ring
+    # degenerates to ONE local (H, T, T) score block — 180 GB at T=75000.
+    # Its O((T/N)²) memory story needs N>1; see RESULTS.md and the
+    # 8-device CPU-mesh coverage in tests/test_ring_attention.py.
     *[(f'attn_benchmark_{impl}', ['--mode', 'attn', '--attn-impl', impl,
                                   '--dtype', 'bf16', '--skip-local'])
-      for impl in ('online', 'flash', 'flash_bounded')],
+      for impl in ('flash', 'flash_bounded')],
     *[(f'attn_benchmark_{impl}_size_4',
        ['--mode', 'attn', '--attn-impl', impl, '--scale', '4',
         '--dtype', 'bf16', '--skip-local'])
       for impl in ('full', 'online', 'flash', 'flash_bounded')],
+    # --- full train step (fwd+bwd+adam as one SPMD program) ---
+    # 'full'/'online' materialize (H, T, T) scores FORWARD AND BACKWARD —
+    # they fit at T=8192 on 16 GiB; flash scales on (T=32768 included as
+    # the memory-scaling point).
+    *[(f'train_benchmark_{impl}',
+       ['--mode', 'train', '--attn-impl', impl, '--dtype', 'bf16',
+        '--seq-len', '16384'])
+      for impl in ('flash', 'flash_bounded')],
+    *[(f'train_benchmark_{impl}_8k',
+       ['--mode', 'train', '--attn-impl', impl, '--dtype', 'bf16',
+        '--seq-len', '8192'])
+      for impl in ('full', 'online', 'flash')],
+    ('train_benchmark_flash_32k',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '32768']),
 ]
 
 

@@ -28,7 +28,12 @@ _REPO = os.path.dirname(_HERE)
 
 @pytest.mark.slow
 def test_two_process_mesh_matches_single_process():
-    port = 29371
+    # Ephemeral port: bind-and-release so concurrent runs don't collide on
+    # a fixed coordinator address.
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
     env = {k: v for k, v in os.environ.items()
            if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
     env['PYTHONPATH'] = _REPO + os.pathsep + env.get('PYTHONPATH', '')

@@ -26,6 +26,39 @@ from distributed_dot_product_tpu.parallel.mesh import seq_mesh
 pytestmark = pytest.mark.slow  # Pallas-interpreter / lax.scan-heavy cases
 
 
+def test_causal_union_empty_row_zero_across_impls():
+    """A row emptied only by the UNION of user mask and causality must be 0
+    with zero gradients in ring, local-reference AND flash paths — the
+    softmax impls must agree on inputs like this."""
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    t, row, dh = 16, 5, 8
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (jax.random.normal(kk, (2, t, dh), jnp.float32) for kk in ks)
+    m = jnp.zeros((2, t, t), dtype=bool).at[:, row, :row + 1].set(True)
+
+    local = local_attention_reference(q, k, v, m, causal=True)
+    flash = flash_attention(q, k, v, m, causal=True)
+    mesh4 = seq_mesh(4)
+    ring = jax.shard_map(
+        lambda q, k, v, m: ring_attention(q, k, v, m, causal=True),
+        mesh=mesh4,
+        in_specs=(P(None, 'seq', None),) * 3 + (P(None, 'seq', None),),
+        out_specs=P(None, 'seq', None), check_vma=False,
+    )(q, k, v, m)
+
+    for name, out in [('local', local), ('flash', flash), ('ring', ring)]:
+        assert (np.asarray(out)[:, row] == 0).all(), name
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(local),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(local),
+                               atol=1e-5, rtol=1e-5)
+    g = jax.grad(lambda v: jnp.sum(local_attention_reference(
+        q, k, v, m, causal=True) ** 2))(v)
+    assert np.isfinite(np.asarray(g)).all()
+
+
 WORLD = 4
 TN = 6
 T = WORLD * TN
