@@ -50,7 +50,8 @@ def parse_args():
     parser.add_argument('--seq-len', type=int, default=16384,
                         help='global sequence length (train mode)')
     parser.add_argument('--attn-impl',
-                        choices=['full', 'online', 'flash', 'flash_bounded'],
+                        choices=['full', 'online', 'flash', 'flash_bounded',
+                                 'ulysses'],
                         default='flash',
                         help='attention softmax/fusion path (attn mode)')
     parser.add_argument('--heads', type=int, default=8,
@@ -155,6 +156,11 @@ def run_attn(args):
     # the recorded attn_impl always names the code path actually measured.
     if args.attn_impl == 'online':
         body = lambda q, k, v: ring_attention(q, k, v)  # noqa: E731
+    elif args.attn_impl == 'ulysses':
+        from distributed_dot_product_tpu.models.ulysses_attention import (
+            ulysses_attention,
+        )
+        body = lambda q, k, v: ulysses_attention(q, k, v)  # noqa: E731
     elif args.attn_impl in ('flash', 'flash_bounded'):
         smode = 'bounded' if args.attn_impl == 'flash_bounded' else 'exact'
 

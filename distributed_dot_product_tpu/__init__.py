@@ -52,6 +52,9 @@ from distributed_dot_product_tpu.models.attention import (  # noqa: F401
 from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,
 )
+from distributed_dot_product_tpu.models.ulysses_attention import (  # noqa: F401
+    ulysses_attention,
+)
 from distributed_dot_product_tpu.ops.pallas_attention import (  # noqa: F401
     flash_attention,
 )

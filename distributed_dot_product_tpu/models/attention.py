@@ -48,6 +48,9 @@ from jax.sharding import PartitionSpec as P
 from distributed_dot_product_tpu.models.ring_attention import (
     local_attention_reference, ring_attention,
 )
+from distributed_dot_product_tpu.models.ulysses_attention import (
+    ulysses_attention,
+)
 from distributed_dot_product_tpu.ops.pallas_attention import flash_attention
 from distributed_dot_product_tpu.ops.ops import matmul_all, matmul_nt
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
@@ -77,7 +80,8 @@ class DistributedDotProductAttn(nn.Module):
     distributed: bool = True
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
-    softmax_impl: str = 'full'   # 'full' (parity) | 'online' | 'flash'
+    # 'full' (parity) | 'online' (ring) | 'flash' | 'ulysses'
+    softmax_impl: str = 'full'
     # For softmax_impl='flash': 'exact' running-max softmax, or 'bounded'
     # (norm-bound shift — faster at small head dim; see
     # ops.pallas_attention.flash_attention for the accuracy contract).
@@ -90,10 +94,10 @@ class DistributedDotProductAttn(nn.Module):
             raise ValueError(
                 f'key_dim {self.key_dim} must be divisible by num_heads '
                 f'{self.num_heads} (reference module.py:29)')
-        if self.softmax_impl not in ('full', 'online', 'flash'):
+        if self.softmax_impl not in ('full', 'online', 'flash', 'ulysses'):
             raise ValueError(
-                f"softmax_impl must be 'full', 'online' or 'flash', got "
-                f"{self.softmax_impl!r}")
+                f"softmax_impl must be 'full', 'online', 'flash' or "
+                f"'ulysses', got {self.softmax_impl!r}")
         if self.impl not in ('allgather', 'ring'):
             raise ValueError(
                 f"impl must be 'allgather' or 'ring', got {self.impl!r}")
@@ -137,7 +141,15 @@ class DistributedDotProductAttn(nn.Module):
         # use the local math path so plain ``model.init(...)`` works.
         distributed = self.distributed and not self.is_initializing()
 
-        if self.softmax_impl == 'flash':
+        softmax_impl = self.softmax_impl
+        if softmax_impl == 'ulysses' and not (distributed
+                                              and self.num_heads > 1):
+            # No head axis to scatter (single head) or the local oracle
+            # branch: the math is identical through the flash path — route
+            # there instead of duplicating it.
+            softmax_impl = 'flash'
+
+        if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
             # the gathered axis (reference module.py:61,67) is standard
             # attention with q := keys, k := queries, v := values.
@@ -166,7 +178,22 @@ class DistributedDotProductAttn(nn.Module):
                                           self._value_dim)
             return self.composition(outputs)
 
-        if self.softmax_impl == 'online':
+        if softmax_impl == 'ulysses':
+            # Head all-to-all path (distributed, num_heads > 1 guaranteed
+            # by the resolution above): heads↔time re-sharding, then the
+            # fused flash kernel locally over the FULL sequence for H/N
+            # heads (see models/ulysses_attention.py). Same q:=keys
+            # convention as the flash path.
+            scale = 1.0 / math.sqrt(self.head_dim)
+            outputs = ulysses_attention(
+                keys, queries, values, attn_mask,
+                axis_name=self.axis_name, scale=scale,
+                softmax_mode=self.flash_softmax_mode)
+            outputs = jnp.swapaxes(outputs, -3, -2)
+            outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
+            return self.composition(outputs)
+
+        if softmax_impl == 'online':
             # Long-context path: ring attention with online softmax — the
             # module's K-first scoring + softmax over the gathered axis
             # (reference module.py:61,67) is standard attention with

@@ -142,10 +142,11 @@ property of compiled XLA programs, not an accounting trick.
           'analog — its module materializes full score rows)', hdr_a, [
         *[(f'{impl} T=75000', row(load(f'attn_benchmark_{impl}'),
                                   pad=False))
-          for impl in ('online', 'flash', 'flash_bounded')],
+          for impl in ('online', 'flash', 'flash_bounded', 'ulysses')],
         *[(f'{impl} T=18750', row(load(f'attn_benchmark_{impl}_size_4'),
                                   pad=False))
-          for impl in ('full', 'online', 'flash', 'flash_bounded')],
+          for impl in ('full', 'online', 'flash', 'flash_bounded',
+                       'ulysses')],
     ])
 
     def trow(rec):

@@ -77,11 +77,11 @@ SWEEPS = [
     # 8-device CPU-mesh coverage in tests/test_ring_attention.py.
     *[(f'attn_benchmark_{impl}', ['--mode', 'attn', '--attn-impl', impl,
                                   '--dtype', 'bf16', '--skip-local'])
-      for impl in ('flash', 'flash_bounded')],
+      for impl in ('flash', 'flash_bounded', 'ulysses')],
     *[(f'attn_benchmark_{impl}_size_4',
        ['--mode', 'attn', '--attn-impl', impl, '--scale', '4',
         '--dtype', 'bf16', '--skip-local'])
-      for impl in ('full', 'online', 'flash', 'flash_bounded')],
+      for impl in ('full', 'online', 'flash', 'flash_bounded', 'ulysses')],
     # --- full train step (fwd+bwd+adam as one SPMD program) ---
     # 'full'/'online' materialize (H, T, T) scores FORWARD AND BACKWARD —
     # they fit at T=8192 on 16 GiB; flash scales on (T=32768 included as

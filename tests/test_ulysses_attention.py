@@ -1,0 +1,169 @@
+# -*- coding: utf-8 -*-
+"""
+Ulysses (head all-to-all) sequence-parallelism tests.
+
+No reference analog (SURVEY §2.2: "Ulysses: No. Heads stay local; no
+all-to-all anywhere"). Oracle strategy as everywhere in this suite: the
+unsharded local computation on full arrays is ground truth; the all-to-all
+re-sharded path over a shard_map mesh must match to fp32 tolerance,
+including gradients, masks and causality.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_dot_product_tpu.models.attention import (
+    DistributedDotProductAttn, apply_seq_parallel,
+)
+from distributed_dot_product_tpu.models.ring_attention import (
+    local_attention_reference,
+)
+from distributed_dot_product_tpu.models.ulysses_attention import (
+    ulysses_attention,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+
+WORLD = 4
+TN = 8
+T = WORLD * TN
+HEADS = 8           # divisible by WORLD
+DH = 16
+BATCH = 2
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return seq_mesh(WORLD)
+
+
+def _qkv(dv=DH):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (BATCH, HEADS, T, DH), jnp.float32)
+    k = jax.random.normal(ks[1], (BATCH, HEADS, T, DH), jnp.float32)
+    v = jax.random.normal(ks[2], (BATCH, HEADS, T, dv), jnp.float32)
+    return q, k, v
+
+
+def _sharded_ulysses(mesh, causal=False, with_mask=False):
+    spec = P(None, None, 'seq', None)
+    mspec = P(None, None, 'seq', None)
+
+    def fn(q, k, v, m):
+        return ulysses_attention(q, k, v, m, causal=causal)
+
+    def call(q, k, v, m):
+        in_specs = (spec, spec, spec, mspec)
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=spec, check_vma=False)(q, k, v, m)
+    return call
+
+
+def _mask():
+    m = jax.random.bernoulli(jax.random.key(7), 0.3, (BATCH, 1, T, T))
+    return m.at[..., 0].set(False)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_forward_matches_oracle(mesh, causal):
+    q, k, v = _qkv(dv=12)   # d_v != d
+    m = _mask()
+    want = local_attention_reference(q, k, v, m, causal=causal)
+    got = _sharded_ulysses(mesh, causal=causal)(q, k, v, m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gradients_match_oracle(mesh):
+    q, k, v = _qkv()
+    m = _mask()
+
+    def loss_dist(q, k, v):
+        return jnp.sum(_sharded_ulysses(mesh)(q, k, v, m) ** 2)
+
+    def loss_local(q, k, v):
+        return jnp.sum(local_attention_reference(q, k, v, m) ** 2)
+
+    g1 = jax.grad(loss_dist, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_local, (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_rank_mismatched_mask_rejected(mesh):
+    """A mask without the explicit size-1 head axis would silently
+    broadcast its batch dim against the head axis after the all_to_all —
+    it must be rejected, not mis-broadcast."""
+    q, k, v = _qkv()
+    m3 = jnp.zeros((BATCH, T, T), dtype=bool)   # no head axis
+    spec = P(None, None, 'seq', None)
+    with pytest.raises(ValueError, match='same rank'):
+        jax.shard_map(
+            lambda q, k, v, m: ulysses_attention(q, k, v, m),
+            mesh=mesh, in_specs=(spec, spec, spec, P(None, 'seq', None)),
+            out_specs=spec, check_vma=False)(q, k, v, m3)
+    m_perhead = jnp.zeros((BATCH, HEADS, T, T), dtype=bool)
+    with pytest.raises(ValueError, match='head-broadcast'):
+        jax.shard_map(
+            lambda q, k, v, m: ulysses_attention(q, k, v, m),
+            mesh=mesh, in_specs=(spec, spec, spec, spec),
+            out_specs=spec, check_vma=False)(q, k, v, m_perhead)
+
+
+def test_heads_not_divisible_rejected(mesh):
+    q, k, v = _qkv()
+    q = q[:, :WORLD + 1]    # 5 heads on a 4-wide mesh
+    k, v = k[:, :WORLD + 1], v[:, :WORLD + 1]
+    with pytest.raises(ValueError, match='divisible'):
+        _sharded_ulysses(mesh)(q, k, v, None)
+
+
+def test_module_ulysses_impl_matches_local_oracle(mesh):
+    """DistributedDotProductAttn(softmax_impl='ulysses') inside shard_map ==
+    the distributed=False oracle, through projections, the K-first scoring
+    convention, multi-head split and mask broadcast."""
+    t, dim, heads = T, 32, HEADS
+    kw = dict(key_dim=dim, num_heads=heads, offset=2)
+    dist = DistributedDotProductAttn(softmax_impl='ulysses', **kw)
+    local = DistributedDotProductAttn(distributed=False, **kw)
+
+    x = jax.random.normal(jax.random.key(0), (BATCH, t, dim))
+    m = jax.random.bernoulli(jax.random.key(1), 0.3, (BATCH, t, t))
+    m = m.at[..., 0].set(False)
+    params = local.init(jax.random.key(2), x, x, x, m)
+
+    expected = local.apply(params, x, x, x, m)
+    got = apply_seq_parallel(dist, params, mesh, x, x, x, m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=1e-5, rtol=1e-5)
+
+    # gradients through the module path too
+    def loss(mod):
+        if mod is local:
+            return lambda p: jnp.sum(local.apply(p, x, x, x, m) ** 2)
+        return lambda p: jnp.sum(
+            apply_seq_parallel(mod, p, mesh, x, x, x, m) ** 2)
+    g_d = jax.grad(loss(dist))(params)
+    g_l = jax.grad(loss(local))(params)
+    for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_l)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_module_ulysses_single_head_falls_back(mesh):
+    """num_heads=1 has no head axis to scatter — the module must route
+    through the gathered flash path and still match the oracle."""
+    t, dim = T, 16
+    kw = dict(key_dim=dim, num_heads=1, offset=2)
+    dist = DistributedDotProductAttn(softmax_impl='ulysses', **kw)
+    local = DistributedDotProductAttn(distributed=False, **kw)
+    x = jax.random.normal(jax.random.key(0), (BATCH, t, dim))
+    m = jnp.zeros((BATCH, t, t), dtype=bool)
+    params = local.init(jax.random.key(2), x, x, x, m)
+    expected = local.apply(params, x, x, x, m)
+    got = apply_seq_parallel(dist, params, mesh, x, x, x, m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=1e-5, rtol=1e-5)
