@@ -1,0 +1,145 @@
+# -*- coding: utf-8 -*-
+"""
+Checkpoint / resume for sharded training state (orbax-backed).
+
+The reference has NO checkpoint subsystem — its only use of ``state_dict``
+is the rank-0 weight broadcast inside a test (SURVEY §5 "Checkpoint /
+resume: none"; reference test_gradient.py:48), so a crashed multi-day run
+restarts from scratch. This module closes that gap the TPU-native way:
+`orbax.checkpoint` writes each device's shards in parallel (OCDBT), works
+unchanged on one host or a multi-host pod (every process calls
+``save``/``restore`` collectively), and restores arrays onto whatever
+sharding the provided template carries — so a checkpoint taken on one mesh
+can resume on another.
+
+Durability: orbax finalizes a checkpoint only after all shards land
+(rename on POSIX, commit marker on object stores); ``latest_step`` asks
+orbax whether a step directory is finalized, so a crash mid-save is never
+selected for restore. Overwriting an existing step keeps the old
+checkpoint as ``step_N.replaced`` until the new one is finalized.
+
+Usage::
+
+    state = TrainState(step=0, params=params, opt_state=opt_state)
+    save(ckpt_dir, state)                       # atomic, collective
+    state = restore(ckpt_dir, state)            # template = like-shaped state
+    step = latest_step(ckpt_dir)                # None if no checkpoint
+"""
+
+import os
+import shutil
+from typing import Any, NamedTuple, Optional
+
+import jax
+
+from distributed_dot_product_tpu.utils.comm import synchronize
+
+__all__ = ['TrainState', 'save', 'restore', 'latest_step']
+
+
+class TrainState(NamedTuple):
+    """Minimal training state: a step counter plus arbitrary pytrees.
+
+    A NamedTuple (not a dataclass) so it is a pytree out of the box and
+    orbax round-trips it without custom registration.
+    """
+    step: int
+    params: Any
+    opt_state: Any
+
+
+_CKPTR = None
+
+
+def _checkpointer():
+    # One long-lived checkpointer: StandardCheckpointer owns async-write
+    # machinery (threads), so constructing one per call would leak it
+    # across a training loop.
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def _step_dir(path, step):
+    return os.path.join(os.fspath(path), f'step_{step:09d}')
+
+
+def _is_finalized(path):
+    try:
+        from orbax.checkpoint import utils as ocp_utils
+        return bool(ocp_utils.is_checkpoint_finalized(path))
+    except Exception:
+        # Conservative fallback: orbax temp dirs carry a suffix after the
+        # final name; a plain step dir we cannot interrogate is assumed
+        # finalized (matches pre-commit-marker orbax on POSIX renames).
+        return True
+
+
+def save(path, state: TrainState, *, force: bool = True) -> str:
+    """Write ``state`` under ``path/step_<step>/``; returns that directory.
+
+    Atomic: orbax writes to a temporary name and finalizes it afterwards.
+    If the step already exists and ``force`` is set, the old checkpoint is
+    kept as ``step_<step>.replaced`` until the new write finalizes, so a
+    crash mid-overwrite never destroys the only copy of a step.
+
+    Collective on multi-host: every process must call this with its view
+    of the same global arrays (directory juggling runs on process 0 only).
+    """
+    target = _step_dir(path, int(state.step))
+    backup = target + '.replaced'
+    exists = os.path.isdir(target)
+    if exists and not force:
+        raise FileExistsError(
+            f'{target} already exists; pass force=True to replace it')
+    if exists and jax.process_index() == 0:
+        if os.path.isdir(backup):
+            shutil.rmtree(backup)
+        os.rename(target, backup)
+    synchronize()
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(target), state)
+    ckptr.wait_until_finished()
+    synchronize()
+    if exists and jax.process_index() == 0 and os.path.isdir(backup):
+        shutil.rmtree(backup)
+    return target
+
+
+def latest_step(path) -> Optional[int]:
+    """Highest step with a FINALIZED checkpoint under ``path``, or None —
+    a crash mid-save leaves an unfinalized directory, which is skipped."""
+    path = os.fspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for name in os.listdir(path):
+        if not name.startswith('step_') or name.endswith('.replaced'):
+            continue
+        try:
+            step = int(name[len('step_'):])
+        except ValueError:
+            continue
+        if _is_finalized(os.path.join(path, name)):
+            steps.append(step)
+    return max(steps) if steps else None
+
+
+def restore(path, template: TrainState, *, step: Optional[int] = None
+            ) -> TrainState:
+    """Restore the checkpoint at ``step`` (default: latest finalized)
+    using ``template`` for structure/shardings: every restored array
+    adopts the sharding of the corresponding template leaf, so resuming
+    on a different mesh layout re-shards transparently.
+    """
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f'no checkpoint under {path!r}')
+    target = os.path.abspath(_step_dir(path, step))
+    restored = _checkpointer().restore(target, template)
+    # orbax returns the same pytree type; ensure the step is a python int
+    # (templates often carry traced/array steps).
+    return restored._replace(step=int(jax.device_get(restored.step)))

@@ -1,0 +1,111 @@
+# -*- coding: utf-8 -*-
+"""
+Checkpoint / resume tests.
+
+No reference analog (SURVEY §5: the reference has no checkpoint subsystem
+at all). The contract tested: interrupting a training run, restoring from
+disk, and continuing must produce exactly the losses of the uninterrupted
+run — including the optimizer state (adam moments), which is where naive
+params-only checkpointing silently diverges.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_dot_product_tpu import DistributedDotProductAttn
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+from distributed_dot_product_tpu.utils.checkpoint import (
+    TrainState, latest_step, restore, save,
+)
+
+
+def _setup():
+    mesh = seq_mesh(8)
+    dim, heads, t, b = 32, 4, 16, 2
+    model = DistributedDotProductAttn(key_dim=dim, num_heads=heads, offset=2)
+    x = jax.random.normal(jax.random.key(0), (b, t, dim), jnp.float32)
+    target = jax.random.normal(jax.random.key(1), (b, t, dim), jnp.float32)
+    mask = jnp.zeros((b, t, t), dtype=bool)
+    params = model.init(jax.random.key(2), x, x, x, mask)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    step = make_train_step(model, optimizer, mesh, donate=False)
+    return step, params, opt_state, (x, x, x, mask, target)
+
+
+def test_resume_reproduces_uninterrupted_run(tmp_path):
+    step, params, opt_state, batch = _setup()
+
+    # Uninterrupted: 4 steps.
+    p, o = params, opt_state
+    losses = []
+    for _ in range(4):
+        p, o, loss = step(p, o, batch)
+        losses.append(float(loss))
+
+    # Interrupted: 2 steps, checkpoint, "crash", restore, 2 more.
+    p, o = params, opt_state
+    for i in range(2):
+        p, o, _ = step(p, o, batch)
+    save(tmp_path, TrainState(step=2, params=p, opt_state=o))
+    assert latest_step(tmp_path) == 2
+
+    template = TrainState(step=0, params=p, opt_state=o)
+    restored = restore(tmp_path, template)
+    assert restored.step == 2
+    p2, o2 = restored.params, restored.opt_state
+    resumed = []
+    for _ in range(2):
+        p2, o2, loss = step(p2, o2, batch)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, losses[2:], rtol=1e-6)
+
+
+def test_restored_arrays_bitwise_equal(tmp_path):
+    step, params, opt_state, batch = _setup()
+    p, o, _ = step(params, opt_state, batch)
+    save(tmp_path, TrainState(step=1, params=p, opt_state=o))
+    restored = restore(tmp_path, TrainState(step=0, params=p, opt_state=o))
+    # Params AND optimizer state (adam moments are where naive
+    # checkpointing silently diverges — the module's stated contract).
+    for got, want in ((restored.params, p), (restored.opt_state, o)):
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_resave_same_step_keeps_backup_until_finalized(tmp_path):
+    """Overwriting an existing step must not destroy the old checkpoint
+    before the new one is finalized (crash-safety of force=True)."""
+    _, params, opt_state, _ = _setup()
+    save(tmp_path, TrainState(step=2, params=params, opt_state=opt_state))
+    save(tmp_path, TrainState(step=2, params=params, opt_state=opt_state))
+    assert latest_step(tmp_path) == 2
+    restored = restore(tmp_path, TrainState(0, params, opt_state))
+    assert restored.step == 2
+    import os
+    assert not os.path.isdir(str(tmp_path / 'step_000000002.replaced'))
+    with pytest.raises(FileExistsError):
+        save(tmp_path, TrainState(step=2, params=params,
+                                  opt_state=opt_state), force=False)
+
+
+def test_restore_without_checkpoint_raises(tmp_path):
+    _, params, opt_state, _ = _setup()
+    with pytest.raises(FileNotFoundError):
+        restore(tmp_path / 'empty', TrainState(0, params, opt_state))
+
+
+def test_multiple_steps_latest_wins(tmp_path):
+    _, params, opt_state, _ = _setup()
+    for s in (1, 5, 3):
+        save(tmp_path, TrainState(step=s, params=params,
+                                  opt_state=opt_state))
+    assert latest_step(tmp_path) == 5
+    assert restore(tmp_path,
+                   TrainState(0, params, opt_state)).step == 5
+    assert restore(tmp_path, TrainState(0, params, opt_state),
+                   step=3).step == 3
