@@ -77,6 +77,12 @@ class DistributedDotProductAttn(nn.Module):
     num_heads: int = 1
     add_bias: bool = False
     offset: int = 32
+    # Causal (autoregressive) masking over GLOBAL positions: output row i
+    # only mixes positions j <= i. The reference has no causal flag (users
+    # must encode the triangle into attn_mask, O(T²/N) per shard anyway);
+    # this derives it from the shard's global offset and ORs it into the
+    # mask, so it works identically in every softmax_impl.
+    causal: bool = False
     distributed: bool = True
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
@@ -149,6 +155,26 @@ class DistributedDotProductAttn(nn.Module):
             # there instead of duplicating it.
             softmax_impl = 'flash'
 
+        # Causal handling: ring/ulysses (and local flash) take causal=True
+        # natively — the kernels skip whole future blocks and need no
+        # materialized triangle. Only the 'full' path and the DISTRIBUTED
+        # flash path (whose kernel sees local key rows with a global offset
+        # it cannot express) densify causality into the mask.
+        native_causal = self.causal and softmax_impl in ('online', 'ulysses')
+        if softmax_impl == 'flash' and not distributed:
+            native_causal = self.causal
+        if self.causal and not native_causal:
+            # Rows of the score block are this shard's GLOBAL positions
+            # (idx·T/N + local row); columns are global already. In the
+            # K-first convention scores[i, j] = k_i·q_j with softmax over
+            # j, so "causal" is the same j <= i triangle.
+            tn = keys.shape[-2]
+            t_global = attn_mask.shape[-1]
+            idx = jax.lax.axis_index(self.axis_name) if distributed else 0
+            rows = idx * tn + jnp.arange(tn)
+            future = rows[:, None] < jnp.arange(t_global)[None, :]
+            attn_mask = jnp.logical_or(attn_mask, future)
+
         if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
             # the gathered axis (reference module.py:61,67) is standard
@@ -170,7 +196,7 @@ class DistributedDotProductAttn(nn.Module):
             else:
                 q_full, v_full = queries, values
             outputs = flash_attention(keys, q_full, v_full, attn_mask,
-                                      scale=scale,
+                                      scale=scale, causal=native_causal,
                                       softmax_mode=self.flash_softmax_mode)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
@@ -188,6 +214,7 @@ class DistributedDotProductAttn(nn.Module):
             outputs = ulysses_attention(
                 keys, queries, values, attn_mask,
                 axis_name=self.axis_name, scale=scale,
+                causal=native_causal,
                 softmax_mode=self.flash_softmax_mode)
             outputs = jnp.swapaxes(outputs, -3, -2)
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
@@ -204,10 +231,12 @@ class DistributedDotProductAttn(nn.Module):
             if distributed:
                 outputs = ring_attention(
                     keys, queries, values, attn_mask,
-                    axis_name=self.axis_name, scale=scale)
+                    axis_name=self.axis_name, scale=scale,
+                    causal=native_causal)
             else:
                 outputs = local_attention_reference(
-                    keys, queries, values, attn_mask, scale=scale)
+                    keys, queries, values, attn_mask, scale=scale,
+                    causal=native_causal)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],

@@ -111,3 +111,38 @@ def test_bad_head_split_raises():
         DistributedDotProductAttn(key_dim=10, num_heads=4).init(
             jax.random.key(0), *(jnp.zeros((1, 4, 10)),) * 3,
             jnp.zeros((1, 4, 4), bool))
+
+
+@pytest.mark.parametrize('softmax_impl', ['full', 'online', 'flash',
+                                          'ulysses'])
+def test_causal_parity_across_impls(mesh, softmax_impl):
+    """causal=True must produce identical outputs in every softmax_impl,
+    matching the distributed=False oracle — the causal triangle is over
+    GLOBAL positions, so shard offsets must be accounted for."""
+    num_heads = 4
+    kwargs = dict(key_dim=KEY_DIM, value_dim=VALUE_DIM, query_dim=QUERY_DIM,
+                  num_heads=num_heads, causal=True, offset=2)
+    dist = DistributedDotProductAttn(softmax_impl=softmax_impl, **kwargs)
+    local = DistributedDotProductAttn(distributed=False, **kwargs)
+    k, q, v, m = _inputs(masked=True)
+    params = local.init(jax.random.key(42), k, q, v, m)
+    out_local = local.apply(params, k, q, v, m)
+    out_dist = apply_seq_parallel(dist, params, mesh, k, q, v, m)
+    np.testing.assert_allclose(np.asarray(out_dist), np.asarray(out_local),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_causal_first_row_ignores_future(mesh):
+    """With causal=True and no user mask, output row 0 must equal the
+    attention over position 0 alone — i.e. v_0 through the projections."""
+    kwargs = dict(key_dim=KEY_DIM, value_dim=VALUE_DIM, query_dim=QUERY_DIM,
+                  causal=True)
+    local = DistributedDotProductAttn(distributed=False, **kwargs)
+    k, q, v, m = _inputs(masked=False)
+    params = local.init(jax.random.key(42), k, q, v, m)
+    out = local.apply(params, k, q, v, m)
+    # row 0 attends only to col 0 -> context = values_proj(v)[..., 0, :]
+    vproj = local.bind(params).values_proj(v)[:, 0]
+    comp = local.bind(params).composition(vproj)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(comp),
+                               rtol=1e-5, atol=1e-5)
