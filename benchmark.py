@@ -49,6 +49,10 @@ def parse_args():
                         default='nt')
     parser.add_argument('--seq-len', type=int, default=16384,
                         help='global sequence length (train mode)')
+    parser.add_argument('--no-mask', action='store_true',
+                        help='train mode: attn_mask=None — drops the only '
+                             'O(T^2) input on the flash path (long-context '
+                             'configuration)')
     parser.add_argument('--attn-impl',
                         choices=['full', 'online', 'flash', 'flash_bounded',
                                  'ulysses'],
@@ -254,8 +258,9 @@ def run_train(args):
     act = NamedSharding(mesh, P(None, SEQ_AXIS, None))
     x = jax.device_put(x_host, act)
     target = jax.device_put(target_host, act)
-    mask = jax.device_put(jnp.zeros((1, t, t), dtype=bool),
-                          NamedSharding(mesh, P(None, SEQ_AXIS, None)))
+    mask = None if args.no_mask else jax.device_put(
+        jnp.zeros((1, t, t), dtype=bool),
+        NamedSharding(mesh, P(None, SEQ_AXIS, None)))
 
     # Init at a tiny T: parameter shapes depend only on DIM, and a
     # full-length init forward would cost an extra whole-T compile per
@@ -279,6 +284,7 @@ def run_train(args):
     record = {
         'mode': 'train', 'attn_impl': args.attn_impl, 'T': t, 'dim': DIM,
         'heads': heads, 'world': world, 'dtype': args.dtype,
+        'mask': not args.no_mask,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'step_time': best, 'step_time_mean': mean,

@@ -126,7 +126,13 @@ class DistributedDotProductAttn(nn.Module):
         self.values_proj = dense(value_dim, 'values')
         self.composition = dense(value_dim, 'composition')
 
-    def __call__(self, keys, queries, values, attn_mask):
+    def __call__(self, keys, queries, values, attn_mask=None):
+        # ``attn_mask=None`` means "no masking" — an extension over the
+        # reference (whose example passes an all-False mask,
+        # example.py:29). It matters at long context: the mask is the only
+        # O(T²) input left on the flash/ulysses/ring paths, so dropping it
+        # (or using causal=True, handled blockwise in-kernel) is what lets
+        # one chip train at T in the hundreds of thousands.
         keys = self.keys_proj(keys)
         queries = self.queries_proj(queries)
         values = self.values_proj(values)
@@ -140,7 +146,8 @@ class DistributedDotProductAttn(nn.Module):
             keys = split(keys, self.head_dim)
             queries = split(queries, self.head_dim)
             values = split(values, self._value_dim // self.num_heads)
-            attn_mask = attn_mask[..., None, :, :]
+            if attn_mask is not None:
+                attn_mask = attn_mask[..., None, :, :]
 
         # During flax init the body runs outside any shard_map (no mesh axis
         # bound), and parameter shapes don't depend on the comm pattern —
@@ -169,11 +176,17 @@ class DistributedDotProductAttn(nn.Module):
             # K-first convention scores[i, j] = k_i·q_j with softmax over
             # j, so "causal" is the same j <= i triangle.
             tn = keys.shape[-2]
-            t_global = attn_mask.shape[-1]
-            idx = jax.lax.axis_index(self.axis_name) if distributed else 0
+            if distributed:
+                idx = jax.lax.axis_index(self.axis_name)
+                world = jax.lax.psum(1, self.axis_name)
+            else:
+                idx, world = 0, 1
+            t_global = (attn_mask.shape[-1] if attn_mask is not None
+                        else tn * world)
             rows = idx * tn + jnp.arange(tn)
             future = rows[:, None] < jnp.arange(t_global)[None, :]
-            attn_mask = jnp.logical_or(attn_mask, future)
+            attn_mask = (future if attn_mask is None
+                         else jnp.logical_or(attn_mask, future))
 
         if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
@@ -251,8 +264,9 @@ class DistributedDotProductAttn(nn.Module):
         # K-first convention kept (reference module.py:60-62): row i of
         # `scores` is key_i against every query.
         scores = scores / math.sqrt(self.head_dim)
-        big_neg = jnp.asarray(-jnp.inf, dtype=scores.dtype)
-        scores = jnp.where(attn_mask, big_neg, scores)
+        if attn_mask is not None:
+            big_neg = jnp.asarray(-jnp.inf, dtype=scores.dtype)
+            scores = jnp.where(attn_mask, big_neg, scores)
         attn = jax.nn.softmax(scores, axis=-1)
         if distributed:
             outputs = matmul_all(attn, values, self.offset,
@@ -266,7 +280,7 @@ class DistributedDotProductAttn(nn.Module):
 
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,
-                       attn_mask, mesh_axis=None):
+                       attn_mask=None, mesh_axis=None):
     """Apply a :class:`DistributedDotProductAttn` to **global** arrays on a
     mesh: params replicated (``P()``), activations sharded on the time axis
     (``P(None, 'seq', None)``).
