@@ -90,6 +90,17 @@ def main():
     attn_b_best, _ = time_fn(fb, q, k, v, iters=3)
     attn_b_gflops = 4.0 * h * t_attn * t_attn * d / attn_b_best / 1e9
 
+    # Whole training step (fwd+bwd+adam, flash path, mask-free) at the
+    # long-context shape — the integration-level rate (RESULTS.md). Reuses
+    # benchmark.measure_train_step so the setup/FLOP accounting can't
+    # drift from the committed corpus records.
+    train_gflops = train_t = None
+    if platform == 'tpu':
+        from benchmark import measure_train_step
+        rec = measure_train_step(seq_len=16384, attn_impl='flash',
+                                 dtype='bf16', no_mask=True, iters=3)
+        train_gflops, train_t = rec['step_gflops_per_chip'], rec['T']
+
     print(json.dumps({
         'metric': 'nt_gflops_per_chip',
         'value': round(gflops_bf16, 1),
@@ -104,6 +115,9 @@ def main():
             'flash_attn_gflops': round(attn_gflops, 1),
             'flash_attn_bounded_gflops': round(attn_b_gflops, 1),
             'flash_attn_T': t_attn, 'flash_attn_time_s': round(attn_best, 4),
+            'train_step_gflops': (round(train_gflops, 1)
+                                  if train_gflops else None),
+            'train_step_T': train_t,
             'world': world, 'platform': platform,
             'baseline': 'reference nt offset=25000, 3x RTX6000/NCCL, '
                         '2287 GFLOP/s/chip (BASELINE.md)',

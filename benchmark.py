@@ -225,13 +225,16 @@ def _memory_analysis(compiled):
         return None
 
 
-def run_train(args):
-    """Full training-step benchmark: forward, loss, gradient psum, optax
-    update as ONE compiled SPMD program (``train.make_train_step``) at the
-    example workload scaled up (reference example.py runs T=4096, dim 768,
-    heads 2 with no optimizer; here T defaults to 16384 with an adam
-    update). Reports the whole-step FLOP rate, counting projections + both
-    attention matmuls forward and the standard 2× for backward.
+def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
+                       no_mask=False, iters=3, devices=None,
+                       impl='allgather', offset=32, heads=8):
+    """Measure one full training step — forward, loss, gradient psum, optax
+    update as ONE compiled SPMD program (``train.make_train_step``).
+    Returns the result record; shared by ``--mode train`` and ``bench.py``
+    so the FLOP accounting and setup cannot drift apart.
+
+    FLOPs: 4 projections (2·T·768² each) + scores/context matmuls
+    (2·T²·768 each) forward; backward ≈ 2× forward; adam is negligible.
     """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -239,26 +242,25 @@ def run_train(args):
     from distributed_dot_product_tpu import DistributedDotProductAttn
     from distributed_dot_product_tpu.train import make_train_step
 
-    mesh = seq_mesh(args.devices)
+    mesh = seq_mesh(devices)
     world = mesh.devices.size
-    t = args.seq_len - args.seq_len % world
-    dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
-    heads = args.heads
+    t = seq_len - seq_len % world
+    jdtype = jnp.float32 if dtype == 'f32' else jnp.bfloat16
 
     model = DistributedDotProductAttn(
-        key_dim=DIM, num_heads=heads, offset=args.offset or 32,
-        softmax_impl=args.attn_impl.replace('_bounded', ''),
-        flash_softmax_mode=('bounded' if args.attn_impl == 'flash_bounded'
+        key_dim=DIM, num_heads=heads, offset=offset or 32,
+        softmax_impl=attn_impl.replace('_bounded', ''),
+        flash_softmax_mode=('bounded' if attn_impl == 'flash_bounded'
                             else 'exact'),
-        impl=args.impl, dtype=dtype)
+        impl=impl, dtype=jdtype)
 
     k1, k2 = jax.random.split(jax.random.key(111))
-    x_host = jax.random.normal(k1, (1, t, DIM), dtype)
-    target_host = jax.random.normal(k2, (1, t, DIM), dtype)
+    x_host = jax.random.normal(k1, (1, t, DIM), jdtype)
+    target_host = jax.random.normal(k2, (1, t, DIM), jdtype)
     act = NamedSharding(mesh, P(None, SEQ_AXIS, None))
     x = jax.device_put(x_host, act)
     target = jax.device_put(target_host, act)
-    mask = None if args.no_mask else jax.device_put(
+    mask = None if no_mask else jax.device_put(
         jnp.zeros((1, t, t), dtype=bool),
         NamedSharding(mesh, P(None, SEQ_AXIS, None)))
 
@@ -266,7 +268,7 @@ def run_train(args):
     # full-length init forward would cost an extra whole-T compile per
     # sweep config.
     t0 = max(world * 2, 16)
-    x0 = jnp.zeros((1, t0, DIM), dtype)
+    x0 = jnp.zeros((1, t0, DIM), jdtype)
     params = model.init(jax.random.key(0), x0, x0, x0,
                         jnp.zeros((1, t0, t0), dtype=bool))
     optimizer = optax.adam(1e-3)
@@ -275,25 +277,32 @@ def run_train(args):
 
     batch = (x, x, x, mask, target)
     compiled = step.lower(params, opt_state, batch).compile()
-    best, mean = time_fn(compiled, params, opt_state, batch,
-                         iters=args.iters)
-    # FLOPs: 4 projections (2·T·768² each) + scores/context matmuls
-    # (2·T²·768 each) forward; backward ≈ 2× forward; adam is negligible.
-    fwd = 8.0 * t * DIM * DIM + 4.0 * t * t * DIM
-    flops = 3.0 * fwd
-    record = {
-        'mode': 'train', 'attn_impl': args.attn_impl, 'T': t, 'dim': DIM,
-        'heads': heads, 'world': world, 'dtype': args.dtype,
-        'mask': not args.no_mask,
+    best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
+    flops = 3.0 * (8.0 * t * DIM * DIM + 4.0 * t * t * DIM)
+    return {
+        'mode': 'train', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
+        'heads': heads, 'world': world, 'dtype': dtype,
+        'mask': not no_mask,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'step_time': best, 'step_time_mean': mean,
         'step_gflops_per_chip': flops / world / best / 1e9,
         'memory_analysis': _memory_analysis(compiled),
     }
+
+
+def run_train(args):
+    """``--mode train``: the reference example workload scaled up
+    (reference example.py runs T=4096, dim 768, heads 2 with no optimizer;
+    here T defaults to 16384 with an adam update)."""
+    record = measure_train_step(
+        seq_len=args.seq_len, attn_impl=args.attn_impl, dtype=args.dtype,
+        no_mask=args.no_mask, iters=args.iters, devices=args.devices,
+        impl=args.impl, offset=args.offset, heads=args.heads)
     ma = record['memory_analysis'] or {}
-    print(f"train[{args.attn_impl}] T={t} dim={DIM} H={heads} "
-          f"{world}-device: {best:.4f}s/step "
+    print(f"train[{args.attn_impl}] T={record['T']} dim={DIM} "
+          f"H={record['heads']} {record['world']}-device: "
+          f"{record['step_time']:.4f}s/step "
           f"({record['step_gflops_per_chip']:.0f} GFLOP/s/chip, "
           f"temp {ma.get('temp_bytes', 0) / 2**30:.2f} GiB)")
     _append_record(args.file, record)

@@ -97,6 +97,20 @@ SWEEPS = [
     ('train_benchmark_flash_32k',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '32768']),
+    # --no-mask (attn_mask=None): the long-context configuration — the
+    # dense mask is the only O(T^2) input on the flash path.
+    ('train_benchmark_flash_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--no-mask']),
+    ('train_benchmark_flash_128k_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '131072', '--no-mask']),
+    ('train_benchmark_flash_256k_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '262144', '--no-mask', '--iters', '2']),
+    ('train_benchmark_flash_512k_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '524288', '--no-mask', '--iters', '1']),
 ]
 
 
@@ -119,8 +133,10 @@ def main():
         if os.path.exists(path) and not args.rerun:
             print(f'== {stem}: exists, skipping (--rerun to redo)')
             continue
+        # Default iters first so a per-config '--iters' in bench_args wins
+        # (argparse keeps the last occurrence).
         cmd = [sys.executable, os.path.join(REPO, 'benchmark.py'),
-               *bench_args, '--iters', str(args.iters), '--file', path]
+               '--iters', str(args.iters), *bench_args, '--file', path]
         print(f'== {stem}: {" ".join(bench_args)}', flush=True)
         t0 = time.time()
         proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
