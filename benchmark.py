@@ -53,6 +53,9 @@ def parse_args():
                         help='train mode: attn_mask=None — drops the only '
                              'O(T^2) input on the flash path (long-context '
                              'configuration)')
+    parser.add_argument('--causal', action='store_true',
+                        help='train mode: autoregressive masking (handled '
+                             'blockwise in-kernel on ring/flash/ulysses)')
     parser.add_argument('--attn-impl',
                         choices=['full', 'online', 'flash', 'flash_bounded',
                                  'ulysses'],
@@ -226,7 +229,7 @@ def _memory_analysis(compiled):
 
 
 def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
-                       no_mask=False, iters=3, devices=None,
+                       no_mask=False, causal=False, iters=3, devices=None,
                        impl='allgather', offset=32, heads=8):
     """Measure one full training step — forward, loss, gradient psum, optax
     update as ONE compiled SPMD program (``train.make_train_step``).
@@ -252,7 +255,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         softmax_impl=attn_impl.replace('_bounded', ''),
         flash_softmax_mode=('bounded' if attn_impl == 'flash_bounded'
                             else 'exact'),
-        impl=impl, dtype=jdtype)
+        causal=causal, impl=impl, dtype=jdtype)
 
     k1, k2 = jax.random.split(jax.random.key(111))
     x_host = jax.random.normal(k1, (1, t, DIM), jdtype)
@@ -278,11 +281,13 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     batch = (x, x, x, mask, target)
     compiled = step.lower(params, opt_state, batch).compile()
     best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
-    flops = 3.0 * (8.0 * t * DIM * DIM + 4.0 * t * t * DIM)
+    # Causal attention does half the score/context work (lower triangle).
+    attn_mm = 2.0 if causal else 4.0
+    flops = 3.0 * (8.0 * t * DIM * DIM + attn_mm * t * t * DIM)
     return {
         'mode': 'train', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
         'heads': heads, 'world': world, 'dtype': dtype,
-        'mask': not no_mask,
+        'mask': not no_mask, 'causal': causal,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'step_time': best, 'step_time_mean': mean,
@@ -297,8 +302,9 @@ def run_train(args):
     here T defaults to 16384 with an adam update)."""
     record = measure_train_step(
         seq_len=args.seq_len, attn_impl=args.attn_impl, dtype=args.dtype,
-        no_mask=args.no_mask, iters=args.iters, devices=args.devices,
-        impl=args.impl, offset=args.offset, heads=args.heads)
+        no_mask=args.no_mask, causal=args.causal, iters=args.iters,
+        devices=args.devices, impl=args.impl, offset=args.offset,
+        heads=args.heads)
     ma = record['memory_analysis'] or {}
     print(f"train[{args.attn_impl}] T={record['T']} dim={DIM} "
           f"H={record['heads']} {record['world']}-device: "

@@ -162,14 +162,13 @@ class DistributedDotProductAttn(nn.Module):
             # there instead of duplicating it.
             softmax_impl = 'flash'
 
-        # Causal handling: ring/ulysses (and local flash) take causal=True
-        # natively — the kernels skip whole future blocks and need no
-        # materialized triangle. Only the 'full' path and the DISTRIBUTED
-        # flash path (whose kernel sees local key rows with a global offset
-        # it cannot express) densify causality into the mask.
-        native_causal = self.causal and softmax_impl in ('online', 'ulysses')
-        if softmax_impl == 'flash' and not distributed:
-            native_causal = self.causal
+        # Causal handling: ring/ulysses/flash take causal=True natively —
+        # the kernels skip whole future blocks and need no materialized
+        # triangle (the distributed flash kernel takes the shard's global
+        # row offset as a scalar input). Only the 'full' parity path
+        # densifies causality into the mask.
+        native_causal = self.causal and softmax_impl in ('online', 'ulysses',
+                                                         'flash')
         if self.causal and not native_causal:
             # Rows of the score block are this shard's GLOBAL positions
             # (idx·T/N + local row); columns are global already. In the
@@ -208,8 +207,14 @@ class DistributedDotProductAttn(nn.Module):
                     tiled=True)
             else:
                 q_full, v_full = queries, values
+            # In the distributed K-first layout the kernel's query rows are
+            # this shard's keys — global positions start at idx·T/N.
+            causal_offset = (
+                jax.lax.axis_index(self.axis_name) * keys.shape[-2]
+                if (native_causal and distributed) else 0)
             outputs = flash_attention(keys, q_full, v_full, attn_mask,
                                       scale=scale, causal=native_causal,
+                                      causal_offset=causal_offset,
                                       softmax_mode=self.flash_softmax_mode)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_dot_product_tpu.ops.pallas_attention import _row_has_valid
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['ring_attention', 'local_attention_reference']
@@ -133,15 +134,11 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         # key would otherwise degenerate to a softmax over its raw q·k
         # logits; zero it explicitly (the reference produces NaN here).
         # "No attendable key" counts the causal restriction too — the
-        # semantics must not depend on WHICH mask emptied the row, and must
-        # match flash_attention's (ops/pallas_attention._row_has_valid).
-        valid = ~mask
-        if causal:
-            col_pos = jnp.arange(mask.shape[-1])
-            valid = jnp.logical_and(valid,
-                                    row_pos[:, None] >= col_pos[None, :])
-        any_valid = jnp.any(valid, axis=-1)
-        out = jnp.where(any_valid[..., None], out, jnp.zeros((), out.dtype))
+        # SHARED helper keeps these semantics identical across every
+        # softmax path.
+        any_valid = _row_has_valid(mask, causal, tn, mask.shape[-1],
+                                   row_offset=idx * tn)
+        out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
     return out.astype(v.dtype)
 
 
@@ -160,12 +157,8 @@ def local_attention_reference(q, k, v, mask=None, causal=False, scale=None):
     attn = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('...to,...od->...td', attn, v.astype(dtype))
     if mask is not None:
-        # Union semantics, as in ring_attention above.
-        valid = ~mask
-        if causal:
-            valid = jnp.logical_and(
-                valid, jnp.arange(q.shape[-2])[:, None]
-                >= jnp.arange(k.shape[-2])[None, :])
-        out = jnp.where(jnp.any(valid, axis=-1)[..., None], out,
-                        jnp.zeros((), out.dtype))
+        # Union semantics via the shared helper, as in ring_attention.
+        out = jnp.where(
+            _row_has_valid(mask, causal, q.shape[-2], k.shape[-2]),
+            out, jnp.zeros((), out.dtype))
     return out.astype(v.dtype)

@@ -97,17 +97,21 @@ def _pad_dim(x, axis, mult):
     return jnp.pad(x, pad)
 
 
-def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref):
+def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref):
     """Shared logit masking: user mask block, causal future, Tk padding.
 
     The mask arrives as int8 (1 = masked): Mosaic widens bool kernel
     operands to s32 — a full-size O(4·Tq·Tk) HBM copy — but takes int8
-    blocks natively.
+    blocks natively. ``off_ref`` (scalar, (1, 1) int32) holds the GLOBAL
+    index of query row 0 — sequence-sharded callers pass their shard's
+    offset so the causal triangle is over global positions with no
+    materialized mask.
     """
     if mask_ref is not None:
         s = jnp.where(mask_ref[0] != 0, _NEG_BIG, s)
     if causal:
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        rows = (off_ref[0, 0] + qi * bq
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(rows < cols, _NEG_BIG, s)
     if kv_len % bk:
@@ -116,14 +120,25 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref):
     return s
 
 
-def _row_has_valid(mask, causal, tq, tk):
+def _causal_run(causal, off_ref, qi, ki, bq, bk):
+    """Block-skip predicate: does this (Q block, K block) pair contain any
+    un-masked causal entry? With a traced row offset this is a dynamic
+    scalar — ``pl.when`` still skips the matmuls at run time."""
+    if not causal:
+        return True
+    return off_ref[0, 0] + (qi + 1) * bq - 1 >= ki * bk
+
+
+def _row_has_valid(mask, causal, tq, tk, row_offset=0):
     """(..., Tq, 1) bool: does row i have ANY attendable key, counting the
     causal restriction too? Rows without one output 0 with zero gradients
     (in every softmax path — the kernels' semantics must not depend on
-    WHICH mask made the row empty)."""
+    WHICH mask made the row empty). ``row_offset`` is the global index of
+    row 0 (sequence-sharded callers pass their shard offset)."""
     valid = ~mask
     if causal:
-        allowed = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        rows = row_offset + jnp.arange(tq)
+        allowed = rows[:, None] >= jnp.arange(tk)[None, :]
         valid = jnp.logical_and(valid, allowed)
     return jnp.any(valid, axis=-1, keepdims=True)
 
@@ -191,9 +206,9 @@ _BOUNDED_SAFE_GAP = 100.0
 def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
     def kernel(*refs):
         if has_mask:
-            q_ref, k_ref, v_ref, mask_ref, *rest = refs
+            off_ref, q_ref, k_ref, v_ref, mask_ref, *rest = refs
         else:
-            q_ref, k_ref, v_ref, *rest = refs
+            off_ref, q_ref, k_ref, v_ref, *rest = refs
             mask_ref = None
         if save_lse:
             o_ref, lse_ref, m_s, l_s, acc_s = rest
@@ -211,10 +226,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
 
         # Causal block skip: the whole K block is strictly in the future of
         # every query row of this program → contributes nothing.
-        if causal:
-            run = (qi + 1) * bq - 1 >= ki * bk
-        else:
-            run = True
+        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
 
         @pl.when(run)
         def _():
@@ -231,7 +243,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
-            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref)
+            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
+                             mask_ref, off_ref)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -263,12 +276,17 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
     return kernel
 
 
-def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
-                    save_lse=False):
+def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
+                    mode='exact', save_lse=False):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
+    # Scalar (1, 1) int32 input: the global index of query row 0 (possibly
+    # traced, e.g. lax.axis_index under shard_map). Always fed — a dead
+    # scalar read costs nothing and keeps the kernel signatures uniform.
+    off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
+    off_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
 
     bq, bk = _block_sizes(tq, tk, q.dtype, d_total=d + d_v,
                           has_mask=mask is not None)
@@ -309,10 +327,10 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
         kernel = _make_fwd_kernel(causal, bq, bk, tk, mask is not None,
                                   save_lse)
         return pl.pallas_call(
-            kernel, grid=grid, in_specs=specs + mask_specs,
+            kernel, grid=grid, in_specs=[off_spec] + specs + mask_specs,
             out_specs=out_specs, out_shape=out_shape,
             scratch_shapes=_scratch(bq, d_v), interpret=interpret,
-        )(*args, *mask_args)
+        )(off, *args, *mask_args)
 
     if mode == 'bounded':
         # Per-row upper bound on the (log2-unit) scores via Cauchy-Schwarz:
@@ -331,11 +349,11 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
                 causal, bq, bk, tk, mask is not None, save_lse)
             return pl.pallas_call(
                 kernel, grid=grid,
-                in_specs=specs + [mvec_spec] + mask_specs,
+                in_specs=[off_spec] + specs + [mvec_spec] + mask_specs,
                 out_specs=out_specs, out_shape=out_shape,
                 scratch_shapes=_scratch(bq, d_v)[1:],  # no m buffer
                 interpret=interpret,
-            )(*args, mvecf, *mask_args)
+            )(off, *args, mvecf, *mask_args)
 
         # Safety net: the bound shift is only exact while
         # bound − true_rowmax stays inside fp32's exponent range; since
@@ -351,7 +369,8 @@ def _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode='exact',
     out, lse = res if save_lse else (res, None)
     out = out[:, :tq].reshape(*batch, tq, d_v)
     if mask is not None:
-        any_valid = _row_has_valid(mask, causal, tq, tk)
+        any_valid = _row_has_valid(mask, causal, tq, tk,
+                                   row_offset=off[0, 0])
         out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
     if save_lse:
         return out, lse[:, :tq, 0].reshape(*batch, tq)
@@ -379,9 +398,9 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
     """
     def kernel(*refs):
         if has_mask:
-            q_ref, k_ref, v_ref, m_ref, mask_ref, *rest = refs
+            off_ref, q_ref, k_ref, v_ref, m_ref, mask_ref, *rest = refs
         else:
-            q_ref, k_ref, v_ref, m_ref, *rest = refs
+            off_ref, q_ref, k_ref, v_ref, m_ref, *rest = refs
             mask_ref = None
         if save_lse:
             o_ref, lse_ref, l_s, acc_s = rest
@@ -396,10 +415,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
-        if causal:
-            run = (qi + 1) * bq - 1 >= ki * bk
-        else:
-            run = True
+        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
 
         @pl.when(run)
         def _():
@@ -409,7 +425,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
-            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref)
+            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
+                             mask_ref, off_ref)
             p = jnp.exp2(s - m_ref[0])                      # bound shift
             l_s[:] += p.sum(axis=-1, keepdims=True)
             acc_s[:] += jax.lax.dot_general(
@@ -432,10 +449,10 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
     def kernel(*refs):
         if has_mask:
-            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
-             dq_ref, dq_acc) = refs
+            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+             mask_ref, dq_ref, dq_acc) = refs
         else:
-            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
              dq_ref, dq_acc) = refs
             mask_ref = None
         qi = pl.program_id(1)
@@ -446,7 +463,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
-        run = (qi + 1) * bq - 1 >= ki * bk if causal else True
+        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
 
         @pl.when(run)
         def _():
@@ -461,7 +478,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
-            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref)
+            s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
+                             mask_ref, off_ref)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
@@ -481,10 +499,10 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
     def kernel(*refs):
         if has_mask:
-            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+             mask_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
         else:
-            (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
              dk_ref, dv_ref, dk_acc, dv_acc) = refs
             mask_ref = None
         kj = pl.program_id(1)
@@ -496,7 +514,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
-        run = (qi + 1) * bq - 1 >= kj * bk if causal else True
+        run = _causal_run(causal, off_ref, qi, kj, bq, bk)
 
         @pl.when(run)
         def _():
@@ -511,7 +529,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
-            s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len, mask_ref)
+            s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len,
+                             mask_ref, off_ref)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dv_acc[:] += jax.lax.dot_general(
                 p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -532,7 +551,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
     return kernel
 
 
-def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
+def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
+                    causal, interpret):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -543,11 +563,13 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
 
+    off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
     if mask is not None:
         # Forward zeroed rows with no attendable key (counting causal), so
         # their cotangent must not flow back through the (garbage-weight)
         # softmax recompute.
-        any_valid = _row_has_valid(mask, causal, tq, tk)
+        any_valid = _row_has_valid(mask, causal, tq, tk,
+                                   row_offset=off[0, 0])
         g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # (*batch, Tq, 1)
@@ -573,8 +595,11 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
                                               tq_p, tk_p)
         args.append(maskf)
 
+    off_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+
     # --- dq pass: grid (batch, Q block, K block), K innermost ---
     dq_in_specs = [
+        off_spec,
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, bk, d_v), lambda b, i, j: (b, j, 0)),
@@ -593,10 +618,11 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
         out_shape=jax.ShapeDtypeStruct((nb, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(*args)
+    )(off, *args)
 
     # --- dk/dv pass: grid (batch, K block, Q block), Q innermost ---
     dkv_in_specs = [
+        off_spec,
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
@@ -622,7 +648,7 @@ def _flash_bwd_impl(q, k, v, mask, out, lse, g, scale, causal, interpret):
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d_v), jnp.float32)],
         interpret=interpret,
-    )(*args)
+    )(off, *args)
 
     dq = dq[:, :tq].reshape(q.shape)
     dk = dk[:, :tk].reshape(k.shape)
@@ -647,31 +673,33 @@ def _reference_math(q, k, v, mask, scale, causal):
     return out.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, scale, causal, interpret, mode):
-    return _flash_fwd_impl(q, k, v, mask, scale, causal, interpret, mode)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, mask, causal_offset, scale, causal, interpret, mode):
+    return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
+                           interpret, mode)
 
 
-def _flash_fwd(q, k, v, mask, scale, causal, interpret, mode):
-    out, lse = _flash_fwd_impl(q, k, v, mask, scale, causal, interpret,
-                               mode, save_lse=True)
-    return out, (q, k, v, mask, out, lse)
+def _flash_fwd(q, k, v, mask, causal_offset, scale, causal, interpret,
+               mode):
+    out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
+                               interpret, mode, save_lse=True)
+    return out, (q, k, v, mask, causal_offset, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, mode, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
-    q, k, v, mask, out, lse = res
-    dq, dk, dv = _flash_bwd_impl(q, k, v, mask, out, lse, g, scale,
-                                 causal, interpret)
-    return dq, dk, dv, None
+    q, k, v, mask, causal_offset, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
+                                 scale, causal, interpret)
+    return dq, dk, dv, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, mask=None, *, causal=False, scale=None,
-                    interpret=None, softmax_mode='exact'):
+def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
+                    scale=None, interpret=None, softmax_mode='exact'):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -682,6 +710,13 @@ def flash_attention(q, k, v, mask=None, *, causal=False, scale=None,
     backward recomputes score blocks from the saved row logsumexp).
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU so the
     CPU test mesh runs the same code.
+
+    ``causal_offset``: the GLOBAL index of query row 0 (int or traced
+    scalar, e.g. ``lax.axis_index(...) * (T // N)`` under ``shard_map``) —
+    lets sequence-sharded callers run causal attention of local query rows
+    against gathered keys with no materialized O(Tq·Tk) triangle; the
+    causal comparison and the block-skip predicate use
+    ``causal_offset + row`` as the global row position.
 
     ``softmax_mode``:
 
@@ -705,5 +740,5 @@ def flash_attention(q, k, v, mask=None, *, causal=False, scale=None,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
-    return _flash(q, k, v, mask, float(scale), bool(causal),
+    return _flash(q, k, v, mask, causal_offset, float(scale), bool(causal),
                   bool(interpret), softmax_mode)

@@ -111,6 +111,9 @@ SWEEPS = [
     ('train_benchmark_flash_512k_nomask',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '524288', '--no-mask', '--iters', '1']),
+    ('train_benchmark_flash_128k_causal',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '131072', '--no-mask', '--causal', '--iters', '2']),
 ]
 
 
