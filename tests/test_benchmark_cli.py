@@ -83,3 +83,6 @@ def test_train_mode(tmp_path):
     rec = _run(tmp_path, 'train_nm', '--mode', 'train', '--attn-impl',
                'online', '--seq-len', '64', '--no-mask')
     assert rec['mask'] is False
+    rec = _run(tmp_path, 'train_c', '--mode', 'train', '--attn-impl',
+               'online', '--seq-len', '64', '--no-mask', '--causal')
+    assert rec['causal'] is True and rec['step_gflops_per_chip'] > 0
