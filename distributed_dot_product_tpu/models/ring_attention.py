@@ -90,9 +90,10 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     @jax.checkpoint
     def fold_block(acc, k_buf, v_buf, s):
         """Online-softmax update with the K/V block of owner (rank+s)%W."""
+        owner = (idx + s) % W
+
         def compute(acc):
             m, l, o = acc
-            owner = (idx + s) % W
             scores = jnp.einsum('...td,...od->...to', q_scaled,
                                 k_buf.astype(dtype), precision=precision)
             if mask_bias is not None:
@@ -124,7 +125,6 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         # the scan keeps folds sequential. Balancing the critical path
         # would need zigzag/striped row assignment, which changes the
         # sharding contract — deliberately not done here.
-        owner = (idx + s) % W
         return lax.cond(owner > idx, lambda acc: acc, compute, acc)
 
     def step(carry, s):
