@@ -78,16 +78,18 @@ def main():
     ks = jax.random.split(jax.random.key(7), 3)
     q, k, v = (jax.random.normal(kk, (1, h, t_attn, d), jnp.bfloat16)
                for kk in ks)
+    # iters=6: the tunneled chip's per-sample variance is ±15%; best-of-6
+    # keeps one bad sample window from distorting the recorded rate.
     fa = jax.jit(lambda q, k, v: jnp.sum(flash_attention(q, k, v),
                                          dtype=jnp.float32))
-    attn_best, _ = time_fn(fa, q, k, v, iters=3)
+    attn_best, _ = time_fn(fa, q, k, v, iters=6)
     attn_gflops = 4.0 * h * t_attn * t_attn * d / attn_best / 1e9
     # softmax_mode='bounded' drops the running-max reduce (see
     # ops/pallas_attention.py) — the faster large-T configuration.
     fb = jax.jit(lambda q, k, v: jnp.sum(
         flash_attention(q, k, v, softmax_mode='bounded'),
         dtype=jnp.float32))
-    attn_b_best, _ = time_fn(fb, q, k, v, iters=3)
+    attn_b_best, _ = time_fn(fb, q, k, v, iters=6)
     attn_b_gflops = 4.0 * h * t_attn * t_attn * d / attn_b_best / 1e9
 
     # Whole training step (fwd+bwd+adam, flash path, mask-free) at the
