@@ -233,10 +233,10 @@ the lower-triangle work.""")
   CPU-mesh tests (`tests/test_ops_grad.py`, parametrized over impl).
 - **Online/ring attention at T=75000 needs N>1 by design:** its score
   memory is O((T/N)²) per step; at N=1 that is the full 180 GB (T,T) block,
-  so the scale=1 row is flash-only. At T=18750 (fits), online ≈ the full
-  path's rate on one chip — its win is *memory at scale-out*, not
-  single-chip speed; flash wins both (5.6× faster than full at T=18750,
-  ~86× less training temp memory at T=8192).
+  so the scale=1 row is flash-only. At T=18750 (fits), online runs ~2× the
+  full path's rate on one chip — its win is *memory at scale-out*, not
+  single-chip speed; flash wins both (9.4× faster than full at T=18750,
+  27× less training temp memory at T=8192).
 - **Flash kernel at d=64**: exact-softmax ~76 TF/s at T=16K (the measured
   matmul-only ceiling of the same grid is ~90; Google's splash-attention
   kernel measures ~75 on this chip/shape). `softmax_mode='bounded'` trades
