@@ -112,6 +112,31 @@ def _summed(fn):
     return jax.jit(lambda *a: jnp.sum(fn(*a), dtype=jnp.float32))
 
 
+_TPU_HBM_GIB = {  # per-generation HBM, used only when stats are absent
+    'v5 lite': 16, 'v5e': 16, 'v6 lite': 32, 'v6e': 32,
+    'v4': 32, 'v5p': 95, 'v5': 95,
+}
+
+
+def _device_bytes_limit():
+    """Per-device HBM limit: runtime stats when available, else a
+    per-generation table keyed on device_kind (tunneled PJRT backends
+    expose no memory_stats — observed on the axon v5e tunnel). Unknown
+    kinds return None, which skips the pre-flight check entirely."""
+    dev = jax.devices()[0]
+    try:
+        limit = (dev.memory_stats() or {}).get('bytes_limit')
+    except Exception:
+        limit = None
+    if limit:
+        return limit
+    kind = getattr(dev, 'device_kind', '').lower()
+    for name, gib in _TPU_HBM_GIB.items():
+        if name in kind:
+            return gib * 2 ** 30
+    return None
+
+
 def run_attn(args):
     """Attention-op benchmark (no reference analog — the reference only
     benchmarks the L2 kernels, reference benchmark.py:23-26): time the
@@ -143,10 +168,7 @@ def run_attn(args):
         # refuse what can't fit rather than dying in an opaque device OOM
         # (the reference's module path has the same ceiling, SURVEY §5).
         # Sized per device; ×2 for scores + softmax output both live.
-        try:
-            limit = (jax.devices()[0].memory_stats() or {}).get('bytes_limit')
-        except Exception:
-            limit = None
+        limit = _device_bytes_limit()
         need = 2 * h * (t // world) * t * jnp.dtype(dtype).itemsize
         if limit and need > 0.45 * limit:
             raise SystemExit(
@@ -344,12 +366,7 @@ def run(args):
     # T=75000 fp32 default is 22.5 GiB against a 16 GiB v5e chip (use
     # --scale 2 or --dtype bf16 there; the reference needed 3 GPUs for the
     # same reason, reference benchmark.py:6-7).
-    stats = {}
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-    except Exception:
-        pass
-    limit = stats.get('bytes_limit')
+    limit = _device_bytes_limit()
     score_bytes = t * t * jnp.dtype(dtype).itemsize
     if limit and score_bytes > 0.9 * limit:
         raise SystemExit(

@@ -32,9 +32,11 @@ def make_batch(batch, t, dim):
     return x, target, mask
 
 
-def run_step(world):
+def run_step(world, ckpt_dir=None):
     """Build the model/mesh/step and run one training step on global
-    arrays; returns the (fully-replicated) loss as a float."""
+    arrays; returns the (fully-replicated) loss as a float. With
+    ``ckpt_dir``, also saves the post-step state and restores it — the
+    collective multi-host checkpoint path (every process participates)."""
     import numpy as np
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -74,7 +76,27 @@ def run_step(world):
         optimizer.init(params_local))
 
     step = make_train_step(model, optimizer, mesh, donate=False)
-    _, _, loss = step(params, opt_state, (x, x, x, mask, target))
+    new_params, new_opt, loss = step(params, opt_state,
+                                     (x, x, x, mask, target))
+
+    if ckpt_dir is not None:
+        # Collective save + restore across all processes (the checkpoint
+        # module's multi-host contract): every process calls with its view
+        # of the same global arrays; restored leaves adopt the template's
+        # (mesh-committed) shardings and must round-trip bitwise.
+        import distributed_dot_product_tpu as ddp
+        ddp.save(ckpt_dir, ddp.TrainState(1, new_params, new_opt))
+        restored = ddp.restore(
+            ckpt_dir, ddp.TrainState(0, new_params, new_opt))
+        assert restored.step == 1
+        for got_tree, want_tree in ((restored.params, new_params),
+                                    (restored.opt_state, new_opt)):
+            for a, b in zip(jax.tree.leaves(got_tree),
+                            jax.tree.leaves(want_tree)):
+                got = np.asarray(jax.device_get(a))
+                want = np.asarray(jax.device_get(b))
+                assert (got == want).all(), 'checkpoint round-trip mismatch'
+
     return float(np.asarray(jax.device_get(loss)))
 
 
@@ -86,6 +108,7 @@ def jnp_like(np_arr):
 def main():
     process_id, num_processes, port = (int(sys.argv[1]), int(sys.argv[2]),
                                        sys.argv[3])
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
     jax.config.update('jax_platforms', 'cpu')
     jax.config.update('jax_num_cpu_devices', LOCAL_DEVICES)
 
@@ -96,7 +119,7 @@ def main():
     world = num_processes * LOCAL_DEVICES
     assert len(jax.devices()) == world, jax.devices()
 
-    loss = run_step(world)
+    loss = run_step(world, ckpt_dir=ckpt_dir)
     comm.synchronize()
     if comm.is_main_process():
         print(f'MULTIHOST_LOSS={loss:.10f}', flush=True)

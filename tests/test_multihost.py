@@ -27,7 +27,7 @@ _REPO = os.path.dirname(_HERE)
 
 
 @pytest.mark.slow
-def test_two_process_mesh_matches_single_process():
+def test_two_process_mesh_matches_single_process(tmp_path):
     # Ephemeral port: bind-and-release so concurrent runs don't collide on
     # a fixed coordinator address.
     import socket
@@ -38,9 +38,10 @@ def test_two_process_mesh_matches_single_process():
            if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
     env['PYTHONPATH'] = _REPO + os.pathsep + env.get('PYTHONPATH', '')
     worker = os.path.join(_HERE, 'multihost_worker.py')
+    ckpt_dir = str(tmp_path / 'ckpt')
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), '2', str(port)],
+            [sys.executable, worker, str(pid), '2', str(port), ckpt_dir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=_REPO)
         for pid in range(2)
