@@ -10,16 +10,26 @@ bounding the *gathered-operand* memory (reference functions.py:64-68,
 SURVEY §5). This module removes that ceiling: K/V shards rotate around the
 mesh ring (``lax.ppermute`` neighbour hops riding the ICI torus) while a
 numerically-stable *online softmax* folds one ``(T/N, T/N)`` score block at
-a time into running ``(max, denominator, weighted-sum)`` accumulators —
-score memory O((T/N)²), independent of world size, so maximum sequence
-length scales linearly with the number of chips.
+a time into running accumulators — score memory O((T/N)²), independent of
+world size, so maximum sequence length scales linearly with the number of
+chips.
 
 No reference analog: its communication is chunked allgather, its softmax is
-full-row (SURVEY §2.2 "Ring attention: No"). The algorithm is the standard
-flash/ring-attention recurrence (online softmax per block, rescale-and-
-accumulate), laid out for the TPU: each step is one large MXU batched
-matmul pair, and XLA overlaps the ``ppermute`` transfer of the next block
-with compute on the current one.
+full-row (SURVEY §2.2 "Ring attention: No"). Two block-fold backends:
+
+- ``block_impl='flash'`` (default): each resident K/V block is folded by
+  the fused Pallas flash kernels of
+  :mod:`distributed_dot_product_tpu.ops.pallas_attention` — the forward
+  computes the block's normalized output + row logsumexp in VMEM and the
+  blocks are merged by the standard LSE combine
+  (``out = Σ_b softmax_b(lse_b) · out_b``); the backward rotates
+  ``(k, v, dk, dv)`` around the ring, calling the flash dq / dk·dv kernels
+  per block, so every score block in BOTH directions runs on the MXU with
+  O(BLOCK²) live score memory. This is the kernel fusion the XLA fold
+  cannot get: the einsum + online-softmax fold keeps the softmax algebra on
+  the VPU and re-materializes (T/N, T/N) score blocks through HBM.
+- ``block_impl='xla'``: the plain ``jnp.einsum`` + online-softmax fold
+  (kept as the portable/debug path and as an oracle for the kernel one).
 
 Convention: this API is standard attention — ``out[i] = Σ_t
 softmax_t(q_i·k_t·scale) v_t`` with softmax over the *gathered* axis. The
@@ -45,7 +55,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_dot_product_tpu.ops.pallas_attention import _row_has_valid
+from distributed_dot_product_tpu.ops.pallas_attention import (
+    _flash_bwd_impl, _flash_fwd_impl, _row_has_valid,
+)
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['ring_attention', 'local_attention_reference']
@@ -59,7 +71,7 @@ def _mask_bias(mask, dtype):
 
 
 def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
-                   scale=None, precision=None):
+                   scale=None, precision=None, block_impl='flash'):
     """Sequence-parallel attention with O((T/N)²) score memory.
 
     ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
@@ -67,21 +79,223 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     boolean ``(..., T/N, T)``, True = masked. ``causal``: apply the causal
     triangle over *global* positions (composes with ``mask``).
 
-    Returns ``(..., T/N, d_v)``. Differentiable (the K/V ring is carried
-    through a ``lax.scan``); each step is rematerialized in the backward
-    pass (``jax.checkpoint``) so backward score memory stays O((T/N)²).
+    ``block_impl='flash'`` (default) folds each resident block with the
+    fused Pallas flash kernels (forward AND backward) and merges blocks by
+    their row logsumexp; ``'xla'`` keeps the plain einsum + online-softmax
+    fold (``precision`` applies only to this backend). Both return
+    ``(..., T/N, d_v)`` and are differentiable; gradients use O((T/N)²)
+    score memory (the flash backend's VJP is a second ring pass that
+    carries ``(dk, dv)`` partial sums with the rotating blocks).
+    """
+    if block_impl not in ('flash', 'xla'):
+        raise ValueError(
+            f"block_impl must be 'flash' or 'xla', got {block_impl!r}")
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    if block_impl == 'flash':
+        if precision is not None:
+            # The Pallas kernels always accumulate in fp32 on the MXU; a
+            # caller-supplied XLA precision cannot apply — reject rather
+            # than silently changing their numerics contract.
+            raise ValueError(
+                "precision is only configurable with block_impl='xla' "
+                '(the flash kernels fix fp32 MXU accumulation)')
+        interpret = jax.default_backend() != 'tpu'
+        return _ring_flash(q, k, v, mask, axis_name, bool(causal),
+                           float(scale), bool(interpret))
+    return _ring_xla(q, k, v, mask, axis_name=axis_name, causal=causal,
+                     scale=scale, precision=precision)
+
+
+def _ring_sweep(axis_name, fold, rotating, acc):
+    """Shared ring schedule: W−1 (fold → rotate-every-``rotating``-buffer)
+    steps via ``lax.scan``, then the final resident block folded WITHOUT
+    the trailing rotation (it would only feed the discarded carry — full
+    shard transfers per call). ``fold(rotating, acc, s) -> (rotating,
+    acc)`` sees the block of owner ``(rank+s) mod W`` at step ``s``.
+    Returns the final ``(rotating, acc, perm)``."""
+    W = lax.psum(1, axis_name)
+    perm = [(i, (i - 1) % W) for i in range(W)]
+
+    def step(carry, s):
+        rot, acc = fold(*carry, s)
+        rot = tuple(lax.ppermute(x, axis_name, perm) for x in rot)
+        return (rot, acc), None
+
+    (rot, acc), _ = lax.scan(step, (rotating, acc), jnp.arange(W - 1))
+    rot, acc = fold(rot, acc, W - 1)
+    return rot, acc, perm
+
+
+# ---------------------------------------------------------------------------
+# block_impl='flash': Pallas-kernel block folds + LSE merge
+# ---------------------------------------------------------------------------
+
+def _blk_mask(mask, owner, tn):
+    """This shard's rows × the owner's column block of the global mask."""
+    if mask is None:
+        return None
+    return lax.dynamic_slice_in_dim(mask, owner * tn, tn, axis=-1)
+
+
+def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
+    """Forward ring: per block, the flash kernel returns the block-local
+    normalized output ``out_b`` and row logsumexp ``lse_b``; blocks merge by
+    the shift-invariant identity ``num += e^{lse_b − m}·out_b,
+    den += e^{lse_b − m}`` (``e^{lse_b − m}·out_b`` is exactly the block's
+    unnormalized numerator re-shifted to the running max ``m``).
+
+    Returns ``(out, lse)`` with the GLOBAL row logsumexp — the only
+    residual (besides the inputs) the ring backward needs.
     """
     W = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     tn = q.shape[-2]
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    den0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    num0 = jnp.zeros((*q.shape[:-1], v.shape[-1]), jnp.float32)
+
+    def fold(rot, acc, s):
+        k_buf, v_buf = rot
+        owner = (idx + s) % W
+
+        def compute(acc):
+            m, den, num = acc
+            # causal_offset = global row 0 of q MINUS global col 0 of the
+            # block: the kernel's causal triangle and block-skip then work
+            # over global positions with no materialized mask.
+            out_b, lse_b = _flash_fwd_impl(
+                q, k_buf, v_buf, _blk_mask(mask, owner, tn),
+                (idx - owner) * tn, scale, causal, interpret, save_lse=True)
+            # A block-empty row (all its columns masked / causal-future)
+            # has lse_b ≈ log-of-large-finite-negative ⇒ combine weight 0:
+            # garbage block outputs never enter the merge.
+            m_new = jnp.maximum(m, lse_b)
+            c_prev = jnp.exp(m - m_new)     # m0=-inf: exp(-inf)=0, no NaN
+            c_blk = jnp.exp(lse_b - m_new)
+            den = den * c_prev + c_blk
+            num = (num * c_prev[..., None]
+                   + c_blk[..., None] * out_b.astype(jnp.float32))
+            return m_new, den, num
+
+        if not causal:
+            return rot, compute(acc)
+        # Whole-block causal skip: the owner's column range lies entirely
+        # in this shard's future — not even a kernel launch. (The kernel
+        # also block-skips internally for partially-causal blocks.)
+        return rot, lax.cond(owner > idx, lambda a: a, compute, acc)
+
+    _, (m, den, num), _ = _ring_sweep(axis_name, fold, (k, v),
+                                      (m0, den0, num0))
+
+    # den > 0 always: the own-diagonal block (s=0) is never skipped, and
+    # every later fold multiplies den by e^{m−m_new} ∈ (0, 1] then adds a
+    # positive weight.
+    out = num / den[..., None]
+    lse = m + jnp.log(den)
+    if mask is not None:
+        # Rows with NO attendable key anywhere (counting causal) carry
+        # garbage weights in every block; zero them (reference: NaN).
+        any_valid = _row_has_valid(mask, causal, tn, mask.shape[-1],
+                                   row_offset=idx * tn)
+        out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
+    return out.astype(v.dtype), lse
+
+
+def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
+                         scale, interpret):
+    """Backward ring: the flash backward decomposes over K/V blocks given
+    the GLOBAL ``lse`` (and ``Δ = rowsum(g·out)``), so a second ring pass
+    rotates ``(k, v, dk, dv)`` together — each rank folds its dq
+    contribution locally and adds its (dk, dv) partial for the RESIDENT
+    block into the accumulators travelling with that block. After the full
+    cycle each (dk, dv) has every rank's contribution and sits one hop from
+    home. Partials stay fp32 across the W folds (``grad_dtype``)."""
+    W = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    tn = q.shape[-2]
+
+    if mask is not None:
+        # Pre-zero empty-row cotangents against the GLOBAL mask; the
+        # per-block calls must then not re-zero by their block-local view
+        # (zero_invalid_rows=False) — a row empty in one block but
+        # attendable elsewhere still owes that block its dq term.
+        any_valid = _row_has_valid(mask, causal, tn, mask.shape[-1],
+                                   row_offset=idx * tn)
+        g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
+
+    def fold(rot, dq, s):
+        k_buf, v_buf, dk_buf, dv_buf = rot
+        owner = (idx + s) % W
+
+        def compute(args):
+            dq, dk_buf, dv_buf = args
+            dq_b, dk_b, dv_b = _flash_bwd_impl(
+                q, k_buf, v_buf, _blk_mask(mask, owner, tn),
+                (idx - owner) * tn, out, lse, g, scale, causal, interpret,
+                zero_invalid_rows=False, grad_dtype=jnp.float32)
+            return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
+
+        if causal:
+            dq, dk_buf, dv_buf = lax.cond(
+                owner > idx, lambda a: a, compute, (dq, dk_buf, dv_buf))
+        else:
+            dq, dk_buf, dv_buf = compute((dq, dk_buf, dv_buf))
+        return (k_buf, v_buf, dk_buf, dv_buf), dq
+
+    rot0 = (k, v, jnp.zeros(k.shape, jnp.float32),
+            jnp.zeros(v.shape, jnp.float32))
+    (_, _, dk_buf, dv_buf), dq, perm = _ring_sweep(
+        axis_name, fold, rot0, jnp.zeros(q.shape, jnp.float32))
+    # After the last fold rank r holds the COMPLETE (dk, dv) of block
+    # (r−1) mod W; one final hop delivers them to their owner.
+    dk = lax.ppermute(dk_buf, axis_name, perm)
+    dv = lax.ppermute(dv_buf, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, mask, axis_name, causal, scale, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
+                                    interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name,
+                                      causal, scale, interpret)
+    return dq, dk, dv, None
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# block_impl='xla': einsum + online-softmax fold (portable / oracle path)
+# ---------------------------------------------------------------------------
+
+def _ring_xla(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
+              scale=None, precision=None):
+    """The plain-XLA block fold (pre-fusion implementation, kept as the
+    portable backend and as an oracle for the kernel path). Differentiable
+    through the scan; each step rematerializes in the backward
+    (``jax.checkpoint``) so backward score memory stays O((T/N)²)."""
+    W = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    tn = q.shape[-2]
     dtype = jnp.promote_types(q.dtype, jnp.float32)
-    scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
 
     acc_shape = (*q.shape[:-1], v.shape[-1])        # (..., Tn, dv)
     m0 = jnp.full(q.shape[:-1], -jnp.inf, dtype)    # running max (..., Tn)
     l0 = jnp.zeros(q.shape[:-1], dtype)             # running denom
     o0 = jnp.zeros(acc_shape, dtype)                # running numerator
-    perm = [(i, (i - 1) % W) for i in range(W)]
 
     mask_bias = None if mask is None else _mask_bias(mask, dtype)
     q_scaled = q.astype(dtype) * scale
@@ -127,19 +341,10 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         # sharding contract — deliberately not done here.
         return lax.cond(owner > idx, lambda acc: acc, compute, acc)
 
-    def step(carry, s):
-        k_buf, v_buf, acc = carry
-        acc = fold_block(acc, k_buf, v_buf, s)
-        k_buf = lax.ppermute(k_buf, axis_name, perm)
-        v_buf = lax.ppermute(v_buf, axis_name, perm)
-        return (k_buf, v_buf, acc), None
+    def fold(rot, acc, s):
+        return rot, fold_block(acc, *rot, s)
 
-    # W-1 rotated steps, then the final resident block folded without the
-    # trailing ppermute pair (it would only feed the discarded carry —
-    # two full shard transfers per call, replayed again under checkpoint).
-    (k_last, v_last, acc), _ = lax.scan(
-        step, (k, v, (m0, l0, o0)), jnp.arange(W - 1))
-    _, l, o = fold_block(acc, k_last, v_last, W - 1)
+    _, (_, l, o), _ = _ring_sweep(axis_name, fold, (k, v), (m0, l0, o0))
     # l >= 1 always (each row's max logit contributes exp(0)); the guard is
     # belt-and-braces only.
     out = o / jnp.where(l == 0, jnp.ones_like(l), l)[..., None]

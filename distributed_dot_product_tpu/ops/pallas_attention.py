@@ -552,11 +552,21 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
 
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
-                    causal, interpret):
+                    causal, interpret, zero_invalid_rows=True,
+                    grad_dtype=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
     ``dq = scale·ds·k``, ``dk = scale·dsᵀ·q``.
+
+    ``zero_invalid_rows=False`` skips the empty-row cotangent zeroing —
+    for callers (the ring path) whose ``mask`` is only one COLUMN BLOCK of
+    the full mask: a row empty in this block but attendable elsewhere has
+    near-zero weights here already, and zeroing its ``g`` by the block-local
+    test would wrongly kill its contribution. Such callers pre-zero ``g``
+    against the GLOBAL mask themselves. ``grad_dtype`` overrides the output
+    gradient dtype (the ring path accumulates per-block grads across W
+    steps and wants fp32 partials rather than W roundings to bf16).
     """
     *batch, tq, d = q.shape
     tk = k.shape[-2]
@@ -564,7 +574,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     nb = int(math.prod(batch)) if batch else 1
 
     off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
-    if mask is not None:
+    if mask is not None and zero_invalid_rows:
         # Forward zeroed rows with no attendable key (counting causal), so
         # their cotangent must not flow back through the (garbage-weight)
         # softmax recompute.
@@ -615,7 +625,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         grid=(nb, tq_p // bq, tk_p // bk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(off, *args)
@@ -642,8 +652,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
             pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nb, tk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((nb, tk_p, d_v), v.dtype),
+            jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
+            jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d_v), jnp.float32)],
