@@ -131,9 +131,11 @@ def _device_bytes_limit():
     if limit:
         return limit
     kind = getattr(dev, 'device_kind', '').lower()
-    for name, gib in _TPU_HBM_GIB.items():
+    # Longest key first: 'v5 lite' must win over 'v5' by specificity, not
+    # by dict insertion order.
+    for name in sorted(_TPU_HBM_GIB, key=len, reverse=True):
         if name in kind:
-            return gib * 2 ** 30
+            return _TPU_HBM_GIB[name] * 2 ** 30
     return None
 
 
@@ -273,7 +275,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     jdtype = jnp.float32 if dtype == 'f32' else jnp.bfloat16
 
     model = DistributedDotProductAttn(
-        key_dim=DIM, num_heads=heads, offset=offset or 32,
+        key_dim=DIM, num_heads=heads, offset=offset,
         softmax_impl=attn_impl.replace('_bounded', ''),
         flash_softmax_mode=('bounded' if attn_impl == 'flash_bounded'
                             else 'exact'),
@@ -309,6 +311,9 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     return {
         'mode': 'train', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
         'heads': heads, 'world': world, 'dtype': dtype,
+        # offset/impl shape only the 'full' softmax path's matmuls, but are
+        # recorded always so any run is reproducible from its record.
+        'offset': offset, 'impl': impl,
         'mask': not no_mask, 'causal': causal,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,

@@ -71,10 +71,18 @@ def _is_finalized(path):
         from orbax.checkpoint import utils as ocp_utils
         return bool(ocp_utils.is_checkpoint_finalized(path))
     except Exception:
-        # Conservative fallback: orbax temp dirs carry a suffix after the
-        # final name; a plain step dir we cannot interrogate is assumed
-        # finalized (matches pre-commit-marker orbax on POSIX renames).
-        return True
+        # Fallback if the orbax util is missing/renamed: never assume YES —
+        # a crash-truncated directory must not be selected for restore.
+        # Orbax in-progress dirs carry an '.orbax-checkpoint-tmp' suffix,
+        # and a finalized StandardCheckpointer dir contains its metadata
+        # files; require positive evidence of the latter.
+        if '.orbax-checkpoint-tmp' in os.path.basename(os.fspath(path)):
+            return False
+        try:
+            entries = set(os.listdir(path))
+        except OSError:
+            return False
+        return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
 
 
 def save(path, state: TrainState, *, force: bool = True) -> str:
@@ -87,10 +95,26 @@ def save(path, state: TrainState, *, force: bool = True) -> str:
 
     Collective on multi-host: every process must call this with its view
     of the same global arrays (directory juggling runs on process 0 only).
+    ``path`` must be a local/POSIX filesystem visible to process 0 — the
+    backup rename dance uses ``os.rename``/``shutil.rmtree``; object-store
+    URLs (``gs://`` etc.) are rejected up front (use orbax directly there).
     """
+    if '://' in os.fspath(path):
+        raise ValueError(
+            f'save() supports POSIX paths only, got {path!r} — the '
+            'overwrite-backup rename is a filesystem operation; for '
+            'object stores call orbax.checkpoint directly')
     target = _step_dir(path, int(state.step))
     backup = target + '.replaced'
     exists = os.path.isdir(target)
+    if jax.process_count() > 1:
+        # Every process must take the same branch below (the orbax save is
+        # collective; one process raising while others enter it would hang
+        # at its barrier). Filesystem views can differ across hosts —
+        # process 0's view decides for everyone.
+        from jax.experimental import multihost_utils
+        exists = bool(multihost_utils.broadcast_one_to_all(
+            jax.numpy.asarray(exists)))
     if exists and not force:
         raise FileExistsError(
             f'{target} already exists; pass force=True to replace it')
