@@ -53,6 +53,14 @@ def parse_args():
                         help='train mode: attn_mask=None — drops the only '
                              'O(T^2) input on the flash path (long-context '
                              'configuration)')
+    parser.add_argument('--mask-kind', choices=['dense', 'none', 'segments'],
+                        default=None,
+                        help='train mode mask form (overrides --no-mask): '
+                             "'segments' = packed-sequence ids, O(T) "
+                             'traffic + cross-segment block skipping')
+    parser.add_argument('--segments', type=int, default=8,
+                        help='number of packed spans for '
+                             '--mask-kind segments')
     parser.add_argument('--causal', action='store_true',
                         help='train mode: autoregressive masking (handled '
                              'blockwise in-kernel on ring/flash/ulysses)')
@@ -254,14 +262,23 @@ def _memory_analysis(compiled):
 
 def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
                        no_mask=False, causal=False, iters=3, devices=None,
-                       impl='allgather', offset=32, heads=8):
+                       impl='allgather', offset=32, heads=8,
+                       mask_kind=None, n_segments=8):
     """Measure one full training step — forward, loss, gradient psum, optax
     update as ONE compiled SPMD program (``train.make_train_step``).
     Returns the result record; shared by ``--mode train`` and ``bench.py``
     so the FLOP accounting and setup cannot drift apart.
 
+    ``mask_kind``: 'dense' (reference-style boolean (B, T, T) zeros mask),
+    'none' (attn_mask=None) or 'segments' (packed-sequence ids, O(T) —
+    ``n_segments`` equal spans); default resolves from the legacy
+    ``no_mask`` flag.
+
     FLOPs: 4 projections (2·T·768² each) + scores/context matmuls
     (2·T²·768 each) forward; backward ≈ 2× forward; adam is negligible.
+    The segment FLOP count is NOT discounted for cross-segment skipping,
+    so reported GFLOP/s includes the skip as apparent speedup (same
+    convention as the causal discount, which IS applied, being exactly 2×).
     """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -281,15 +298,26 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
                             else 'exact'),
         causal=causal, impl=impl, dtype=jdtype)
 
+    if mask_kind is None:
+        mask_kind = 'none' if no_mask else 'dense'
+    if mask_kind not in ('dense', 'none', 'segments'):
+        raise ValueError(f'unknown mask_kind {mask_kind!r}')
+
     k1, k2 = jax.random.split(jax.random.key(111))
     x_host = jax.random.normal(k1, (1, t, DIM), jdtype)
     target_host = jax.random.normal(k2, (1, t, DIM), jdtype)
     act = NamedSharding(mesh, P(None, SEQ_AXIS, None))
     x = jax.device_put(x_host, act)
     target = jax.device_put(target_host, act)
-    mask = None if no_mask else jax.device_put(
+    mask = None if mask_kind != 'dense' else jax.device_put(
         jnp.zeros((1, t, t), dtype=bool),
         NamedSharding(mesh, P(None, SEQ_AXIS, None)))
+    seg = None
+    if mask_kind == 'segments':
+        # n_segments equal packed spans — the compact O(T) mask form.
+        seg = jax.device_put(
+            (jnp.arange(t, dtype=jnp.int32) * n_segments // t)[None],
+            NamedSharding(mesh, P(None, SEQ_AXIS)))
 
     # Init at a tiny T: parameter shapes depend only on DIM, and a
     # full-length init forward would cost an extra whole-T compile per
@@ -302,7 +330,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     opt_state = optimizer.init(params)
     step = make_train_step(model, optimizer, mesh, donate=False)
 
-    batch = (x, x, x, mask, target)
+    batch = (x, x, x, mask, target, seg)
     compiled = step.lower(params, opt_state, batch).compile()
     best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
     # Causal attention does half the score/context work (lower triangle).
@@ -314,7 +342,9 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         # offset/impl shape only the 'full' softmax path's matmuls, but are
         # recorded always so any run is reproducible from its record.
         'offset': offset, 'impl': impl,
-        'mask': not no_mask, 'causal': causal,
+        'mask': mask_kind == 'dense', 'mask_kind': mask_kind,
+        'n_segments': n_segments if mask_kind == 'segments' else None,
+        'causal': causal,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'step_time': best, 'step_time_mean': mean,
@@ -331,7 +361,8 @@ def run_train(args):
         seq_len=args.seq_len, attn_impl=args.attn_impl, dtype=args.dtype,
         no_mask=args.no_mask, causal=args.causal, iters=args.iters,
         devices=args.devices, impl=args.impl, offset=args.offset,
-        heads=args.heads)
+        heads=args.heads, mask_kind=args.mask_kind,
+        n_segments=args.segments)
     ma = record['memory_analysis'] or {}
     print(f"train[{args.attn_impl}] T={record['T']} dim={DIM} "
           f"H={record['heads']} {record['world']}-device: "

@@ -126,7 +126,16 @@ class DistributedDotProductAttn(nn.Module):
         self.values_proj = dense(value_dim, 'values')
         self.composition = dense(value_dim, 'composition')
 
-    def __call__(self, keys, queries, values, attn_mask=None):
+    def __call__(self, keys, queries, values, attn_mask=None,
+                 segment_ids=None):
+        # ``segment_ids``: optional non-negative int ``(B, T/N)`` local
+        # shard — the compact packed-sequence mask (positions in different
+        # segments don't attend; equivalent to the dense
+        # ``mask[i, j] = seg[i] != seg[j]`` but O(T), not O(T²)).
+        # flash/ulysses apply it in-kernel with whole-block skipping;
+        # full/online densify it into the boolean mask (those paths build
+        # (T/N, T) score rows anyway). Composes with ``attn_mask`` and
+        # ``causal`` as a union of maskings.
         # ``attn_mask=None`` means "no masking" — an extension over the
         # reference (whose example passes an all-False mask,
         # example.py:29). It matters at long context: the mask is the only
@@ -187,6 +196,23 @@ class DistributedDotProductAttn(nn.Module):
             attn_mask = (future if attn_mask is None
                          else jnp.logical_or(attn_mask, future))
 
+        seg_local = None
+        if segment_ids is not None:
+            seg_local = segment_ids.astype(jnp.int32)
+            if softmax_impl in ('full', 'online'):
+                # These paths materialize (T/N, T) rows regardless — the
+                # compact form densifies into the boolean mask (rows =
+                # this shard's positions, columns global).
+                seg_full = (jax.lax.all_gather(seg_local, self.axis_name,
+                                               axis=-1, tiled=True)
+                            if distributed else seg_local)
+                dense = seg_local[..., :, None] != seg_full[..., None, :]
+                if self.num_heads > 1:
+                    dense = dense[..., None, :, :]
+                attn_mask = (dense if attn_mask is None
+                             else jnp.logical_or(attn_mask, dense))
+                seg_local = None  # consumed
+
         if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
             # the gathered axis (reference module.py:61,67) is standard
@@ -212,10 +238,22 @@ class DistributedDotProductAttn(nn.Module):
             causal_offset = (
                 jax.lax.axis_index(self.axis_name) * keys.shape[-2]
                 if (native_causal and distributed) else 0)
+            seg_pair = None
+            if seg_local is not None:
+                # K-first layout: the kernel's query rows are this shard's
+                # keys (local segs), its key columns the gathered queries.
+                seg_kv = (jax.lax.all_gather(seg_local, self.axis_name,
+                                             axis=-1, tiled=True)
+                          if distributed else seg_local)
+                sq, sk = seg_local, seg_kv
+                if self.num_heads > 1:
+                    sq, sk = sq[..., None, :], sk[..., None, :]
+                seg_pair = (sq, sk)
             outputs = flash_attention(keys, q_full, v_full, attn_mask,
                                       scale=scale, causal=native_causal,
                                       causal_offset=causal_offset,
-                                      softmax_mode=self.flash_softmax_mode)
+                                      softmax_mode=self.flash_softmax_mode,
+                                      segment_ids=seg_pair)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],
@@ -233,7 +271,8 @@ class DistributedDotProductAttn(nn.Module):
                 keys, queries, values, attn_mask,
                 axis_name=self.axis_name, scale=scale,
                 causal=native_causal,
-                softmax_mode=self.flash_softmax_mode)
+                softmax_mode=self.flash_softmax_mode,
+                segment_ids=seg_local)
             outputs = jnp.swapaxes(outputs, -3, -2)
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
             return self.composition(outputs)
@@ -285,10 +324,11 @@ class DistributedDotProductAttn(nn.Module):
 
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,
-                       attn_mask=None, mesh_axis=None):
+                       attn_mask=None, mesh_axis=None, segment_ids=None):
     """Apply a :class:`DistributedDotProductAttn` to **global** arrays on a
     mesh: params replicated (``P()``), activations sharded on the time axis
-    (``P(None, 'seq', None)``).
+    (``P(None, 'seq', None)``); an optional global ``(B, T)``
+    ``segment_ids`` is sharded on time too.
 
     Replaces the reference's launch convention where ``horovodrun`` starts N
     processes that each construct the module and feed it their shard
@@ -296,12 +336,13 @@ def apply_seq_parallel(module, params, mesh, keys, queries, values,
     """
     mesh_axis = mesh_axis or module.axis_name
     act_spec = P(*([None] * (keys.ndim - 2) + [mesh_axis, None]))
+    seg_spec = P(*([None] * (keys.ndim - 2) + [mesh_axis]))
 
-    def fn(p, k, q, v, m):
-        return module.apply(p, k, q, v, m)
+    def fn(p, k, q, v, m, seg):
+        return module.apply(p, k, q, v, m, segment_ids=seg)
 
     return jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(P(), act_spec, act_spec, act_spec, act_spec),
+        in_specs=(P(), act_spec, act_spec, act_spec, act_spec, seg_spec),
         out_specs=act_spec, check_vma=False,
-    )(params, keys, queries, values, attn_mask)
+    )(params, keys, queries, values, attn_mask, segment_ids)

@@ -190,15 +190,12 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
 
     # den > 0 always: the own-diagonal block (s=0) is never skipped, and
     # every later fold multiplies den by e^{m−m_new} ∈ (0, 1] then adds a
-    # positive weight.
+    # positive weight. Rows with NO attendable key need no special-casing:
+    # the kernels' -inf masking makes every block contribute out_b = 0
+    # with lse_b ≈ ln2·_NEG_BIG, so num stays 0 and out is exactly 0
+    # (the reference NaNs here, SURVEY §4).
     out = num / den[..., None]
     lse = m + jnp.log(den)
-    if mask is not None:
-        # Rows with NO attendable key anywhere (counting causal) carry
-        # garbage weights in every block; zero them (reference: NaN).
-        any_valid = _row_has_valid(mask, causal, tn, mask.shape[-1],
-                                   row_offset=idx * tn)
-        out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
     return out.astype(v.dtype), lse
 
 
@@ -214,15 +211,9 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     W = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     tn = q.shape[-2]
-
-    if mask is not None:
-        # Pre-zero empty-row cotangents against the GLOBAL mask; the
-        # per-block calls must then not re-zero by their block-local view
-        # (zero_invalid_rows=False) — a row empty in one block but
-        # attendable elsewhere still owes that block its dq term.
-        any_valid = _row_has_valid(mask, causal, tn, mask.shape[-1],
-                                   row_offset=idx * tn)
-        g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
+    # Empty-row cotangents need no pre-zeroing: an empty row's global lse
+    # clamps to _NEG_BIG in every per-block backward, where its recomputed
+    # weights are exactly 0 — all its gradient terms die in-kernel.
 
     def fold(rot, dq, s):
         k_buf, v_buf, dk_buf, dv_buf = rot
@@ -233,7 +224,7 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
             dq_b, dk_b, dv_b = _flash_bwd_impl(
                 q, k_buf, v_buf, _blk_mask(mask, owner, tn),
                 (idx - owner) * tn, out, lse, g, scale, causal, interpret,
-                zero_invalid_rows=False, grad_dtype=jnp.float32)
+                grad_dtype=jnp.float32)
             return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
 
         if causal:

@@ -46,14 +46,18 @@ __all__ = ['ulysses_attention']
 
 
 def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
-                      causal=False, scale=None, softmax_mode='exact'):
+                      causal=False, scale=None, softmax_mode='exact',
+                      segment_ids=None):
     """Sequence-parallel attention via head↔time all-to-all re-sharding.
 
     ``q, k, v``: local shards ``(..., H, T/N, d)`` (``v`` may differ in its
     feature dim). Requires ``H % N == 0`` for mesh width ``N``. ``mask``:
     optional boolean ``(..., T/N, T)`` broadcastable over the leading dims
     — NOTE it is gathered to full ``(T, T)`` per device (see module
-    docstring). Returns ``(..., H, T/N, d_v)``.
+    docstring). ``segment_ids``: optional non-negative int ``(..., T/N)``
+    local shard (NO head axis) — the packed-sequence mask form; gathered
+    to ``(..., T)`` (O(T), unlike the dense mask's O(T²)) and applied
+    inside the kernel. Returns ``(..., H, T/N, d_v)``.
 
     Must run inside a ``shard_map`` over ``axis_name`` (use
     :func:`~distributed_dot_product_tpu.models.attention.apply_seq_parallel`
@@ -114,6 +118,16 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
         full_mask = lax.all_gather(mask, axis_name, axis=mask.ndim - 2,
                                    tiled=True)
 
+    seg_pair = None
+    if segment_ids is not None:
+        # Both sides of every locally-owned attention row span the full
+        # sequence after the head scatter; one O(T) gather serves q and kv
+        # (size-1 head axis inserted to broadcast against (..., H/N, T)).
+        seg_full = lax.all_gather(segment_ids.astype(jnp.int32), axis_name,
+                                  axis=segment_ids.ndim - 1, tiled=True)
+        seg_full = seg_full[..., None, :]
+        seg_pair = (seg_full, seg_full)
+
     out = flash_attention(qh, kh, vh, full_mask, causal=causal, scale=scale,
-                          softmax_mode=softmax_mode)
+                          softmax_mode=softmax_mode, segment_ids=seg_pair)
     return gather_heads(out)

@@ -97,26 +97,47 @@ def _pad_dim(x, axis, mult):
     return jnp.pad(x, pad)
 
 
-def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref):
-    """Shared logit masking: user mask block, causal future, Tk padding.
+def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
+                 segq_ref=None, segk_ref=None, mask_live=None):
+    """Shared logit masking: user mask block, segment ids, causal future,
+    Tk padding.
 
     The mask arrives as int8 (1 = masked): Mosaic widens bool kernel
     operands to s32 — a full-size O(4·Tq·Tk) HBM copy — but takes int8
     blocks natively. ``off_ref`` (scalar, (1, 1) int32) holds the GLOBAL
     index of query row 0 — sequence-sharded callers pass their shard's
     offset so the causal triangle is over global positions with no
-    materialized mask.
+    materialized mask. ``segq_ref``/``segk_ref`` are (1, B, 1)/(1, 1, B)
+    int32 segment-id blocks: positions in different segments are masked —
+    the packed-sequence mask form with O(T) (not O(T²)) HBM traffic.
+
+    Masked logits are ``-inf``, NOT the large-finite ``_NEG_BIG``: every
+    kernel shifts ``s`` by a value clamped ≥ ``_NEG_BIG`` (the running-max
+    scratch is INITIALIZED to ``_NEG_BIG``, the bounded kernel's shift and
+    the backward's lse are finite by construction), so ``exp2(s − shift)``
+    is exactly 0 for masked entries and never NaN. That makes fully-masked
+    rows yield 0 output / 0 gradients *inside* the kernel — which is also
+    what makes whole-block skipping exact: a skipped block contributes
+    nothing, the same as folding its all-zero weights.
     """
     if mask_ref is not None:
-        s = jnp.where(mask_ref[0] != 0, _NEG_BIG, s)
+        masked = mask_ref[0] != 0
+        if mask_live is not None:
+            # Scalar-prefetch redirection aliases non-mixed tiles onto
+            # block (0, 0): their resident mask content is arbitrary and
+            # must not be applied (``mask_live`` = this tile is mixed).
+            masked = jnp.logical_and(masked, mask_live)
+        s = jnp.where(masked, -jnp.inf, s)
+    if segq_ref is not None:
+        s = jnp.where(segq_ref[0] != segk_ref[0], -jnp.inf, s)
     if causal:
         rows = (off_ref[0, 0] + qi * bq
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(rows < cols, _NEG_BIG, s)
+        s = jnp.where(rows < cols, -jnp.inf, s)
     if kv_len % bk:
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(cols >= kv_len, _NEG_BIG, s)
+        s = jnp.where(cols >= kv_len, -jnp.inf, s)
     return s
 
 
@@ -143,55 +164,98 @@ def _row_has_valid(mask, causal, tq, tk, row_offset=0):
     return jnp.any(valid, axis=-1, keepdims=True)
 
 
+def _bcast_lead(kind, shape_lead, batch, ndim_trailing):
+    """Validate that an auxiliary input's leading dims broadcast against the
+    q/k/v batch dims; returns them left-padded with 1s to ``len(batch)``."""
+    if len(shape_lead) > len(batch):
+        # More leading dims than q/k/v: the output batch shape comes solely
+        # from q/k/v, so NumPy-style broadcasting cannot apply — reject
+        # instead of silently indexing only [0].
+        raise ValueError(
+            f'{kind} has {len(shape_lead)} leading dims but q/k/v have '
+            f'{len(batch)}; {kind} may not add batch dims')
+    lead = (1,) * (len(batch) - len(shape_lead)) + tuple(shape_lead)
+    for db, dm in zip(batch, lead):
+        if dm not in (1, db):
+            raise ValueError(
+                f'{kind} leading dims {tuple(shape_lead)} do not broadcast '
+                f'against q/k/v leading dims {tuple(batch)}')
+    return lead
+
+
+def _batch_index_fn(batch, lead):
+    """Flat-batch-index map (folded into a BlockSpec) from the q/k/v flat
+    batch index to the flat index of an aux input whose size-1 lead axes
+    are broadcast (stride 0)."""
+    strides = []
+    stride = 1
+    for db, dm in zip(reversed(batch), reversed(lead)):
+        strides.append(0 if dm == 1 else stride)
+        stride *= dm
+
+    strides.reverse()
+
+    def index(b):
+        out = 0
+        rem = b
+        for db, st in zip(reversed(batch), reversed(strides)):
+            out = out + (rem % db) * st
+            rem = rem // db
+        return out
+
+    return index
+
+
 def _mask_setup(mask, batch, tq, tk, tq_p, tk_p):
     """Validate mask broadcasting and flatten it WITHOUT materializing the
-    broadcast: returns the padded flat mask and a flat-batch-index map
-    (folded into the BlockSpec) that skips size-1 mask axes.
+    broadcast: returns the padded flat mask, a flat-batch-index map that
+    skips size-1 mask axes, and the mask's (broadcast-padded) lead dims.
 
     Padding rows/cols are set True (masked) so padded K columns never
     contribute and padded Q rows recompute as fully-masked (their
     cotangents are zero-padded anyway).
     """
-    if mask.ndim - 2 > len(batch):
-        # More leading dims than q/k/v: the output batch shape comes solely
-        # from q/k/v, so NumPy-style broadcasting cannot apply — reject
-        # instead of silently indexing only mask[0].
-        raise ValueError(
-            f'mask has {mask.ndim - 2} leading dims but q/k/v have '
-            f'{len(batch)}; a mask may not add batch dims')
-    mlead = (1,) * (len(batch) - (mask.ndim - 2)) + mask.shape[:-2]
     if mask.shape[-2:] != (tq, tk):
         raise ValueError(
             f'mask trailing dims {mask.shape[-2:]} must equal '
             f'(Tq, Tk) = {(tq, tk)}')
-    for db, dm in zip(batch, mlead):
-        if dm not in (1, db):
-            raise ValueError(
-                f'mask leading dims {mask.shape[:-2]} do not broadcast '
-                f'against q/k/v leading dims {tuple(batch)}')
+    mlead = _bcast_lead('mask', mask.shape[:-2], batch, 2)
     nm = int(math.prod(mlead)) if mlead else 1
     # int8, not bool: see _apply_masks. Padding rows/cols are masked (1).
     maskf = jnp.pad(mask.reshape(nm, tq, tk).astype(jnp.int8),
                     ((0, 0), (0, tq_p - tq), (0, tk_p - tk)),
                     constant_values=1)
+    return maskf, _batch_index_fn(batch, mlead), mlead
 
-    # Row-major strides of the mask's leading dims inside the batch.
-    midx_strides = []
-    stride = 1
-    for db, dm in zip(reversed(batch), reversed(mlead)):
-        midx_strides.append(0 if dm == 1 else stride)
-        stride *= dm
-    midx_strides.reverse()
 
-    def mask_batch_index(b):
-        out = 0
-        rem = b
-        for db, st in zip(reversed(batch), reversed(midx_strides)):
-            out = out + (rem % db) * st
-            rem = rem // db
-        return out
+def _seg_setup(segment_ids, batch, tq, tk, tq_p, tk_p):
+    """Prepare the segment-id pair for the kernels: ``(seg_q, seg_kv)``
+    int arrays of trailing shape ``(Tq,)`` / ``(Tk,)`` (leading dims
+    broadcastable against q/k/v like a mask's). Returns the padded flat
+    column/row vectors ``(nq, Tq_p, 1)`` / ``(nk, 1, Tk_p)``, their
+    batch-index maps, and their lead dims.
 
-    return maskf, mask_batch_index
+    Ids must be non-negative: Q padding uses sentinel −1 and K padding −2,
+    so padded positions never match anything (and padded K columns stay
+    masked even without the ``kv_len % bk`` guard).
+    """
+    seg_q, seg_k = segment_ids
+    if seg_q.shape[-1] != tq or seg_k.shape[-1] != tk:
+        raise ValueError(
+            f'segment_ids trailing dims ({seg_q.shape[-1]}, '
+            f'{seg_k.shape[-1]}) must equal (Tq, Tk) = {(tq, tk)}')
+    qlead = _bcast_lead('segment_ids[0]', seg_q.shape[:-1], batch, 1)
+    klead = _bcast_lead('segment_ids[1]', seg_k.shape[:-1], batch, 1)
+    nq = int(math.prod(qlead)) if qlead else 1
+    nk = int(math.prod(klead)) if klead else 1
+    segqf = jnp.pad(seg_q.astype(jnp.int32).reshape(nq, tq, 1),
+                    ((0, 0), (0, tq_p - tq), (0, 0)), constant_values=-1)
+    segkf = jnp.pad(seg_k.astype(jnp.int32).reshape(nk, 1, tk),
+                    ((0, 0), (0, 0), (0, tk_p - tk)), constant_values=-2)
+    return (segqf, _batch_index_fn(batch, qlead), qlead,
+            segkf, _batch_index_fn(batch, klead), klead)
+
+
 
 
 _LOG2E = math.log2(math.e)
@@ -203,13 +267,86 @@ _LN2 = math.log(2.0)
 _BOUNDED_SAFE_GAP = 100.0
 
 
-def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
+# Dense block-skip summaries above this size stay un-streamed (the skip is
+# dropped, not the mask): SMEM is ~a MiB per core and the summary competes
+# with nothing else we place there.
+_RUNSUM_SMEM_CAP = 512 * 1024
+
+# Test hook: force the scalar-prefetch mask redirect under the (slow)
+# Mosaic interpreter so the CPU suite can cover the TPU-only path on tiny
+# shapes.
+_REDIRECT_ON_INTERPRET = False
+
+
+def _mask_streams_per_tile(nb, tq, tk, dtype, d_total, allow_redirect,
+                           bwd=False):
+    """Will the dense mask stream for (almost) every tile? Only when the
+    block-skip summary cannot ride SMEM (or the redirect is off) — block
+    sizing must then keep the halved blocks that fit the streamed mask in
+    VMEM. With the redirect live, the resident mask block is a single
+    aliased tile and full-size blocks win (measured on v5e, T=16K d=96
+    bf16 fwd+bwd: 44.7 ms at 256×512 vs 31.2 ms at 1024×1024)."""
+    if not allow_redirect:
+        return True
+    f = _bwd_block_sizes if bwd else _block_sizes
+    bq, bk = f(tq, tk, dtype, d_total=d_total, has_mask=False)
+    return nb * (-(-tq // bq)) * (-(-tk // bk)) * 4 > _RUNSUM_SMEM_CAP
+
+
+def _split_aux(rest, has_mask, has_seg):
+    """Pop the optional (mask, seg_q, seg_k, qmm, kmm) refs off the input
+    tail shared by every kernel signature (the block-skip summary rides
+    the scalar-prefetch slot instead, always ref 0)."""
+    mask_ref = segq_ref = segk_ref = qmm_ref = kmm_ref = None
+    if has_mask:
+        mask_ref, *rest = rest
+    if has_seg:
+        segq_ref, segk_ref, qmm_ref, kmm_ref, *rest = rest
+    return mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref, rest
+
+
+def _run_pred(causal, off_ref, qi, ki, bq, bk, b, qmm_ref, kmm_ref,
+              runsum_ref):
+    """Combined block-skip predicate from scalar SMEM tables (vector
+    reductions to scalars trip Mosaic relayouts, and (1, 1, ·) VMEM blocks
+    are rejected outright — SMEM with program-id indexing is the TPU way):
+
+    - causal: the K block lies strictly in every query row's future;
+    - segments (``qmm/kmm``, per-block [min, max] id intervals): disjoint
+      intervals cannot contain an equal pair — true for ANY id layout,
+      tight for the sorted ids of packed sequences;
+    - dense mask (``runsum``, precomputed any-unmasked-entry per block
+      pair): skips the matmuls of fully-masked tiles (their mask block DMA
+      is already paid — compute only).
+
+    Exactness: masked logits are -inf ⇒ weights exactly 0 (see
+    ``_apply_masks``), so skipping a fully-masked block is identical to
+    folding it.
+    """
+    run = _causal_run(causal, off_ref, qi, ki, bq, bk)
+
+    def _and(a, x):
+        return x if a is True else jnp.logical_and(a, x)
+
+    if qmm_ref is not None:
+        run = _and(run, jnp.logical_and(
+            qmm_ref[b, qi, 0] <= kmm_ref[b, ki, 1],
+            kmm_ref[b, ki, 0] <= qmm_ref[b, qi, 1]))
+    if runsum_ref is not None:
+        run = _and(run, runsum_ref[b, qi, ki] != 0)
+    return run
+
+
+def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
+                     has_mask_skip, save_lse):
     def kernel(*refs):
-        if has_mask:
-            off_ref, q_ref, k_ref, v_ref, mask_ref, *rest = refs
+        if has_mask_skip:
+            runsum_ref, *refs = refs
         else:
-            off_ref, q_ref, k_ref, v_ref, *rest = refs
-            mask_ref = None
+            runsum_ref = None
+        off_ref, q_ref, k_ref, v_ref, *rest = refs
+        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
+         rest) = _split_aux(rest, has_mask, has_seg)
         if save_lse:
             o_ref, lse_ref, m_s, l_s, acc_s = rest
         else:
@@ -224,9 +361,10 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
-        # Causal block skip: the whole K block is strictly in the future of
-        # every query row of this program → contributes nothing.
-        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
+        # Block skip: K block strictly in the causal future of every query
+        # row, or provably fully masked → contributes nothing.
+        run = _run_pred(causal, off_ref, qi, ki, bq, bk,
+                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -243,8 +381,11 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
+            mask_live = (None if runsum_ref is None else
+                         runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref)
+                             mask_ref, off_ref, segq_ref, segk_ref,
+                             mask_live)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -261,12 +402,9 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
             l = l_s[:]
             safe_l = jnp.where(l == 0.0, 1.0, l)
             out = acc_s[:] / safe_l
-            # l == 0 happens only for causal rows before any valid column of
-            # a fully-skipped prefix (impossible: block (qi,0) always runs)
-            # or for fully-masked rows, which must return 0 (parity with
-            # ring_attention; the reference NaNs here, SURVEY §4). With
-            # large-finite mask bias, fully-masked rows have l >= eps but
-            # garbage weights — zero them via the mask below in the wrapper.
+            # l == 0 ⇔ the row has no attendable key (every logit -inf,
+            # every weight exactly 0) — out is then 0 with zero grads,
+            # in-kernel (the reference NaNs here, SURVEY §4).
             o_ref[0] = out.astype(o_ref.dtype)
             if save_lse:
                 # Convert from log2 back to natural-log units for the
@@ -276,8 +414,113 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, save_lse):
     return kernel
 
 
+def _aux_setup(mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
+               allow_redirect=True):
+    """Specs (both grid orders) + args + presence flags for the optional
+    (mask, segments, block-skip table) kernel inputs, shared by the
+    forward and both backward passes — args are computed ONCE (the int8
+    mask copy and the skip tables are O(T²)-read reductions; the dq and
+    dk/dv passes must not each pay them again). ``specs_t`` carries index
+    maps for the dk/dv grid ``(b, kj, qi)`` (Q innermost).
+
+    The skip tables (segment per-block [min, max], dense any-unmasked
+    summary) are whole-array SMEM inputs pre-broadcast to the flat batch —
+    kernels index them by raw program ids, no per-input batch maps."""
+    nqb, nkb = tq_p // bq, tk_p // bk
+    nb = int(math.prod(batch)) if batch else 1
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    specs, specs_t, args = [], [], []
+    runsum = None
+    maskf = mask_idx = mlead = None
+    if mask is not None:
+        maskf, mask_idx, mlead = _mask_setup(mask, batch, tq, tk,
+                                             tq_p, tk_p)
+        # 3-state per-tile summary: 0 = every entry masked (tile skipped),
+        # 1 = mixed (mask block streamed + applied), 2 = no entry masked
+        # (tile computed, mask block neither streamed nor applied).
+        # Dropped when it would crowd SMEM (mask then streams for every
+        # tile, the round-2 behavior) — and off-TPU (``allow_redirect``):
+        # the redirect needs a scalar-prefetch grid, which only the slow
+        # Mosaic interpreter emulates, and the DMA it saves isn't real on
+        # the test mesh anyway.
+        if allow_redirect and nb * nqb * nkb * 4 <= _RUNSUM_SMEM_CAP:
+            tile = maskf.reshape(maskf.shape[0], nqb, bq, nkb, bk)
+            state = jnp.where(tile.min(axis=(2, 4)) == 1, 0,
+                              jnp.where(tile.max(axis=(2, 4)) == 0, 2, 1))
+            runsum = jnp.broadcast_to(
+                state.reshape(*mlead, nqb, nkb),
+                (*batch, nqb, nkb)).reshape(nb, nqb, nkb).astype(jnp.int32)
+
+        if runsum is None:
+            mask_map = lambda b, i, j, *rs: (mask_idx(b), i, j)  # noqa: E731
+        else:  # scalar-prefetch mode: maps receive the summary ref
+            # Scalar-prefetch redirection: non-mixed tiles (skipped, or
+            # computed mask-free) alias block (0, 0, 0), so consecutive
+            # programs re-use the resident copy and their O(bq·bk) mask
+            # DMA disappears.
+            def mask_map(b, i, j, *rs):
+                mixed = rs[0][b, i, j] == 1
+                return (jnp.where(mixed, mask_idx(b), 0),
+                        jnp.where(mixed, i, 0), jnp.where(mixed, j, 0))
+        specs.append(pl.BlockSpec((1, bq, bk), mask_map))
+        specs_t.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i, *rs: mask_map(b, i, j, *rs)))
+        args.append(maskf)
+    if segment_ids is not None:
+        seg = _seg_setup(segment_ids, batch, tq, tk, tq_p, tk_p)
+        segqf, segq_idx, qlead, segkf, segk_idx, klead = seg
+        specs.append(pl.BlockSpec(
+            (1, bq, 1), lambda b, i, j, *rs: (segq_idx(b), i, 0)))
+        specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, i, j, *rs: (segk_idx(b), 0, j)))
+        specs_t.append(pl.BlockSpec(
+            (1, bq, 1), lambda b, j, i, *rs: (segq_idx(b), i, 0)))
+        specs_t.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, j, i, *rs: (segk_idx(b), 0, j)))
+        args.extend([segqf, segkf])
+        # Per-block [min, max] id intervals, (nb, n_blocks, 2) in SMEM.
+        sq = segqf[..., 0].reshape(segqf.shape[0], nqb, bq)
+        sk = segkf[:, 0].reshape(segkf.shape[0], nkb, bk)
+        qmm = jnp.stack([sq.min(-1), sq.max(-1)], -1)
+        kmm = jnp.stack([sk.min(-1), sk.max(-1)], -1)
+        qmm = jnp.broadcast_to(qmm.reshape(*qlead, nqb, 2),
+                               (*batch, nqb, 2)).reshape(nb, nqb, 2)
+        kmm = jnp.broadcast_to(kmm.reshape(*klead, nkb, 2),
+                               (*batch, nkb, 2)).reshape(nb, nkb, 2)
+        specs.extend([smem_spec, smem_spec])
+        specs_t.extend([smem_spec, smem_spec])
+        args.extend([qmm, kmm])
+    # prefetch == a live summary: the call becomes a scalar-prefetch grid
+    # and kernels pop the summary as ref 0.
+    flags = (mask is not None, segment_ids is not None, runsum is not None)
+    return specs, specs_t, args, flags, runsum
+
+
+def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
+                 interpret, runsum):
+    """Build + invoke: a scalar-prefetch grid when a block-skip summary is
+    live (``runsum``), a plain grid otherwise. Index maps are variadic
+    (``*rs``) so the same lambdas serve both. ``interpret=True`` under
+    prefetch upgrades to the Mosaic TPU interpreter — the default HLO
+    interpreter cannot evaluate scalar-prefetch grids ("MLIR translation
+    rule for primitive 'program_id' not found for platform cpu")."""
+    if runsum is not None:
+        call = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+                out_specs=out_specs, scratch_shapes=scratch),
+            out_shape=out_shape,
+            interpret=(pltpu.InterpretParams() if interpret is True
+                       else interpret))
+        return lambda *a: call(runsum, *a)
+    return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, scratch_shapes=scratch,
+                          out_shape=out_shape, interpret=interpret)
+
+
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
-                    mode='exact', save_lse=False):
+                    mode='exact', save_lse=False, segment_ids=None):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -286,10 +529,13 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     # traced, e.g. lax.axis_index under shard_map). Always fed — a dead
     # scalar read costs nothing and keeps the kernel signatures uniform.
     off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
-    off_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+    off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
 
+    allow_redirect = (not interpret) or _REDIRECT_ON_INTERPRET
+    streams_mask = mask is not None and _mask_streams_per_tile(
+        nb, tq, tk, q.dtype, d + d_v, allow_redirect)
     bq, bk = _block_sizes(tq, tk, q.dtype, d_total=d + d_v,
-                          has_mask=mask is not None)
+                          has_mask=streams_mask)
     # exp2 trick: fold scale·log2(e) into q so the kernel's score block
     # needs no per-element multiply (exp2 replaces exp, whose hardware
     # lowering is exp2(x·log2e) anyway). One extra rounding of q, same
@@ -302,35 +548,30 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     grid = (nb, tq_p // bq, tk_p // bk)
 
     specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, i, j, *rs: (b, j, 0)),
     ]
     args = [qf, kf, vf]
-    mask_specs, mask_args = [], []
-    if mask is not None:
-        maskf, mask_batch_index = _mask_setup(mask, batch, tq, tk,
-                                              tq_p, tk_p)
-        mask_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda b, i, j: (mask_batch_index(b), i, j)))
-        mask_args.append(maskf)
+    aux_specs, _, aux_args, flags, runsum = _aux_setup(
+        mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
+        allow_redirect=allow_redirect)
 
-    out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0))
+    out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0))
     out_shape = jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype)
     if save_lse:
         out_specs = [out_specs,
-                     pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))]
+                     pl.BlockSpec((1, bq, 1),
+                                  lambda b, i, j, *rs: (b, i, 0))]
         out_shape = [out_shape,
                      jax.ShapeDtypeStruct((nb, tq_p, 1), jnp.float32)]
 
     def run_exact(*_):
-        kernel = _make_fwd_kernel(causal, bq, bk, tk, mask is not None,
-                                  save_lse)
-        return pl.pallas_call(
-            kernel, grid=grid, in_specs=[off_spec] + specs + mask_specs,
-            out_specs=out_specs, out_shape=out_shape,
-            scratch_shapes=_scratch(bq, d_v), interpret=interpret,
-        )(off, *args, *mask_args)
+        kernel = _make_fwd_kernel(causal, bq, bk, tk, *flags, save_lse)
+        return _pallas_call(
+            kernel, grid, [off_spec] + specs + aux_specs, out_specs,
+            _scratch(bq, d_v), out_shape, interpret, runsum,
+        )(off, *args, *aux_args)
 
     if mode == 'bounded':
         # Per-row upper bound on the (log2-unit) scores via Cauchy-Schwarz:
@@ -342,18 +583,16 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
         kn = jnp.sqrt(jnp.max(jnp.sum(k32 * k32, axis=-1), axis=-1))
         mvec = qn * kn[:, None, None] + 1.0                 # (nb, Tq, 1)
         mvecf = _pad_dim(mvec, 1, bq)
-        mvec_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+        mvec_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0))
 
         def run_bounded(*_):
             kernel = _make_fwd_kernel_bounded(
-                causal, bq, bk, tk, mask is not None, save_lse)
-            return pl.pallas_call(
-                kernel, grid=grid,
-                in_specs=[off_spec] + specs + [mvec_spec] + mask_specs,
-                out_specs=out_specs, out_shape=out_shape,
-                scratch_shapes=_scratch(bq, d_v)[1:],  # no m buffer
-                interpret=interpret,
-            )(off, *args, mvecf, *mask_args)
+                causal, bq, bk, tk, *flags, save_lse)
+            return _pallas_call(
+                kernel, grid, [off_spec] + specs + [mvec_spec] + aux_specs,
+                out_specs, _scratch(bq, d_v)[1:],  # no m buffer
+                out_shape, interpret, runsum,
+            )(off, *args, mvecf, *aux_args)
 
         # Safety net: the bound shift is only exact while
         # bound − true_rowmax stays inside fp32's exponent range; since
@@ -368,10 +607,9 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
         res = run_exact()
     out, lse = res if save_lse else (res, None)
     out = out[:, :tq].reshape(*batch, tq, d_v)
-    if mask is not None:
-        any_valid = _row_has_valid(mask, causal, tq, tk,
-                                   row_offset=off[0, 0])
-        out = jnp.where(any_valid, out, jnp.zeros((), out.dtype))
+    # No post-hoc empty-row zeroing: -inf masking makes the kernels emit
+    # exactly 0 for rows with no attendable key (see _apply_masks), so the
+    # O(Tq·Tk) any-valid reduction the wrapper used to run is pure cost.
     if save_lse:
         return out, lse[:, :tq, 0].reshape(*batch, tq)
     return out
@@ -383,7 +621,8 @@ def _scratch(bq, d_v):
             pltpu.VMEM((bq, d_v), jnp.float32)]
 
 
-def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
+def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
+                             has_mask_skip, save_lse):
     """Forward kernel for ``softmax_mode='bounded'``: the per-row shift is
     a precomputed upper bound on the row max (Cauchy-Schwarz,
     ``‖q_i‖·max_j‖k_j‖``, fed as an input), so the kernel drops the
@@ -397,11 +636,13 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
     the worst-case gap ``2·max(bound)`` exceeds ``_BOUNDED_SAFE_GAP``).
     """
     def kernel(*refs):
-        if has_mask:
-            off_ref, q_ref, k_ref, v_ref, m_ref, mask_ref, *rest = refs
+        if has_mask_skip:
+            runsum_ref, *refs = refs
         else:
-            off_ref, q_ref, k_ref, v_ref, m_ref, *rest = refs
-            mask_ref = None
+            runsum_ref = None
+        off_ref, q_ref, k_ref, v_ref, m_ref, *rest = refs
+        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
+         rest) = _split_aux(rest, has_mask, has_seg)
         if save_lse:
             o_ref, lse_ref, l_s, acc_s = rest
         else:
@@ -415,7 +656,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
-        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
+        run = _run_pred(causal, off_ref, qi, ki, bq, bk,
+                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -425,8 +667,11 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
+            mask_live = (None if runsum_ref is None else
+                         runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref)
+                             mask_ref, off_ref, segq_ref, segk_ref,
+                             mask_live)
             p = jnp.exp2(s - m_ref[0])                      # bound shift
             l_s[:] += p.sum(axis=-1, keepdims=True)
             acc_s[:] += jax.lax.dot_general(
@@ -446,15 +691,18 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, save_lse):
     return kernel
 
 
-def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
+def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
+                    has_mask_skip):
     def kernel(*refs):
-        if has_mask:
-            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-             mask_ref, dq_ref, dq_acc) = refs
+        if has_mask_skip:
+            runsum_ref, *refs = refs
         else:
-            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-             dq_ref, dq_acc) = refs
-            mask_ref = None
+            runsum_ref = None
+        (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         *rest) = refs
+        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
+         rest) = _split_aux(rest, has_mask, has_seg)
+        dq_ref, dq_acc = rest
         qi = pl.program_id(1)
         ki = pl.program_id(2)
         last_k = pl.num_programs(2) - 1
@@ -463,7 +711,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
-        run = _causal_run(causal, off_ref, qi, ki, bq, bk)
+        run = _run_pred(causal, off_ref, qi, ki, bq, bk,
+                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -478,8 +727,11 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
+            mask_live = (None if runsum_ref is None else
+                         runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref)
+                             mask_ref, off_ref, segq_ref, segk_ref,
+                             mask_live)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
@@ -496,15 +748,18 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask):
     return kernel
 
 
-def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
+def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
+                     has_mask_skip):
     def kernel(*refs):
-        if has_mask:
-            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-             mask_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        if has_mask_skip:
+            runsum_ref, *refs = refs
         else:
-            (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
-            mask_ref = None
+            runsum_ref = None
+        (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         *rest) = refs
+        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
+         rest) = _split_aux(rest, has_mask, has_seg)
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
         kj = pl.program_id(1)
         qi = pl.program_id(2)
         last_q = pl.num_programs(2) - 1
@@ -514,7 +769,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
-        run = _causal_run(causal, off_ref, qi, kj, bq, bk)
+        run = _run_pred(causal, off_ref, qi, kj, bq, bk,
+                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -529,8 +785,11 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)  # (BQ, BK), log2 units
+            mask_live = (None if runsum_ref is None else
+                         runsum_ref[pl.program_id(0), qi, kj] == 1)
             s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len,
-                             mask_ref, off_ref)
+                             mask_ref, off_ref, segq_ref, segk_ref,
+                             mask_live)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dv_acc[:] += jax.lax.dot_general(
                 p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -552,21 +811,18 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask):
 
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
-                    causal, interpret, zero_invalid_rows=True,
-                    grad_dtype=None):
+                    causal, interpret, grad_dtype=None, segment_ids=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
     ``dq = scale·ds·k``, ``dk = scale·dsᵀ·q``.
 
-    ``zero_invalid_rows=False`` skips the empty-row cotangent zeroing —
-    for callers (the ring path) whose ``mask`` is only one COLUMN BLOCK of
-    the full mask: a row empty in this block but attendable elsewhere has
-    near-zero weights here already, and zeroing its ``g`` by the block-local
-    test would wrongly kill its contribution. Such callers pre-zero ``g``
-    against the GLOBAL mask themselves. ``grad_dtype`` overrides the output
-    gradient dtype (the ring path accumulates per-block grads across W
-    steps and wants fp32 partials rather than W roundings to bf16).
+    Empty-row cotangents need no explicit zeroing: with -inf masking the
+    recomputed weights of such rows are exactly 0 (``lse`` clamps to
+    ``_NEG_BIG``), so every gradient term dies in-kernel. ``grad_dtype``
+    overrides the output gradient dtype (the ring path accumulates
+    per-block grads across W steps and wants fp32 partials rather than W
+    roundings to bf16).
     """
     *batch, tq, d = q.shape
     tk = k.shape[-2]
@@ -574,18 +830,14 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     nb = int(math.prod(batch)) if batch else 1
 
     off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
-    if mask is not None and zero_invalid_rows:
-        # Forward zeroed rows with no attendable key (counting causal), so
-        # their cotangent must not flow back through the (garbage-weight)
-        # softmax recompute.
-        any_valid = _row_has_valid(mask, causal, tq, tk,
-                                   row_offset=off[0, 0])
-        g = jnp.where(any_valid, g, jnp.zeros((), g.dtype))
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # (*batch, Tq, 1)
 
+    allow_redirect = (not interpret) or _REDIRECT_ON_INTERPRET
+    streams_mask = mask is not None and _mask_streams_per_tile(
+        nb, tq, tk, q.dtype, d + d_v, allow_redirect, bwd=True)
     bq, bk = _bwd_block_sizes(tq, tk, q.dtype, d_total=d + d_v,
-                              has_mask=mask is not None)
+                              has_mask=streams_mask)
     # Same exp2 pre-folding as the forward: q carries scale·log2e, lse is
     # converted to log2 units, so the kernels' (BQ, BK) score blocks need
     # no per-element multiply.
@@ -594,71 +846,66 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
     vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
     gf = _pad_dim(g.reshape(nb, tq, d_v), 1, bq)            # zero-padded
-    lsef = _pad_dim((lse * _LOG2E).reshape(nb, tq, 1), 1, bq)
+    # Clamp: a fully-masked row's lse is ln2·_NEG_BIG, whose ·log2e
+    # conversion overflows fp32 to -inf — and the kernels' recompute
+    # exp2(s − lse₂) with s = -inf (masked) would then be NaN. Clamped to
+    # the (finite) _NEG_BIG shift, masked entries recompute p = 0 exactly.
+    lsef = _pad_dim(jnp.maximum(lse * _LOG2E, _NEG_BIG)
+                    .reshape(nb, tq, 1), 1, bq)
     deltaf = _pad_dim(delta.reshape(nb, tq, 1), 1, bq)
     tq_p, tk_p = qf.shape[1], kf.shape[1]
 
     args = [qf, kf, vf, gf, lsef, deltaf]
-    has_mask = mask is not None
-    if has_mask:
-        maskf, mask_batch_index = _mask_setup(mask, batch, tq, tk,
-                                              tq_p, tk_p)
-        args.append(maskf)
+    aux_specs, aux_specs_t, aux_args, flags, runsum = _aux_setup(
+        mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
+        allow_redirect=allow_redirect)
 
-    off_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+    off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
 
     # --- dq pass: grid (batch, Q block, K block), K innermost ---
     dq_in_specs = [
         off_spec,
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bq, d_v), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-    ]
-    if has_mask:
-        dq_in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda b, i, j: (mask_batch_index(b), i, j)))
-    dq = pl.pallas_call(
-        _make_dq_kernel(scale, causal, bq, bk, tk, has_mask),
-        grid=(nb, tq_p // bq, tk_p // bk),
-        in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(off, *args)
+        pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, i, j, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
+    ] + aux_specs
+    dq = _pallas_call(
+        _make_dq_kernel(scale, causal, bq, bk, tk, *flags),
+        (nb, tq_p // bq, tk_p // bk), dq_in_specs,
+        pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
+        [pltpu.VMEM((bq, d), jnp.float32)],
+        jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
+        interpret, runsum,
+    )(off, *args, *aux_args)
 
     # --- dk/dv pass: grid (batch, K block, Q block), Q innermost ---
     dkv_in_specs = [
         off_spec,
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, d_v), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-    ]
-    if has_mask:
-        dkv_in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda b, j, i: (mask_batch_index(b), i, j)))
-    dk, dv = pl.pallas_call(
-        _make_dkv_kernel(scale, causal, bq, bk, tk, has_mask),
-        grid=(nb, tk_p // bk, tq_p // bq),
-        in_specs=dkv_in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d_v), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bq, d_v), lambda b, j, i, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, j, i, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, j, i, *rs: (b, i, 0)),
+    ] + aux_specs_t
+    dk, dv = _pallas_call(
+        _make_dkv_kernel(scale, causal, bq, bk, tk, *flags),
+        (nb, tk_p // bk, tq_p // bq), dkv_in_specs,
+        [
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
+            pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
         ],
-        out_shape=[
+        [pltpu.VMEM((bk, d), jnp.float32),
+         pltpu.VMEM((bk, d_v), jnp.float32)],
+        [
             jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
             jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d_v), jnp.float32)],
-        interpret=interpret,
-    )(off, *args)
+        interpret, runsum,
+    )(off, *args, *aux_args)
 
     dq = dq[:, :tq].reshape(q.shape)
     dk = dk[:, :tk].reshape(k.shape)
@@ -683,38 +930,57 @@ def _reference_math(q, k, v, mask, scale, causal):
     return out.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, mask, causal_offset, scale, causal, interpret, mode):
+def _seg_pair(seg_q, seg_k):
+    return None if seg_q is None else (seg_q, seg_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, scale, causal,
+           interpret, mode):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
-                           interpret, mode)
+                           interpret, mode,
+                           segment_ids=_seg_pair(seg_q, seg_k))
 
 
-def _flash_fwd(q, k, v, mask, causal_offset, scale, causal, interpret,
-               mode):
+def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, scale, causal,
+               interpret, mode):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
-                               interpret, mode, save_lse=True)
-    return out, (q, k, v, mask, causal_offset, out, lse)
+                               interpret, mode, save_lse=True,
+                               segment_ids=_seg_pair(seg_q, seg_k))
+    return out, (q, k, v, mask, causal_offset, seg_q, seg_k, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, mode, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
-    q, k, v, mask, causal_offset, out, lse = res
+    q, k, v, mask, causal_offset, seg_q, seg_k, out, lse = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
-                                 scale, causal, interpret)
-    return dq, dk, dv, None, None
+                                 scale, causal, interpret,
+                                 segment_ids=_seg_pair(seg_q, seg_k))
+    return dq, dk, dv, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
-                    scale=None, interpret=None, softmax_mode='exact'):
+                    scale=None, interpret=None, softmax_mode='exact',
+                    segment_ids=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
     boolean ``mask (..., Tq, Tk)`` broadcastable over the leading dims
     (True = masked out, the reference's convention, reference README.md:67).
+    ``segment_ids``: the compact packed-sequence mask form — a
+    ``(seg_q, seg_kv)`` pair of non-negative int arrays with trailing
+    shapes ``(Tq,)`` / ``(Tk,)`` (leading dims broadcastable like the
+    mask's), or a single ``(..., T)`` array used for both sides when
+    ``Tq == Tk``. Positions in different segments don't attend — the same
+    semantics as the dense ``mask[i, j] = seg_q[i] != seg_kv[j]`` with
+    O(T) instead of O(Tq·Tk) HBM traffic, and (Q block, K block) pairs
+    with provably disjoint id ranges are skipped outright. Composes with
+    ``mask`` and ``causal`` (union of maskings); rows left with no
+    attendable key output 0 with zero gradients.
     Differentiable end-to-end with blockwise Pallas kernels in both
     directions — peak memory is O(T·d) for forward AND backward (the
     backward recomputes score blocks from the saved row logsumexp).
@@ -750,5 +1016,15 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
-    return _flash(q, k, v, mask, causal_offset, float(scale), bool(causal),
-                  bool(interpret), softmax_mode)
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            seg_q, seg_k = segment_ids
+        else:
+            if q.shape[-2] != k.shape[-2]:
+                raise ValueError(
+                    'a single segment_ids array needs Tq == Tk; pass a '
+                    '(seg_q, seg_kv) pair for cross-length attention')
+            seg_q = seg_k = segment_ids
+    return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, float(scale),
+                  bool(causal), bool(interpret), softmax_mode)

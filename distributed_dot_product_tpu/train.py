@@ -52,16 +52,20 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     ``data_axis``: name of the batch mesh axis, or None for pure SP.
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
-    where ``batch = (keys, queries, values, attn_mask, target)`` holds
-    *global* arrays; activations are sharded ``(batch→data, time→seq)``,
-    parameters and optimizer state stay replicated (the reference's
-    weight-replication convention, reference test_gradient.py:48).
+    where ``batch = (keys, queries, values, attn_mask, target)`` — or
+    ``(..., target, segment_ids)`` with a global ``(B, T)`` packed-sequence
+    id array — holds *global* arrays; activations are sharded
+    ``(batch→data, time→seq)``, parameters and optimizer state stay
+    replicated (the reference's weight-replication convention, reference
+    test_gradient.py:48).
     """
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
 
-    def local_step(params, opt_state, keys, queries, values, mask, target):
+    def local_step(params, opt_state, keys, queries, values, mask, target,
+                   seg):
         def local_loss(p):
-            out = module.apply(p, keys, queries, values, mask)
+            out = module.apply(p, keys, queries, values, mask,
+                               segment_ids=seg)
             l = loss_fn(out, target)
             for ax in axes:
                 l = lax.pmean(l, ax)
@@ -83,16 +87,20 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
         return P(*names)
 
     a3 = act_spec(3)
+    # segment_ids (B, T): time on the LAST axis (not -2 like activations).
+    seg_spec = (P(None, seq_axis) if data_axis is None
+                else P(data_axis, seq_axis))
     sharded = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), a3, a3, a3, a3, a3),
+        in_specs=(P(), P(), a3, a3, a3, a3, a3, seg_spec),
         out_specs=(P(), P(), P()),
         check_vma=False)
 
     def step(params, opt_state, batch):
-        keys, queries, values, mask, target = batch
+        keys, queries, values, mask, target, *rest = batch
+        seg = rest[0] if rest else None
         return sharded(params, opt_state, keys, queries, values, mask,
-                       target)
+                       target, seg)
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
