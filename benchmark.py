@@ -90,6 +90,16 @@ def parse_args():
                         help='skip the single-device full-size baseline')
     parser.add_argument('--profile-dir', default=None,
                         help='write a jax.profiler trace here')
+    # Multi-host measurement surface (the reference gathers per-rank stats
+    # to rank 0 via MPI.gather and averages, reference benchmark.py:104-117)
+    parser.add_argument('--multihost', action='store_true',
+                        help='join a multi-process run via comm.init(); '
+                             'per-process measurements are allgathered, '
+                             'process 0 writes the averaged record')
+    parser.add_argument('--coordinator', default=None,
+                        help='coordinator address host:port (multihost)')
+    parser.add_argument('--num-processes', type=int, default=None)
+    parser.add_argument('--process-id', type=int, default=None)
     return parser.parse_args()
 
 
@@ -185,11 +195,12 @@ def run_attn(args):
                 f'attn_impl=full needs ~{need / 2**30:.1f} GiB of score '
                 f'buffers per device; raise --scale or use more devices')
 
+    from distributed_dot_product_tpu.parallel.mesh import globalize
     keys = jax.random.split(jax.random.key(111), 3)
     shape = (1, h, t, d)
     spec = P(None, None, SEQ_AXIS, None)
-    q, k, v = (jax.device_put(jax.random.normal(kk, shape, dtype),
-                              NamedSharding(mesh, spec)) for kk in keys)
+    q, k, v = (globalize(jax.random.normal(kk, shape, dtype),
+                         NamedSharding(mesh, spec)) for kk in keys)
 
     # Every impl runs through shard_map (a W=1 mesh degenerates cleanly), so
     # the recorded attn_impl always names the code path actually measured.
@@ -303,19 +314,23 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     if mask_kind not in ('dense', 'none', 'segments'):
         raise ValueError(f'unknown mask_kind {mask_kind!r}')
 
+    from distributed_dot_product_tpu.parallel.mesh import globalize
+
     k1, k2 = jax.random.split(jax.random.key(111))
     x_host = jax.random.normal(k1, (1, t, DIM), jdtype)
     target_host = jax.random.normal(k2, (1, t, DIM), jdtype)
     act = NamedSharding(mesh, P(None, SEQ_AXIS, None))
-    x = jax.device_put(x_host, act)
-    target = jax.device_put(target_host, act)
-    mask = None if mask_kind != 'dense' else jax.device_put(
+    # globalize: same-seeded host arrays exist in every process, so this
+    # works unchanged when --multihost splits the mesh across processes.
+    x = globalize(x_host, act)
+    target = globalize(target_host, act)
+    mask = None if mask_kind != 'dense' else globalize(
         jnp.zeros((1, t, t), dtype=bool),
         NamedSharding(mesh, P(None, SEQ_AXIS, None)))
     seg = None
     if mask_kind == 'segments':
         # n_segments equal packed spans — the compact O(T) mask form.
-        seg = jax.device_put(
+        seg = globalize(
             (jnp.arange(t, dtype=jnp.int32) * n_segments // t)[None],
             NamedSharding(mesh, P(None, SEQ_AXIS)))
 
@@ -373,8 +388,44 @@ def run_train(args):
     return record
 
 
+# Per-process measurements averaged across hosts (the reference's
+# MPI.gather-to-rank-0-and-average, reference benchmark.py:104-117); the
+# throughput fields derived from them are rescaled to match.
+_MH_TIME_KEYS = ('local_time', 'local_time_mean', 'dist_time',
+                 'dist_time_mean', 'step_time', 'step_time_mean')
+_MH_RATE_KEYS = {'dist_gflops_per_chip': 'dist_time',
+                 'step_gflops_per_chip': 'step_time',
+                 'local_gflops': 'local_time'}
+
+
+def _multihost_aggregate(record):
+    """Average the timing fields over all processes; every process returns
+    the same aggregated record (process 0 is the only writer)."""
+    if jax.process_count() == 1:
+        return record
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.array([float(record[k]) if record.get(k) is not None
+                      else np.nan for k in _MH_TIME_KEYS], np.float64)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    rec = dict(record)
+    for i, k in enumerate(_MH_TIME_KEYS):
+        if record.get(k) is not None:
+            rec[k] = float(np.mean(gathered[:, i]))
+    for rate, timek in _MH_RATE_KEYS.items():
+        if record.get(rate) is not None and record.get(timek):
+            rec[rate] = record[rate] * record[timek] / rec[timek]
+    rec['n_processes'] = jax.process_count()
+    return rec
+
+
 def _append_record(path, record):
     # Append-to-JSON-file convention (reference benchmark.py:42-44,241-253).
+    # Multihost: aggregate everywhere (collective), write on process 0 only.
+    record = _multihost_aggregate(record)
+    if jax.process_index() != 0:
+        return record
     results = []
     if os.path.exists(path):
         with open(path) as f:
@@ -382,6 +433,7 @@ def _append_record(path, record):
     results.append(record)
     with open(path, 'w') as f:
         json.dump(results, f, indent=2)
+    return record
 
 
 def run(args):
@@ -471,5 +523,16 @@ def run(args):
     return record
 
 
+def main():
+    args = parse_args()
+    if args.multihost:
+        from distributed_dot_product_tpu.utils import comm
+        comm.init(coordinator_address=args.coordinator,
+                  num_processes=args.num_processes,
+                  process_id=args.process_id)
+        comm.synchronize()
+    return run(args)
+
+
 if __name__ == '__main__':
-    run(parse_args())
+    main()

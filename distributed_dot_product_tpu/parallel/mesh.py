@@ -73,12 +73,29 @@ def replicated_spec():
     return P()
 
 
+def globalize(x, sharding):
+    """Place a host array onto a (possibly multi-host) sharding.
+
+    Single-process this is ``jax.device_put``. Multi-host, every process
+    must hold the SAME full array (e.g. same-seeded RNG or deterministic
+    construction) and each device picks out its own shard — the standard
+    replacement for the reference's per-rank ``tensor[rank]`` slicing
+    (reference test_multiplication.py:127-128) when one process cannot
+    address all devices.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(x.shape, sharding,
+                                        lambda idx: x[idx])
+
+
 def shard_seq(x, mesh, seq_axis=-2, mesh_axis=SEQ_AXIS):
     """Place a global array on ``mesh`` sharded along its time axis.
 
     Replaces the reference's manual per-rank slicing (``tensor[rank]``,
     reference test_multiplication.py:127-128) — here the global array stays
-    a single ``jax.Array`` whose shards live on the devices.
+    a single ``jax.Array`` whose shards live on the devices (works
+    multi-host via :func:`globalize`).
     """
     spec = seq_spec(x.ndim, seq_axis=seq_axis, mesh_axis=mesh_axis)
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    return globalize(x, NamedSharding(mesh, spec))

@@ -69,3 +69,58 @@ def test_two_process_mesh_matches_single_process(tmp_path):
     from multihost_worker import run_step
     single_loss = run_step(8)
     np.testing.assert_allclose(multi_loss, single_loss, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_benchmark_aggregation(tmp_path):
+    """``benchmark.py --multihost``: 2 localhost processes × 4 virtual CPU
+    devices form one 8-device mesh; per-process measurements are
+    allgathered and process 0 writes ONE averaged record — the reference's
+    MPI.gather-to-rank-0 measurement surface (reference
+    benchmark.py:104-117)."""
+    import json
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+    env['PYTHONPATH'] = _REPO + os.pathsep + env.get('PYTHONPATH', '')
+    out_file = str(tmp_path / 'bench.json')
+
+    def code(pid):
+        argv = ['benchmark.py', '--multihost', '--mode', 'train',
+                '--seq-len', '64', '--iters', '1', '--attn-impl', 'flash',
+                '--heads', '4', '--num-processes', '2',
+                '--process-id', str(pid),
+                '--coordinator', f'127.0.0.1:{port}', '--file', out_file]
+        return ('import jax, sys; '
+                "jax.config.update('jax_platforms', 'cpu'); "
+                "jax.config.update('jax_num_cpu_devices', 4); "
+                f'sys.argv = {argv!r}; '
+                'import benchmark; benchmark.main()')
+
+    procs = [subprocess.Popen([sys.executable, '-c', code(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              env=env, cwd=_REPO)
+             for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f'benchmark process failed:\n{out}'
+
+    with open(out_file) as f:
+        records = json.load(f)
+    assert len(records) == 1, records       # process 0 is the only writer
+    rec = records[0]
+    assert rec['n_processes'] == 2
+    assert rec['world'] == 8                # one global mesh, both hosts
+    assert rec['step_time'] > 0 and np.isfinite(rec['step_gflops_per_chip'])
