@@ -88,6 +88,11 @@ class DistributedDotProductAttn(nn.Module):
     impl: str = 'allgather'
     # 'full' (parity) | 'online' (ring) | 'flash' | 'ulysses'
     softmax_impl: str = 'full'
+    # softmax_impl='online' + causal only: 'zigzag' balances the causal
+    # ring's critical path (shard i holds half-stripes {i, 2W-1-i}; feed
+    # inputs permuted by models.ring_attention.zigzag_indices and invert
+    # on the output). Requires attn_mask=None and no segment_ids.
+    ring_layout: str = 'contiguous'
     # For softmax_impl='flash': 'exact' running-max softmax, or 'bounded'
     # (norm-bound shift — faster at small head dim; see
     # ops.pallas_attention.flash_attention for the accuracy contract).
@@ -289,7 +294,7 @@ class DistributedDotProductAttn(nn.Module):
                 outputs = ring_attention(
                     keys, queries, values, attn_mask,
                     axis_name=self.axis_name, scale=scale,
-                    causal=native_causal)
+                    causal=native_causal, layout=self.ring_layout)
             else:
                 outputs = local_attention_reference(
                     keys, queries, values, attn_mask, scale=scale,

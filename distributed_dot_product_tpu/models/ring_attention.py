@@ -60,7 +60,7 @@ from distributed_dot_product_tpu.ops.pallas_attention import (
 )
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
-__all__ = ['ring_attention', 'local_attention_reference']
+__all__ = ['ring_attention', 'local_attention_reference', 'zigzag_indices']
 
 
 def _mask_bias(mask, dtype):
@@ -71,7 +71,8 @@ def _mask_bias(mask, dtype):
 
 
 def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
-                   scale=None, precision=None, block_impl='flash'):
+                   scale=None, precision=None, block_impl='flash',
+                   layout='contiguous'):
     """Sequence-parallel attention with O((T/N)²) score memory.
 
     ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
@@ -86,10 +87,39 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     ``(..., T/N, d_v)`` and are differentiable; gradients use O((T/N)²)
     score memory (the flash backend's VJP is a second ring pass that
     carries ``(dk, dv)`` partial sums with the rotating blocks).
+
+    ``layout``: how shard i's rows map to GLOBAL positions.
+
+    - ``'contiguous'`` (default): rows ``[i·T/N, (i+1)·T/N)`` — but under
+      ``causal=True`` the work is imbalanced: shard 0 attends 1 block,
+      shard W−1 attends all W, and since ring folds are sequential the
+      LAST shard's W folds set the wall-clock (the skip halves average
+      compute, not the critical path).
+    - ``'zigzag'``: shard i holds the two half-stripes ``i`` and
+      ``2W−1−i`` of length T/2N — every shard then attends W+1
+      half-blocks, balancing the causal critical path (~2× faster steps
+      at large W). Requires ``causal=True``, ``block_impl='flash'``, an
+      even per-shard length and ``mask=None``/no segments (a (T/N, T)
+      mask's columns are contiguous-global; re-indexing it per layout is
+      not implemented). Use :func:`zigzag_indices` to permute global
+      arrays into (and out of) this layout.
     """
     if block_impl not in ('flash', 'xla'):
         raise ValueError(
             f"block_impl must be 'flash' or 'xla', got {block_impl!r}")
+    if layout not in ('contiguous', 'zigzag'):
+        raise ValueError(
+            f"layout must be 'contiguous' or 'zigzag', got {layout!r}")
+    if layout == 'zigzag':
+        if not causal or block_impl != 'flash' or mask is not None:
+            raise ValueError(
+                "layout='zigzag' balances the CAUSAL critical path and "
+                "needs block_impl='flash' with mask=None (mask columns "
+                'are contiguous-global; per-layout re-indexing is not '
+                'implemented)')
+        if q.shape[-2] % 2:
+            raise ValueError('zigzag needs an even per-shard length '
+                             f'(got T/N = {q.shape[-2]})')
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
     if block_impl == 'flash':
         if precision is not None:
@@ -101,7 +131,7 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                 '(the flash kernels fix fp32 MXU accumulation)')
         interpret = jax.default_backend() != 'tpu'
         return _ring_flash(q, k, v, mask, axis_name, bool(causal),
-                           float(scale), bool(interpret))
+                           float(scale), bool(interpret), layout)
     return _ring_xla(q, k, v, mask, axis_name=axis_name, causal=causal,
                      scale=scale, precision=precision)
 
@@ -137,7 +167,36 @@ def _blk_mask(mask, owner, tn):
     return lax.dynamic_slice_in_dim(mask, owner * tn, tn, axis=-1)
 
 
-def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
+def _layout_positions(layout, shard, world, tn):
+    """Shard→global position vector ``(tn,)`` for non-contiguous layouts
+    (``shard`` may be traced — ``lax.axis_index`` or a ring owner).
+    zigzag: the half-stripes ``shard`` and ``2W−1−shard``."""
+    if layout == 'contiguous':
+        return None
+    h = tn // 2
+    return jnp.concatenate([shard * h + jnp.arange(h),
+                            (2 * world - 1 - shard) * h + jnp.arange(h)])
+
+
+def zigzag_indices(t, world):
+    """Global→zigzag gather indices: ``x_zig = x[..., idx, :]`` places a
+    ``(…, T, …)`` array so that contiguous sharding over ``world`` devices
+    gives shard i the half-stripes {i, 2W−1−i} that
+    ``ring_attention(layout='zigzag')`` expects. The inverse (for outputs)
+    is ``jnp.argsort(idx)``."""
+    if t % (2 * world):
+        raise ValueError(f'T={t} must divide into 2·world={2 * world} '
+                         'half-stripes')
+    h = t // (2 * world)
+    import numpy as np
+    return jnp.asarray(np.concatenate([
+        np.concatenate([i * h + np.arange(h),
+                        (2 * world - 1 - i) * h + np.arange(h)])
+        for i in range(world)]))
+
+
+def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
+                         layout='contiguous'):
     """Forward ring: per block, the flash kernel returns the block-local
     normalized output ``out_b`` and row logsumexp ``lse_b``; blocks merge by
     the shift-invariant identity ``num += e^{lse_b − m}·out_b,
@@ -150,6 +209,7 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
     W = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     tn = q.shape[-2]
+    my_pos = _layout_positions(layout, idx, W, tn)
 
     m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
     den0 = jnp.zeros(q.shape[:-1], jnp.float32)
@@ -161,12 +221,24 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
 
         def compute(acc):
             m, den, num = acc
-            # causal_offset = global row 0 of q MINUS global col 0 of the
-            # block: the kernel's causal triangle and block-skip then work
-            # over global positions with no materialized mask.
-            out_b, lse_b = _flash_fwd_impl(
-                q, k_buf, v_buf, _blk_mask(mask, owner, tn),
-                (idx - owner) * tn, scale, causal, interpret, save_lse=True)
+            # Contiguous: causal_offset = global row 0 of q MINUS global
+            # col 0 of the block — the kernel's causal triangle and
+            # block-skip then work over global positions with no
+            # materialized mask. Zigzag: explicit per-row/col position
+            # vectors instead (the rows aren't one contiguous run); the
+            # kernel skips provably-future blocks from their position
+            # interval tables.
+            if my_pos is None:
+                out_b, lse_b = _flash_fwd_impl(
+                    q, k_buf, v_buf, _blk_mask(mask, owner, tn),
+                    (idx - owner) * tn, scale, causal, interpret,
+                    save_lse=True)
+            else:
+                out_b, lse_b = _flash_fwd_impl(
+                    q, k_buf, v_buf, None, 0, scale, False, interpret,
+                    save_lse=True,
+                    positions=(my_pos,
+                               _layout_positions(layout, owner, W, tn)))
             # A block-empty row (all its columns masked / causal-future)
             # has lse_b ≈ log-of-large-finite-negative ⇒ combine weight 0:
             # garbage block outputs never enter the merge.
@@ -178,7 +250,11 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
                    + c_blk[..., None] * out_b.astype(jnp.float32))
             return m_new, den, num
 
-        if not causal:
+        if not causal or my_pos is not None:
+            # Zigzag: every (shard, owner) pair owns some past half-block
+            # (that is the point — balanced folds), so there is no
+            # whole-fold skip; the kernel still skips future HALF-blocks
+            # from the position interval tables.
             return rot, compute(acc)
         # Whole-block causal skip: the owner's column range lies entirely
         # in this shard's future — not even a kernel launch. (The kernel
@@ -200,7 +276,7 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret):
 
 
 def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
-                         scale, interpret):
+                         scale, interpret, layout='contiguous'):
     """Backward ring: the flash backward decomposes over K/V blocks given
     the GLOBAL ``lse`` (and ``Δ = rowsum(g·out)``), so a second ring pass
     rotates ``(k, v, dk, dv)`` together — each rank folds its dq
@@ -211,6 +287,7 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     W = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     tn = q.shape[-2]
+    my_pos = _layout_positions(layout, idx, W, tn)
     # Empty-row cotangents need no pre-zeroing: an empty row's global lse
     # clamps to _NEG_BIG in every per-block backward, where its recomputed
     # weights are exactly 0 — all its gradient terms die in-kernel.
@@ -221,13 +298,20 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
 
         def compute(args):
             dq, dk_buf, dv_buf = args
-            dq_b, dk_b, dv_b = _flash_bwd_impl(
-                q, k_buf, v_buf, _blk_mask(mask, owner, tn),
-                (idx - owner) * tn, out, lse, g, scale, causal, interpret,
-                grad_dtype=jnp.float32)
+            if my_pos is None:
+                dq_b, dk_b, dv_b = _flash_bwd_impl(
+                    q, k_buf, v_buf, _blk_mask(mask, owner, tn),
+                    (idx - owner) * tn, out, lse, g, scale, causal,
+                    interpret, grad_dtype=jnp.float32)
+            else:
+                dq_b, dk_b, dv_b = _flash_bwd_impl(
+                    q, k_buf, v_buf, None, 0, out, lse, g, scale, False,
+                    interpret, grad_dtype=jnp.float32,
+                    positions=(my_pos,
+                               _layout_positions(layout, owner, W, tn)))
             return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
 
-        if causal:
+        if causal and my_pos is None:
             dq, dk_buf, dv_buf = lax.cond(
                 owner > idx, lambda a: a, compute, (dq, dk_buf, dv_buf))
         else:
@@ -245,23 +329,24 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret, layout):
     out, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                  interpret)
+                                  interpret, layout)
     return out
 
 
-def _ring_flash_vjp_fwd(q, k, v, mask, axis_name, causal, scale, interpret):
+def _ring_flash_vjp_fwd(q, k, v, mask, axis_name, causal, scale, interpret,
+                        layout):
     out, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                    interpret)
+                                    interpret, layout)
     return out, (q, k, v, mask, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, layout, res, g):
     q, k, v, mask, out, lse = res
     dq, dk, dv = _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name,
-                                      causal, scale, interpret)
+                                      causal, scale, interpret, layout)
     return dq, dk, dv, None
 
 

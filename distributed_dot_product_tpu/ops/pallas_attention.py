@@ -98,7 +98,7 @@ def _pad_dim(x, axis, mult):
 
 
 def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
-                 segq_ref=None, segk_ref=None, mask_live=None):
+                 seg=None, pos=None, mask_live=None):
     """Shared logit masking: user mask block, segment ids, causal future,
     Tk padding.
 
@@ -107,9 +107,12 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
     blocks natively. ``off_ref`` (scalar, (1, 1) int32) holds the GLOBAL
     index of query row 0 — sequence-sharded callers pass their shard's
     offset so the causal triangle is over global positions with no
-    materialized mask. ``segq_ref``/``segk_ref`` are (1, B, 1)/(1, 1, B)
-    int32 segment-id blocks: positions in different segments are masked —
-    the packed-sequence mask form with O(T) (not O(T²)) HBM traffic.
+    materialized mask. ``seg``/``pos`` carry (1, B, 1)/(1, 1, B) int32
+    per-position vector blocks (plus their SMEM skip tables, unused here):
+    ``seg`` masks pairs in different segments (the packed-sequence mask
+    form, O(T) not O(T²) HBM traffic); ``pos`` masks pairs where the query
+    GLOBAL position precedes the key's — causal over arbitrary row
+    layouts (zigzag/striped sharding).
 
     Masked logits are ``-inf``, NOT the large-finite ``_NEG_BIG``: every
     kernel shifts ``s`` by a value clamped ≥ ``_NEG_BIG`` (the running-max
@@ -128,8 +131,10 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
             # must not be applied (``mask_live`` = this tile is mixed).
             masked = jnp.logical_and(masked, mask_live)
         s = jnp.where(masked, -jnp.inf, s)
-    if segq_ref is not None:
-        s = jnp.where(segq_ref[0] != segk_ref[0], -jnp.inf, s)
+    if seg is not None:
+        s = jnp.where(seg[0][0] != seg[1][0], -jnp.inf, s)
+    if pos is not None:
+        s = jnp.where(pos[0][0] < pos[1][0], -jnp.inf, s)
     if causal:
         rows = (off_ref[0, 0] + qi * bq
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
@@ -228,32 +233,49 @@ def _mask_setup(mask, batch, tq, tk, tq_p, tk_p):
     return maskf, _batch_index_fn(batch, mlead), mlead
 
 
-def _seg_setup(segment_ids, batch, tq, tk, tq_p, tk_p):
-    """Prepare the segment-id pair for the kernels: ``(seg_q, seg_kv)``
-    int arrays of trailing shape ``(Tq,)`` / ``(Tk,)`` (leading dims
-    broadcastable against q/k/v like a mask's). Returns the padded flat
-    column/row vectors ``(nq, Tq_p, 1)`` / ``(nk, 1, Tk_p)``, their
-    batch-index maps, and their lead dims.
-
-    Ids must be non-negative: Q padding uses sentinel −1 and K padding −2,
-    so padded positions never match anything (and padded K columns stay
-    masked even without the ``kv_len % bk`` guard).
-    """
-    seg_q, seg_k = segment_ids
-    if seg_q.shape[-1] != tq or seg_k.shape[-1] != tk:
+def _vec_setup(kind, pair, batch, tq, tk, tq_p, tk_p, pad_q, pad_k):
+    """Prepare a per-position int vector pair for the kernels (segment ids
+    or global positions): ``(vec_q, vec_kv)`` with trailing shapes
+    ``(Tq,)`` / ``(Tk,)`` (leading dims broadcastable against q/k/v like a
+    mask's). Returns the padded flat column/row vectors ``(nq, Tq_p, 1)``
+    / ``(nk, 1, Tk_p)``, their batch-index maps, and their lead dims.
+    ``pad_q``/``pad_k`` are the padding sentinels (chosen per use so
+    padded positions always end up masked)."""
+    vec_q, vec_k = pair
+    if vec_q.shape[-1] != tq or vec_k.shape[-1] != tk:
         raise ValueError(
-            f'segment_ids trailing dims ({seg_q.shape[-1]}, '
-            f'{seg_k.shape[-1]}) must equal (Tq, Tk) = {(tq, tk)}')
-    qlead = _bcast_lead('segment_ids[0]', seg_q.shape[:-1], batch, 1)
-    klead = _bcast_lead('segment_ids[1]', seg_k.shape[:-1], batch, 1)
+            f'{kind} trailing dims ({vec_q.shape[-1]}, '
+            f'{vec_k.shape[-1]}) must equal (Tq, Tk) = {(tq, tk)}')
+    qlead = _bcast_lead(f'{kind}[0]', vec_q.shape[:-1], batch, 1)
+    klead = _bcast_lead(f'{kind}[1]', vec_k.shape[:-1], batch, 1)
     nq = int(math.prod(qlead)) if qlead else 1
     nk = int(math.prod(klead)) if klead else 1
-    segqf = jnp.pad(seg_q.astype(jnp.int32).reshape(nq, tq, 1),
-                    ((0, 0), (0, tq_p - tq), (0, 0)), constant_values=-1)
-    segkf = jnp.pad(seg_k.astype(jnp.int32).reshape(nk, 1, tk),
-                    ((0, 0), (0, 0), (0, tk_p - tk)), constant_values=-2)
-    return (segqf, _batch_index_fn(batch, qlead), qlead,
-            segkf, _batch_index_fn(batch, klead), klead)
+    vqf = jnp.pad(vec_q.astype(jnp.int32).reshape(nq, tq, 1),
+                  ((0, 0), (0, tq_p - tq), (0, 0)), constant_values=pad_q)
+    vkf = jnp.pad(vec_k.astype(jnp.int32).reshape(nk, 1, tk),
+                  ((0, 0), (0, 0), (0, tk_p - tk)), constant_values=pad_k)
+    return (vqf, _batch_index_fn(batch, qlead), qlead,
+            vkf, _batch_index_fn(batch, klead), klead)
+
+
+def _seg_setup(segment_ids, batch, tq, tk, tq_p, tk_p):
+    """Segment-id pair: ids must be non-negative — Q padding uses sentinel
+    −1 and K padding −2, so padded positions never match anything (and
+    padded K columns stay masked even without the ``kv_len % bk``
+    guard)."""
+    return _vec_setup('segment_ids', segment_ids, batch, tq, tk, tq_p,
+                      tk_p, -1, -2)
+
+
+def _pos_setup(positions, batch, tq, tk, tq_p, tk_p):
+    """Explicit-global-position pair for causal masking over ARBITRARY row
+    layouts (zigzag/striped sequence sharding): entry (i, j) is masked
+    when ``pos_q[i] < pos_kv[j]``. Positions must be non-negative; Q pads
+    with −1 (< every real position ⇒ padded rows fully masked) and K pads
+    with a huge sentinel (> every real position ⇒ padded columns
+    masked)."""
+    return _vec_setup('positions', positions, batch, tq, tk, tq_p, tk_p,
+                      -1, 2 ** 30)
 
 
 
@@ -293,28 +315,35 @@ def _mask_streams_per_tile(nb, tq, tk, dtype, d_total, allow_redirect,
     return nb * (-(-tq // bq)) * (-(-tk // bk)) * 4 > _RUNSUM_SMEM_CAP
 
 
-def _split_aux(rest, has_mask, has_seg):
-    """Pop the optional (mask, seg_q, seg_k, qmm, kmm) refs off the input
-    tail shared by every kernel signature (the block-skip summary rides
-    the scalar-prefetch slot instead, always ref 0)."""
-    mask_ref = segq_ref = segk_ref = qmm_ref = kmm_ref = None
+def _split_aux(rest, has_mask, has_seg, has_pos):
+    """Pop the optional (mask, segments, positions) ref groups off the
+    input tail shared by every kernel signature (the block-skip summary
+    rides the scalar-prefetch slot instead, always ref 0). Segments and
+    positions each contribute (vec_q, vec_k, qmm, kmm) refs."""
+    mask_ref = seg = pos = None
     if has_mask:
         mask_ref, *rest = rest
     if has_seg:
-        segq_ref, segk_ref, qmm_ref, kmm_ref, *rest = rest
-    return mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref, rest
+        vq, vk, qmm, kmm, *rest = rest
+        seg = (vq, vk, qmm, kmm)
+    if has_pos:
+        vq, vk, qmm, kmm, *rest = rest
+        pos = (vq, vk, qmm, kmm)
+    return mask_ref, seg, pos, rest
 
 
-def _run_pred(causal, off_ref, qi, ki, bq, bk, b, qmm_ref, kmm_ref,
-              runsum_ref):
+def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref):
     """Combined block-skip predicate from scalar SMEM tables (vector
     reductions to scalars trip Mosaic relayouts, and (1, 1, ·) VMEM blocks
     are rejected outright — SMEM with program-id indexing is the TPU way):
 
     - causal: the K block lies strictly in every query row's future;
-    - segments (``qmm/kmm``, per-block [min, max] id intervals): disjoint
-      intervals cannot contain an equal pair — true for ANY id layout,
-      tight for the sorted ids of packed sequences;
+    - segments (per-block [min, max] id intervals): disjoint intervals
+      cannot contain an equal pair — true for ANY id layout, tight for
+      the sorted ids of packed sequences;
+    - positions (per-block [min, max] global positions): a block whose
+      every query position precedes its every key position is fully in
+      the causal future — the zigzag/striped analog of the causal skip;
     - dense mask (``runsum``, precomputed any-unmasked-entry per block
       pair): skips the matmuls of fully-masked tiles (their mask block DMA
       is already paid — compute only).
@@ -328,16 +357,19 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, qmm_ref, kmm_ref,
     def _and(a, x):
         return x if a is True else jnp.logical_and(a, x)
 
-    if qmm_ref is not None:
-        run = _and(run, jnp.logical_and(
-            qmm_ref[b, qi, 0] <= kmm_ref[b, ki, 1],
-            kmm_ref[b, ki, 0] <= qmm_ref[b, qi, 1]))
+    if seg is not None:
+        _, _, qmm, kmm = seg
+        run = _and(run, jnp.logical_and(qmm[b, qi, 0] <= kmm[b, ki, 1],
+                                        kmm[b, ki, 0] <= qmm[b, qi, 1]))
+    if pos is not None:
+        _, _, qmm, kmm = pos
+        run = _and(run, qmm[b, qi, 1] >= kmm[b, ki, 0])
     if runsum_ref is not None:
         run = _and(run, runsum_ref[b, qi, ki] != 0)
     return run
 
 
-def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
+def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
                      has_mask_skip, save_lse):
     def kernel(*refs):
         if has_mask_skip:
@@ -345,8 +377,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
         else:
             runsum_ref = None
         off_ref, q_ref, k_ref, v_ref, *rest = refs
-        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
-         rest) = _split_aux(rest, has_mask, has_seg)
+        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
+                                              has_pos)
         if save_lse:
             o_ref, lse_ref, m_s, l_s, acc_s = rest
         else:
@@ -364,7 +396,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
         # Block skip: K block strictly in the causal future of every query
         # row, or provably fully masked → contributes nothing.
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -384,8 +416,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, segq_ref, segk_ref,
-                             mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -414,8 +445,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg,
     return kernel
 
 
-def _aux_setup(mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
-               allow_redirect=True):
+def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
+               bq, bk, allow_redirect=True):
     """Specs (both grid orders) + args + presence flags for the optional
     (mask, segments, block-skip table) kernel inputs, shared by the
     forward and both backward passes — args are computed ONCE (the int8
@@ -466,21 +497,24 @@ def _aux_setup(mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
         specs_t.append(pl.BlockSpec(
             (1, bq, bk), lambda b, j, i, *rs: mask_map(b, i, j, *rs)))
         args.append(maskf)
-    if segment_ids is not None:
-        seg = _seg_setup(segment_ids, batch, tq, tk, tq_p, tk_p)
-        segqf, segq_idx, qlead, segkf, segk_idx, klead = seg
+    for pair, setup in ((segment_ids, _seg_setup), (positions, _pos_setup)):
+        if pair is None:
+            continue
+        vqf, vq_idx, qlead, vkf, vk_idx, klead = setup(
+            pair, batch, tq, tk, tq_p, tk_p)
         specs.append(pl.BlockSpec(
-            (1, bq, 1), lambda b, i, j, *rs: (segq_idx(b), i, 0)))
+            (1, bq, 1), lambda b, i, j, *rs, f=vq_idx: (f(b), i, 0)))
         specs.append(pl.BlockSpec(
-            (1, 1, bk), lambda b, i, j, *rs: (segk_idx(b), 0, j)))
+            (1, 1, bk), lambda b, i, j, *rs, f=vk_idx: (f(b), 0, j)))
         specs_t.append(pl.BlockSpec(
-            (1, bq, 1), lambda b, j, i, *rs: (segq_idx(b), i, 0)))
+            (1, bq, 1), lambda b, j, i, *rs, f=vq_idx: (f(b), i, 0)))
         specs_t.append(pl.BlockSpec(
-            (1, 1, bk), lambda b, j, i, *rs: (segk_idx(b), 0, j)))
-        args.extend([segqf, segkf])
-        # Per-block [min, max] id intervals, (nb, n_blocks, 2) in SMEM.
-        sq = segqf[..., 0].reshape(segqf.shape[0], nqb, bq)
-        sk = segkf[:, 0].reshape(segkf.shape[0], nkb, bk)
+            (1, 1, bk), lambda b, j, i, *rs, f=vk_idx: (f(b), 0, j)))
+        args.extend([vqf, vkf])
+        # Per-block [min, max] intervals, (nb, n_blocks, 2) in SMEM —
+        # these drive the cross-segment / causal-future block skips.
+        sq = vqf[..., 0].reshape(vqf.shape[0], nqb, bq)
+        sk = vkf[:, 0].reshape(vkf.shape[0], nkb, bk)
         qmm = jnp.stack([sq.min(-1), sq.max(-1)], -1)
         kmm = jnp.stack([sk.min(-1), sk.max(-1)], -1)
         qmm = jnp.broadcast_to(qmm.reshape(*qlead, nqb, 2),
@@ -492,7 +526,8 @@ def _aux_setup(mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
         args.extend([qmm, kmm])
     # prefetch == a live summary: the call becomes a scalar-prefetch grid
     # and kernels pop the summary as ref 0.
-    flags = (mask is not None, segment_ids is not None, runsum is not None)
+    flags = (mask is not None, segment_ids is not None,
+             positions is not None, runsum is not None)
     return specs, specs_t, args, flags, runsum
 
 
@@ -520,7 +555,8 @@ def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
 
 
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
-                    mode='exact', save_lse=False, segment_ids=None):
+                    mode='exact', save_lse=False, segment_ids=None,
+                    positions=None):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -554,7 +590,7 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     ]
     args = [qf, kf, vf]
     aux_specs, _, aux_args, flags, runsum = _aux_setup(
-        mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
+        mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
         allow_redirect=allow_redirect)
 
     out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0))
@@ -622,7 +658,7 @@ def _scratch(bq, d_v):
 
 
 def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
-                             has_mask_skip, save_lse):
+                             has_pos, has_mask_skip, save_lse):
     """Forward kernel for ``softmax_mode='bounded'``: the per-row shift is
     a precomputed upper bound on the row max (Cauchy-Schwarz,
     ``‖q_i‖·max_j‖k_j‖``, fed as an input), so the kernel drops the
@@ -641,8 +677,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
         else:
             runsum_ref = None
         off_ref, q_ref, k_ref, v_ref, m_ref, *rest = refs
-        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
-         rest) = _split_aux(rest, has_mask, has_seg)
+        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
+                                              has_pos)
         if save_lse:
             o_ref, lse_ref, l_s, acc_s = rest
         else:
@@ -657,7 +693,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
             acc_s[:] = jnp.zeros_like(acc_s)
 
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -670,8 +706,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, segq_ref, segk_ref,
-                             mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live)
             p = jnp.exp2(s - m_ref[0])                      # bound shift
             l_s[:] += p.sum(axis=-1, keepdims=True)
             acc_s[:] += jax.lax.dot_general(
@@ -692,7 +727,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                    has_mask_skip):
+                    has_pos, has_mask_skip):
     def kernel(*refs):
         if has_mask_skip:
             runsum_ref, *refs = refs
@@ -700,8 +735,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref = None
         (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
-        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
-         rest) = _split_aux(rest, has_mask, has_seg)
+        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
+                                              has_pos)
         dq_ref, dq_acc = rest
         qi = pl.program_id(1)
         ki = pl.program_id(2)
@@ -712,7 +747,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -730,8 +765,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, segq_ref, segk_ref,
-                             mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
@@ -749,7 +783,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                     has_mask_skip):
+                     has_pos, has_mask_skip):
     def kernel(*refs):
         if has_mask_skip:
             runsum_ref, *refs = refs
@@ -757,8 +791,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref = None
         (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
-        (mask_ref, segq_ref, segk_ref, qmm_ref, kmm_ref,
-         rest) = _split_aux(rest, has_mask, has_seg)
+        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
+                                              has_pos)
         dk_ref, dv_ref, dk_acc, dv_acc = rest
         kj = pl.program_id(1)
         qi = pl.program_id(2)
@@ -770,7 +804,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
         run = _run_pred(causal, off_ref, qi, kj, bq, bk,
-                        pl.program_id(0), qmm_ref, kmm_ref, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref)
 
         @pl.when(run)
         def _():
@@ -788,8 +822,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, kj] == 1)
             s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, segq_ref, segk_ref,
-                             mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dv_acc[:] += jax.lax.dot_general(
                 p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -811,7 +844,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
-                    causal, interpret, grad_dtype=None, segment_ids=None):
+                    causal, interpret, grad_dtype=None, segment_ids=None,
+                    positions=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -857,7 +891,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
 
     args = [qf, kf, vf, gf, lsef, deltaf]
     aux_specs, aux_specs_t, aux_args, flags, runsum = _aux_setup(
-        mask, segment_ids, batch, tq, tk, tq_p, tk_p, bq, bk,
+        mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
         allow_redirect=allow_redirect)
 
     off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
@@ -934,30 +968,34 @@ def _seg_pair(seg_q, seg_k):
     return None if seg_q is None else (seg_q, seg_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
-def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, scale, causal,
-           interpret, mode):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, scale,
+           causal, interpret, mode):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                            interpret, mode,
-                           segment_ids=_seg_pair(seg_q, seg_k))
+                           segment_ids=_seg_pair(seg_q, seg_k),
+                           positions=_seg_pair(pos_q, pos_k))
 
 
-def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, scale, causal,
-               interpret, mode):
+def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
+               scale, causal, interpret, mode):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                                interpret, mode, save_lse=True,
-                               segment_ids=_seg_pair(seg_q, seg_k))
-    return out, (q, k, v, mask, causal_offset, seg_q, seg_k, out, lse)
+                               segment_ids=_seg_pair(seg_q, seg_k),
+                               positions=_seg_pair(pos_q, pos_k))
+    return out, (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
+                 out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, mode, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
-    q, k, v, mask, causal_offset, seg_q, seg_k, out, lse = res
+    q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, out, lse = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
                                  scale, causal, interpret,
-                                 segment_ids=_seg_pair(seg_q, seg_k))
-    return dq, dk, dv, None, None, None, None
+                                 segment_ids=_seg_pair(seg_q, seg_k),
+                                 positions=_seg_pair(pos_q, pos_k))
+    return dq, dk, dv, None, None, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -965,7 +1003,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                     scale=None, interpret=None, softmax_mode='exact',
-                    segment_ids=None):
+                    segment_ids=None, positions=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -981,6 +1019,17 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     with provably disjoint id ranges are skipped outright. Composes with
     ``mask`` and ``causal`` (union of maskings); rows left with no
     attendable key output 0 with zero gradients.
+
+    ``positions``: causal masking over EXPLICIT global positions — a
+    ``(pos_q, pos_kv)`` pair (or single array, same rules as
+    ``segment_ids``) of non-negative ints; pair (i, j) is masked when
+    ``pos_q[i] < pos_kv[j]``. This is ``causal=True`` generalized to
+    arbitrary row layouts (zigzag/striped sequence sharding, where a
+    shard's rows are not one contiguous run and a scalar
+    ``causal_offset`` cannot describe them); blocks whose positions are
+    provably all-future are skipped like the contiguous causal skip.
+    Mutually exclusive with ``causal``; composes with ``mask`` and
+    ``segment_ids``.
     Differentiable end-to-end with blockwise Pallas kernels in both
     directions — peak memory is O(T·d) for forward AND backward (the
     backward recomputes score blocks from the saved row logsumexp).
@@ -1016,15 +1065,23 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
-    seg_q = seg_k = None
-    if segment_ids is not None:
-        if isinstance(segment_ids, (tuple, list)):
-            seg_q, seg_k = segment_ids
-        else:
-            if q.shape[-2] != k.shape[-2]:
-                raise ValueError(
-                    'a single segment_ids array needs Tq == Tk; pass a '
-                    '(seg_q, seg_kv) pair for cross-length attention')
-            seg_q = seg_k = segment_ids
-    return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, float(scale),
-                  bool(causal), bool(interpret), softmax_mode)
+
+    def _pair(value, name):
+        if value is None:
+            return None, None
+        if isinstance(value, (tuple, list)):
+            return value
+        if q.shape[-2] != k.shape[-2]:
+            raise ValueError(
+                f'a single {name} array needs Tq == Tk; pass a '
+                f'(q-side, kv-side) pair for cross-length attention')
+        return value, value
+
+    seg_q, seg_k = _pair(segment_ids, 'segment_ids')
+    pos_q, pos_k = _pair(positions, 'positions')
+    if positions is not None and causal:
+        raise ValueError(
+            'positions IS causal masking (over explicit global positions) '
+            '— pass one or the other, not both')
+    return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
+                  float(scale), bool(causal), bool(interpret), softmax_mode)
