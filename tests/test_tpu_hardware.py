@@ -1,0 +1,311 @@
+# -*- coding: utf-8 -*-
+"""
+Real-TPU hardware parity suite (``DDP_TPU_TESTS_ON_TPU=1 pytest -m tpu``).
+
+The reference runs its whole test suite on the accelerator when present
+(cpu/cuda device fixture, reference test_gradient.py:64-70). The CPU-mesh
+suite here covers the same *code* (shard_map plumbing, Pallas interpreter),
+but the real backend differs materially — Mosaic kernel compilation, bf16
+MXU matmul defaults, ICI collectives — so this module re-runs the core
+parity assertions on the actual chip: the three L2 kernels (bitwise, under
+``default_matmul_precision('highest')`` — TPU's default bf16 3-pass would
+round the integer oracle), their VJPs, flash fwd+bwd with every mask form
+(dense + block-skip redirect, segments, positions), ring attention (both
+layouts), the 'full' module path and one full train step.
+
+Single-chip W=1 meshes: the shard_map/collective plumbing compiles and
+executes for real, degenerate but on-device (multi-chip execution is
+covered by the CPU mesh + the driver dryrun; this suite is about the
+hardware backend).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_ON_TPU = (os.environ.get('DDP_TPU_TESTS_ON_TPU')
+           and jax.default_backend() == 'tpu')
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu():
+    if not _ON_TPU:
+        pytest.skip('requires DDP_TPU_TESTS_ON_TPU=1 and a real TPU backend')
+
+
+def _ints(*shape, lo=-3, hi=4, seed=0):
+    """Integer-valued f32: bitwise-comparable when matmul precision is
+    forced to 'highest' (partial sums stay far below 2^24)."""
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(lo, hi, size=shape).astype(np.float32))
+
+
+T, D = 64, 32
+
+
+# --- L2 kernels: bitwise parity + VJPs -----------------------------------
+
+@pytest.mark.parametrize('offset', [8, None])
+def test_matmul_nt_bitwise(offset):
+    from distributed_dot_product_tpu.ops.functions import (
+        distributed_matmul_nt_global,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    left, right = _ints(2, T, D), _ints(2, T, D, seed=1)
+    with jax.default_matmul_precision('highest'):
+        got = distributed_matmul_nt_global(left, right, offset=offset,
+                                           mesh=seq_mesh(1))
+        want = jnp.matmul(left, jnp.swapaxes(right, -1, -2))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_matmul_tn_bitwise():
+    from distributed_dot_product_tpu.ops.functions import (
+        distributed_matmul_tn_global,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    left, right = _ints(2, T, T), _ints(2, T, D, seed=1)
+    with jax.default_matmul_precision('highest'):
+        got = distributed_matmul_tn_global(left, right, mesh=seq_mesh(1))
+        want = jnp.matmul(jnp.swapaxes(left, -1, -2), right)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_matmul_all_bitwise():
+    from distributed_dot_product_tpu.ops.functions import (
+        distributed_matmul_all_global,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    left, right = _ints(2, T, T), _ints(2, T, D, seed=1)
+    with jax.default_matmul_precision('highest'):
+        got = distributed_matmul_all_global(left, right, offset=8,
+                                            mesh=seq_mesh(1))
+        want = jnp.matmul(left, right)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_op_grads_match_full_autodiff():
+    """The custom VJPs (reference ops.py pairings, fixed) on the chip."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.ops.ops import matmul_nt
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    left, right = _ints(1, T, D), _ints(1, T, D, seed=1)
+    cot = _ints(1, T, T, seed=2)
+    mesh = seq_mesh(1)
+
+    def dist(left, right):
+        return jax.shard_map(
+            lambda l, r: matmul_nt(l, r, 8), mesh=mesh,
+            in_specs=(P(None, 'seq', None),) * 2,
+            out_specs=P(None, 'seq', None), check_vma=False)(left, right)
+
+    with jax.default_matmul_precision('highest'):
+        g_dist = jax.grad(lambda l, r: jnp.sum(dist(l, r) * cot),
+                          argnums=(0, 1))(left, right)
+        g_full = jax.grad(
+            lambda l, r: jnp.sum(
+                jnp.matmul(l, jnp.swapaxes(r, -1, -2)) * cot),
+            argnums=(0, 1))(left, right)
+    for got, want in zip(g_dist, g_full):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# --- flash kernels: every mask form on Mosaic ----------------------------
+
+def _qkv(t=512, d=64, dtype=jnp.bfloat16, heads=4):
+    ks = jax.random.split(jax.random.key(0), 3)
+    return tuple(jax.random.normal(kk, (1, heads, t, d), dtype) for kk in ks)
+
+
+def _oracle(q, k, v, mask, causal=False):
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        _reference_math,
+    )
+    return _reference_math(q.astype(jnp.float32), k.astype(jnp.float32),
+                           v.astype(jnp.float32), mask,
+                           1.0 / np.sqrt(q.shape[-1]), causal)
+
+
+def _close(got, want, atol=2.5e-2):
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=atol, rtol=atol)
+
+
+def test_flash_dense_mask_redirect_fwd_bwd():
+    """Dense mask through the scalar-prefetch DMA redirect (TPU-only
+    path): block-diagonal mask = skipped, redirected AND mixed tiles."""
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    t = 1024
+    q, k, v = _qkv(t)
+    blk = jnp.arange(t) // 256
+    mask = (blk[:, None] != blk[None, :])[None, None]
+    mask = mask.at[:, :, :300, :].set(False)
+    _close(flash_attention(q, k, v, mask), _oracle(q, k, v, mask))
+    g = jax.grad(lambda v_: jnp.sum(flash_attention(q, k, v_, mask)
+                                    .astype(jnp.float32) ** 2))(v)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_flash_segments_fwd_bwd():
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    t = 768
+    q, k, v = _qkv(t)
+    seg = (jnp.arange(t, dtype=jnp.int32) * 3 // t)[None]
+    dense = (seg[0][:, None] != seg[0][None, :])[None, None]
+    _close(flash_attention(q, k, v, segment_ids=seg),
+           _oracle(q, k, v, jnp.broadcast_to(dense, (1, 1, t, t))))
+    g = jax.grad(lambda v_: jnp.sum(flash_attention(
+        q, k, v_, segment_ids=seg).astype(jnp.float32) ** 2))(v)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_flash_positions_fwd_bwd():
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    t = 512
+    q, k, v = _qkv(t)
+    pos = jax.random.permutation(jax.random.key(3), t)[None].astype(
+        jnp.int32)
+    dense = (pos[0][:, None] < pos[0][None, :])[None, None]
+    _close(flash_attention(q, k, v, positions=pos),
+           _oracle(q, k, v, jnp.broadcast_to(dense, (1, 1, t, t))))
+    g = jax.grad(lambda q_: jnp.sum(flash_attention(
+        q_, k, v, positions=pos).astype(jnp.float32) ** 2))(q)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_flash_causal_offset_traced():
+    """Sequence-sharded causal: the traced scalar offset input."""
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    t = 512
+    q, k, v = _qkv(t)
+    half = q[:, :, t // 2:]
+    rows = t // 2 + jnp.arange(t // 2)
+    dense = (rows[:, None] < jnp.arange(t)[None, :])[None, None]
+    got = jax.jit(lambda off: flash_attention(
+        half, k, v, causal=True, causal_offset=off))(t // 2)
+    _close(got, _oracle(half, k, v,
+                        jnp.broadcast_to(dense, (1, 1, t // 2, t))))
+
+
+# --- ring attention on the chip ------------------------------------------
+
+def test_ring_attention_w1_fwd_grad():
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    q, k, v = _qkv(512)
+    mesh = seq_mesh(1)
+    spec = P(None, None, 'seq', None)
+    ring = jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    _close(ring(q, k, v), _oracle(q, k, v, None, causal=True))
+    g = jax.grad(lambda v_: jnp.sum(ring(q, k, v_)
+                                    .astype(jnp.float32) ** 2))(v)
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_ring_zigzag_w1():
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention, zigzag_indices,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    t = 512
+    q, k, v = _qkv(t)
+    idx = zigzag_indices(t, 1)
+    inv = jnp.argsort(idx)
+    mesh = seq_mesh(1)
+    spec = P(None, None, 'seq', None)
+    ring = jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, causal=True,
+                                       layout='zigzag'),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    got = ring(q[..., idx, :], k[..., idx, :], v[..., idx, :])[..., inv, :]
+    _close(got, _oracle(q, k, v, None, causal=True))
+
+
+# --- module + train step -------------------------------------------------
+
+def test_module_full_path_matches_oracle():
+    """The reference-parity 'full' softmax path (chunked allgather nt/all
+    kernels through the module) on the chip."""
+    from distributed_dot_product_tpu.models.attention import (
+        DistributedDotProductAttn, apply_seq_parallel,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    dim, t = 64, 256
+    model = DistributedDotProductAttn(key_dim=dim, num_heads=4, offset=32)
+    oracle = DistributedDotProductAttn(key_dim=dim, num_heads=4,
+                                       distributed=False)
+    x = jax.random.normal(jax.random.key(1), (2, t, dim), jnp.float32)
+    m = jnp.zeros((2, t, t), dtype=bool)
+    params = oracle.init(jax.random.key(2), x, x, x, m)
+    got = apply_seq_parallel(model, params, seq_mesh(1), x, x, x, m)
+    want = oracle.apply(params, x, x, x, m)
+    _close(got, want)
+
+
+def test_train_step_updates_params():
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_dot_product_tpu import DistributedDotProductAttn
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    from distributed_dot_product_tpu.train import make_train_step
+    dim, t = 64, 512
+    mesh = seq_mesh(1)
+    model = DistributedDotProductAttn(key_dim=dim, num_heads=4,
+                                      softmax_impl='flash', causal=True,
+                                      dtype=jnp.bfloat16)
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), (1, t, dim), jnp.bfloat16),
+        NamedSharding(mesh, P(None, 'seq', None)))
+    x0 = jnp.zeros((1, 16, dim), jnp.bfloat16)
+    params = model.init(jax.random.key(0), x0, x0, x0, None)
+    opt = optax.adam(1e-3)
+    step = make_train_step(model, opt, mesh, donate=False)
+    new_params, _, loss = step(params, opt.init(params),
+                               (x, x, x, None, x))
+    assert np.isfinite(float(loss))
+    changed = any(
+        not np.allclose(np.asarray(a, np.float32), np.asarray(b, np.float32))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(new_params)))
+    assert changed, 'adam update did not change the parameters'
+
+
+def test_ulysses_w1_matches_flash():
+    from distributed_dot_product_tpu.models.ulysses_attention import (
+        ulysses_attention,
+    )
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    from jax.sharding import PartitionSpec as P
+    q, k, v = _qkv(256)
+    mesh = seq_mesh(1)
+    spec = P(None, None, 'seq', None)
+    uly = jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+    _close(uly(q, k, v), flash_attention(q, k, v, causal=True),
+           atol=1e-2)
