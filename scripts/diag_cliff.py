@@ -1,0 +1,119 @@
+# -*- coding: utf-8 -*-
+"""Diagnose the T=524288 train-step throughput cliff (VERDICT r2 item 2).
+
+Isolates the step's components at T=262144 vs T=524288 on the real chip:
+full step, forward-only loss, flash attention alone (fwd, fwd+bwd), and
+projections alone. Prints per-component times so the superlinear term is
+visible.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_dot_product_tpu import DistributedDotProductAttn
+from distributed_dot_product_tpu.parallel.mesh import globalize, seq_mesh
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+DIM = 768
+HEADS = 8
+
+
+from distributed_dot_product_tpu.utils.tracing import time_fn
+
+
+def timeit(fn, *args, iters=2):
+    best, _ = time_fn(fn, *args, iters=iters, warmup=1)
+    return best
+
+
+def run(t, only=None):
+    mesh = seq_mesh(None)
+    model = DistributedDotProductAttn(
+        key_dim=DIM, num_heads=HEADS, softmax_impl='flash',
+        dtype=jnp.bfloat16)
+    k1, k2 = jax.random.split(jax.random.key(111))
+    x = globalize(jax.random.normal(k1, (1, t, DIM), jnp.bfloat16),
+                  NamedSharding(mesh, P(None, SEQ_AXIS, None)))
+    target = globalize(jax.random.normal(k2, (1, t, DIM), jnp.bfloat16),
+                       NamedSharding(mesh, P(None, SEQ_AXIS, None)))
+    t0 = 16
+    x0 = jnp.zeros((1, t0, DIM), jnp.bfloat16)
+    params = model.init(jax.random.key(0), x0, x0, x0, None)
+
+    if only in (None, 'step'):
+        import optax
+        from distributed_dot_product_tpu.train import make_train_step
+        optimizer = optax.adam(1e-3)
+        opt_state = optimizer.init(params)
+        step = make_train_step(model, optimizer, mesh, donate=False)
+        batch = (x, x, x, None, target, None)
+        c_step = step.lower(params, opt_state, batch).compile()
+        tm = timeit(c_step, params, opt_state, batch)
+        ma = c_step.memory_analysis()
+        print(f'T={t} full step: {tm:.3f}s  temp={ma.temp_size_in_bytes/2**30:.2f}GiB '
+              f'arg={ma.argument_size_in_bytes/2**30:.2f}GiB '
+              f'out={ma.output_size_in_bytes/2**30:.2f}GiB')
+    if only == 'step':
+        return
+
+    if only == 'flash':
+        flash_only(t)
+        return
+    # forward-only loss
+    def fwd_local(p, x, target):
+        out = model.apply(p, x, x, x, None)
+        return jnp.mean((out - target) ** 2)
+    a3 = P(None, SEQ_AXIS, None)
+    fwd = jax.shard_map(fwd_local, mesh=mesh, in_specs=(P(), a3, a3),
+                        out_specs=P(), check_vma=False)
+    c_fwd = jax.jit(fwd).lower(params, x, target).compile()
+    tm = timeit(c_fwd, params, x, target)
+    print(f'T={t} forward-only: {tm:.3f}s')
+
+    # grad of loss (no optimizer)
+    g = jax.shard_map(jax.grad(fwd_local), mesh=mesh,
+                      in_specs=(P(), a3, a3), out_specs=P(),
+                      check_vma=False)
+    c_g = jax.jit(g).lower(params, x, target).compile()
+    tm = timeit(c_g, params, x, target)
+    ma = c_g.memory_analysis()
+    print(f'T={t} fwd+bwd (no adam): {tm:.3f}s  temp={ma.temp_size_in_bytes/2**30:.2f}GiB')
+
+    flash_only(t)
+
+
+def flash_only(t):
+    # flash attention alone on pre-projected q/k/v
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        flash_attention)
+    q = jax.random.normal(jax.random.key(1), (HEADS, t, DIM // HEADS),
+                          jnp.bfloat16)
+    def attn_fwd(q):
+        return flash_attention(q, q, q)
+    c_a = jax.jit(attn_fwd).lower(q).compile()
+    tm = timeit(c_a, q)
+    print(f'T={t} flash fwd alone: {tm:.3f}s')
+
+    def attn_loss(q):
+        return flash_attention(q, q, q).astype(jnp.float32).sum()
+    c_ab = jax.jit(jax.grad(attn_loss)).lower(q).compile()
+    tm = timeit(c_ab, q)
+    print(f'T={t} flash fwd+bwd alone: {tm:.3f}s')
+    sys.stdout.flush()
+
+
+if __name__ == '__main__':
+    only = None
+    args = []
+    for a in sys.argv[1:]:
+        if a.startswith('--only='):
+            only = a.split('=', 1)[1]
+        else:
+            args.append(a)
+    for t in (int(a) for a in args or ['262144', '524288']):
+        run(t, only)

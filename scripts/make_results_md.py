@@ -188,19 +188,34 @@ backward, flash recomputes blockwise from the saved row logsumexp.
     # The no-mask prose cites specific rows — print it only when both
     # records exist (partial regeneration must not fabricate claims, and
     # must not drop the analysis section below either).
-    if (load('train_benchmark_flash_256k_nomask') is not None
-            and load('train_benchmark_flash_nomask') is not None):
+    if all(load(s) is not None for s in (
+            'train_benchmark_flash_nomask',
+            'train_benchmark_flash_128k_nomask',
+            'train_benchmark_flash_256k_nomask',
+            'train_benchmark_flash_512k_nomask')):
         print("""
 No-mask rows use `--no-mask` (`attn_mask=None`, an extension over the
 reference API): the dense mask is the only O(T²) input on the flash path
 — at T=16K dropping it alone takes the step from ~59 to ~92 TFLOP/s
 (no int8 mask copy, full-size kernel blocks) — and leaves training memory
 linear in T — ONE 16 GiB chip trains
-dim-768 8-head attention at **T=262,144 at ~89 TFLOP/s/step** (the
-reference's full-score materialization would need ~0.5 TiB per device at
-that length). T=512K still fits (10 GiB of temporaries) but falls off the
-throughput cliff (~13 TF/s) as XLA trades compute to stay under the HBM
-ceiling — the honest single-chip limit of this configuration.""")
+dim-768 8-head attention at **T=524,288 at ~89 TFLOP/s/step** (the
+reference's full-score materialization would need ~2 TiB per device at
+that length). Scaling is exactly quadratic from 131K through 512K — each
+doubling of T costs 4× the step time at a flat ~89 TFLOP/s, with
+temporaries linear in T (2.5 → 5 → 10 GiB).
+
+A round-2 record showed 195.7 s/step (13 TF/s) at T=512K — a 7× cliff.
+Round-3 diagnosis (`scripts/diag_cliff.py`): it does not reproduce. In a
+fresh process every component scales perfectly — flash fwd alone 1.82 s →
+7.28 s, fwd+bwd 7.14 s → 28.5 s, and the full step 7.15 s → 28.6 s going
+262K → 512K — and re-running the UNCHANGED round-2 code from a worktree at
+its commit also gives 28.6 s, with the compiled executable reporting
+identical buffer totals (temp 10.00 GiB) then and now. So the cliff was
+transient device/tunnel state during the original one-shot `--iters 1`
+sweep measurement, not the compiled program; the corpus now carries the
+reproducible record (`train_benchmark_flash_512k_nomask.json`, last
+entry) and the sweep runs this config at `--iters 2`.""")
     if load('train_benchmark_flash_128k_causal') is not None:
         print("""
 The causal row runs the kernels' in-kernel triangle (a traced global row

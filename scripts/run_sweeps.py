@@ -110,7 +110,7 @@ SWEEPS = [
       '--seq-len', '262144', '--no-mask', '--iters', '2']),
     ('train_benchmark_flash_512k_nomask',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
-      '--seq-len', '524288', '--no-mask', '--iters', '1']),
+      '--seq-len', '524288', '--no-mask', '--iters', '2']),
     ('train_benchmark_flash_128k_causal',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '131072', '--no-mask', '--causal', '--iters', '2']),
