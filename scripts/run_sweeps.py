@@ -71,17 +71,29 @@ SWEEPS = [
     # --- attention op: full vs online(ring) vs flash vs flash_bounded ---
     # (no reference analog; T = 75000/scale, H=8, d=64.) 'full'
     # materializes (H, T/N, T) scores, so it only fits at larger scales.
-    # 'online' (ring) is absent at scale=1: on a W=1 mesh the ring
-    # degenerates to ONE local (H, T, T) score block — 180 GB at T=75000.
-    # Its O((T/N)²) memory story needs N>1; see RESULTS.md and the
-    # 8-device CPU-mesh coverage in tests/test_ring_attention.py.
+    # 'online' (ring) runs at scale=1 since the flash-backed block fold:
+    # the old einsum fold materialized the whole (H, T, T) score block
+    # (180 GB at T=75000); the fused fold holds O(block²) and matches
+    # flash's rate. Its O((T/N)²) memory story still needs N>1; see
+    # RESULTS.md and tests/test_ring_attention.py for CPU-mesh coverage.
     *[(f'attn_benchmark_{impl}', ['--mode', 'attn', '--attn-impl', impl,
                                   '--dtype', 'bf16', '--skip-local'])
-      for impl in ('flash', 'flash_bounded', 'ulysses')],
+      for impl in ('online', 'flash', 'flash_bounded', 'ulysses')],
     *[(f'attn_benchmark_{impl}_size_4',
        ['--mode', 'attn', '--attn-impl', impl, '--scale', '4',
         '--dtype', 'bf16', '--skip-local'])
       for impl in ('full', 'online', 'flash', 'flash_bounded', 'ulysses')],
+    # --- flash head-dim sweep: d in {64, 128, 256} x T in {16K, 75K} ---
+    # Grounds the "d=64 bounds MFU" analysis in data: per-head arithmetic
+    # intensity grows with d, so the rate climbs toward the MXU peak.
+    # (d=64, T=75000 is exactly attn_benchmark_flash above — the RESULTS
+    # head-dim table reads that record instead of re-measuring it.)
+    *[(f'attn_benchmark_flash_d{d}_{tag}',
+       ['--mode', 'attn', '--attn-impl', 'flash', '--dtype', 'bf16',
+        '--head-dim', str(d), '--skip-local'] + extra)
+      for d in (64, 128, 256)
+      for tag, extra in (('16k', ['--seq-len', '16384']), ('75k', []))
+      if (d, tag) != (64, '75k')],
     # --- full train step (fwd+bwd+adam as one SPMD program) ---
     # 'full'/'online' materialize (H, T, T) scores FORWARD AND BACKWARD —
     # they fit at T=8192 on 16 GiB; flash scales on (T=32768 included as
@@ -114,6 +126,24 @@ SWEEPS = [
     ('train_benchmark_flash_128k_causal',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '131072', '--no-mask', '--causal', '--iters', '2']),
+    # Sliding-window attention: O(T·window) compute — the linear-in-T
+    # long-context configuration (window=4096 ≈ a Mistral-style cap).
+    *[(f'train_benchmark_flash_{tag}_win4k',
+       ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+        '--seq-len', tlen, '--no-mask', '--causal', '--window', '4096',
+        '--iters', '2'])
+      for tag, tlen in (('128k', '131072'), ('512k', '524288'))],
+    # Segment-id (packed-sequence) mask: O(T) kernel inputs, cross-
+    # segment block skipping — the compact-mask capability record.
+    ('train_benchmark_flash_segments',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--mask-kind', 'segments', '--segments', '8']),
+    # --- train-step head-dim sweep (dim=768 fixed, so d = 768/heads) ---
+    *[(f'train_benchmark_flash_h{h}_{tag}_nomask',
+       ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+        '--heads', str(h), '--no-mask', '--seq-len', tlen])
+      for h in (12, 6, 3)
+      for tag, tlen in (('16k', '16384'), ('75k', '75000'))],
 ]
 
 

@@ -72,7 +72,17 @@ def test_attn_mode(tmp_path):
     rec = _run(tmp_path, 'attn', '--mode', 'attn', '--attn-impl', 'online',
                '--scale', '2344', '--skip-local')
     assert rec['attn_impl'] == 'online'
+    assert rec['T'] == 24  # 75000 // 2344 = 31, floored to the 8-mesh
     assert rec['dist_gflops_per_chip'] > 0
+
+
+def test_attn_mode_seq_len_override(tmp_path):
+    # --seq-len overrides the reference's T = 75000/scale convention
+    # (used by the head-dim sweep to pin T exactly).
+    rec = _run(tmp_path, 'attn_sl', '--mode', 'attn', '--attn-impl',
+               'online', '--seq-len', '64', '--head-dim', '32',
+               '--skip-local')
+    assert rec['T'] == 64 and rec['head_dim'] == 32
 
 
 def test_train_mode(tmp_path):
@@ -86,3 +96,10 @@ def test_train_mode(tmp_path):
     rec = _run(tmp_path, 'train_c', '--mode', 'train', '--attn-impl',
                'online', '--seq-len', '64', '--no-mask', '--causal')
     assert rec['causal'] is True and rec['step_gflops_per_chip'] > 0
+
+
+def test_train_mode_window(tmp_path):
+    rec = _run(tmp_path, 'train_w', '--mode', 'train', '--attn-impl',
+               'flash', '--seq-len', '64', '--no-mask', '--causal',
+               '--window', '16')
+    assert rec['window'] == 16 and rec['step_gflops_per_chip'] > 0
