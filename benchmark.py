@@ -47,8 +47,9 @@ def parse_args():
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
                                            'train'],
                         default='nt')
-    parser.add_argument('--seq-len', type=int, default=16384,
-                        help='global sequence length (train mode)')
+    parser.add_argument('--seq-len', type=int, default=None,
+                        help='global sequence length (train mode default '
+                             '16384; attn mode default 75000//scale)')
     parser.add_argument('--no-mask', action='store_true',
                         help='train mode: attn_mask=None — drops the only '
                              'O(T^2) input on the flash path (long-context '
@@ -64,6 +65,10 @@ def parse_args():
     parser.add_argument('--causal', action='store_true',
                         help='train mode: autoregressive masking (handled '
                              'blockwise in-kernel on ring/flash/ulysses)')
+    parser.add_argument('--window', type=int, default=None,
+                        help='train mode: sliding-window lookback cap '
+                             '(requires --causal) — attention compute '
+                             'becomes O(T·window), linear in T')
     parser.add_argument('--attn-impl',
                         choices=['full', 'online', 'flash', 'flash_bounded',
                                  'ulysses'],
@@ -177,7 +182,7 @@ def run_attn(args):
 
     mesh = seq_mesh(args.devices)
     world = mesh.devices.size
-    t = FULL_T // args.scale
+    t = args.seq_len or FULL_T // args.scale
     t -= t % world
     h, d = args.heads, args.head_dim
     dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
@@ -274,7 +279,7 @@ def _memory_analysis(compiled):
 def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
                        no_mask=False, causal=False, iters=3, devices=None,
                        impl='allgather', offset=32, heads=8,
-                       mask_kind=None, n_segments=8):
+                       mask_kind=None, n_segments=8, window=None):
     """Measure one full training step — forward, loss, gradient psum, optax
     update as ONE compiled SPMD program (``train.make_train_step``).
     Returns the result record; shared by ``--mode train`` and ``bench.py``
@@ -290,6 +295,9 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     The segment FLOP count is NOT discounted for cross-segment skipping,
     so reported GFLOP/s includes the skip as apparent speedup (same
     convention as the causal discount, which IS applied, being exactly 2×).
+    ``window`` (requires causal) counts only in-window pairs — attention
+    work is then O(T·window), so s/step is the honest headline and
+    GFLOP/s shows kernel efficiency on the remaining work.
     """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -307,7 +315,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         softmax_impl=attn_impl.replace('_bounded', ''),
         flash_softmax_mode=('bounded' if attn_impl == 'flash_bounded'
                             else 'exact'),
-        causal=causal, impl=impl, dtype=jdtype)
+        causal=causal, window=window, impl=impl, dtype=jdtype)
 
     if mask_kind is None:
         mask_kind = 'none' if no_mask else 'dense'
@@ -348,9 +356,16 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     batch = (x, x, x, mask, target, seg)
     compiled = step.lower(params, opt_state, batch).compile()
     best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
-    # Causal attention does half the score/context work (lower triangle).
-    attn_mm = 2.0 if causal else 4.0
-    flops = 3.0 * (8.0 * t * DIM * DIM + attn_mm * t * t * DIM)
+    # Attended (query, key) pairs: full square, causal lower triangle, or
+    # the sliding-window band (row i attends min(i+1, window) keys).
+    if causal and window is not None:
+        w = min(window, t)
+        pairs = w * (w + 1) / 2.0 + (t - w) * float(w)
+    elif causal:
+        pairs = t * t / 2.0
+    else:
+        pairs = float(t) * t
+    flops = 3.0 * (8.0 * t * DIM * DIM + 4.0 * pairs * DIM)
     return {
         'mode': 'train', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
         'heads': heads, 'world': world, 'dtype': dtype,
@@ -359,7 +374,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         'offset': offset, 'impl': impl,
         'mask': mask_kind == 'dense', 'mask_kind': mask_kind,
         'n_segments': n_segments if mask_kind == 'segments' else None,
-        'causal': causal,
+        'causal': causal, 'window': window,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'step_time': best, 'step_time_mean': mean,
@@ -373,10 +388,11 @@ def run_train(args):
     (reference example.py runs T=4096, dim 768, heads 2 with no optimizer;
     here T defaults to 16384 with an adam update)."""
     record = measure_train_step(
-        seq_len=args.seq_len, attn_impl=args.attn_impl, dtype=args.dtype,
+        seq_len=args.seq_len or 16384, attn_impl=args.attn_impl,
+        dtype=args.dtype,
         no_mask=args.no_mask, causal=args.causal, iters=args.iters,
         devices=args.devices, impl=args.impl, offset=args.offset,
-        heads=args.heads, mask_kind=args.mask_kind,
+        heads=args.heads, mask_kind=args.mask_kind, window=args.window,
         n_segments=args.segments)
     ma = record['memory_analysis'] or {}
     print(f"train[{args.attn_impl}] T={record['T']} dim={DIM} "

@@ -83,6 +83,12 @@ class DistributedDotProductAttn(nn.Module):
     # this derives it from the shard's global offset and ORs it into the
     # mask, so it works identically in every softmax_impl.
     causal: bool = False
+    # Sliding-window lookback cap over GLOBAL positions (requires
+    # causal=True): row i attends columns (i − window, i]. Native in the
+    # flash/online/ulysses kernels with whole-block skipping — compute and
+    # HBM traffic per shard become O(window·T/N), linear in T; the 'full'
+    # parity path densifies it into the mask. No reference analog.
+    window: Optional[int] = None
     distributed: bool = True
     axis_name: str = SEQ_AXIS
     impl: str = 'allgather'
@@ -112,6 +118,13 @@ class DistributedDotProductAttn(nn.Module):
         if self.impl not in ('allgather', 'ring'):
             raise ValueError(
                 f"impl must be 'allgather' or 'ring', got {self.impl!r}")
+        if self.window is not None:
+            if not isinstance(self.window, int) or self.window < 1:
+                raise ValueError(
+                    f'window must be a positive int, got {self.window!r}')
+            if not self.causal:
+                raise ValueError('window is a lookback cap and requires '
+                                 'causal=True')
         value_dim = self.value_dim if self.value_dim is not None \
             else self.key_dim
         if value_dim % self.num_heads:
@@ -197,7 +210,11 @@ class DistributedDotProductAttn(nn.Module):
             t_global = (attn_mask.shape[-1] if attn_mask is not None
                         else tn * world)
             rows = idx * tn + jnp.arange(tn)
-            future = rows[:, None] < jnp.arange(t_global)[None, :]
+            cols = jnp.arange(t_global)
+            future = rows[:, None] < cols[None, :]
+            if self.window is not None:
+                future = jnp.logical_or(
+                    future, rows[:, None] - cols[None, :] >= self.window)
             attn_mask = (future if attn_mask is None
                          else jnp.logical_or(attn_mask, future))
 
@@ -258,7 +275,9 @@ class DistributedDotProductAttn(nn.Module):
                                       scale=scale, causal=native_causal,
                                       causal_offset=causal_offset,
                                       softmax_mode=self.flash_softmax_mode,
-                                      segment_ids=seg_pair)
+                                      segment_ids=seg_pair,
+                                      window=(self.window if native_causal
+                                              else None))
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],
@@ -277,7 +296,7 @@ class DistributedDotProductAttn(nn.Module):
                 axis_name=self.axis_name, scale=scale,
                 causal=native_causal,
                 softmax_mode=self.flash_softmax_mode,
-                segment_ids=seg_local)
+                segment_ids=seg_local, window=self.window)
             outputs = jnp.swapaxes(outputs, -3, -2)
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
             return self.composition(outputs)
@@ -294,11 +313,12 @@ class DistributedDotProductAttn(nn.Module):
                 outputs = ring_attention(
                     keys, queries, values, attn_mask,
                     axis_name=self.axis_name, scale=scale,
-                    causal=native_causal, layout=self.ring_layout)
+                    causal=native_causal, layout=self.ring_layout,
+                    window=self.window)
             else:
                 outputs = local_attention_reference(
                     keys, queries, values, attn_mask, scale=scale,
-                    causal=native_causal)
+                    causal=native_causal, window=self.window)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],

@@ -72,7 +72,7 @@ def _mask_bias(mask, dtype):
 
 def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                    scale=None, precision=None, block_impl='flash',
-                   layout='contiguous'):
+                   layout='contiguous', window=None):
     """Sequence-parallel attention with O((T/N)²) score memory.
 
     ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
@@ -103,6 +103,16 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
       mask's columns are contiguous-global; re-indexing it per layout is
       not implemented). Use :func:`zigzag_indices` to permute global
       arrays into (and out of) this layout.
+
+    ``window``: sliding-window lookback cap over global positions (see
+    :func:`~distributed_dot_product_tpu.ops.pallas_attention.flash_attention`).
+    Requires ``causal=True``. On the contiguous layout, ring folds whose
+    whole K/V block lies ≥ window positions in the past are skipped
+    entirely (not even a kernel launch) — with window ≪ T, per-shard
+    compute drops from O(T·T/N) to O(window·T/N), and only the
+    communication stays O(T). ``block_impl='xla'`` supports window only
+    with ``mask=None`` (its post-hoc empty-row zeroing is not
+    window-aware; the flash backend handles mask+window exactly).
     """
     if block_impl not in ('flash', 'xla'):
         raise ValueError(
@@ -120,6 +130,17 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         if q.shape[-2] % 2:
             raise ValueError('zigzag needs an even per-shard length '
                              f'(got T/N = {q.shape[-2]})')
+    if window is not None:
+        if not isinstance(window, int) or window < 1:
+            raise ValueError(f'window must be a positive int, got {window!r}')
+        if not causal:
+            raise ValueError('window is a lookback cap and requires '
+                             'causal=True')
+        if block_impl == 'xla' and mask is not None:
+            raise ValueError(
+                "block_impl='xla' supports window only with mask=None (its "
+                'empty-row zeroing is not window-aware); use the flash '
+                'backend for mask+window')
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
     if block_impl == 'flash':
         if precision is not None:
@@ -131,9 +152,9 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                 '(the flash kernels fix fp32 MXU accumulation)')
         interpret = jax.default_backend() != 'tpu'
         return _ring_flash(q, k, v, mask, axis_name, bool(causal),
-                           float(scale), bool(interpret), layout)
+                           float(scale), bool(interpret), layout, window)
     return _ring_xla(q, k, v, mask, axis_name=axis_name, causal=causal,
-                     scale=scale, precision=precision)
+                     scale=scale, precision=precision, window=window)
 
 
 def _ring_sweep(axis_name, fold, rotating, acc):
@@ -195,8 +216,20 @@ def zigzag_indices(t, world):
         for i in range(world)]))
 
 
+def _fold_skip(idx, owner, tn, window):
+    """Whole-fold skip predicate (contiguous layout, causal): the owner's
+    column block lies entirely in this shard's future — or, with a sliding
+    window, entirely ≥ window positions in the past (the closest pair is
+    query row 0 at ``idx·tn`` vs the block's LAST column
+    ``owner·tn + tn − 1``)."""
+    skip = owner > idx
+    if window is not None:
+        skip = jnp.logical_or(skip, (idx - owner) * tn - tn + 1 >= window)
+    return skip
+
+
 def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
-                         layout='contiguous'):
+                         layout='contiguous', window=None):
     """Forward ring: per block, the flash kernel returns the block-local
     normalized output ``out_b`` and row logsumexp ``lse_b``; blocks merge by
     the shift-invariant identity ``num += e^{lse_b − m}·out_b,
@@ -232,13 +265,14 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
                 out_b, lse_b = _flash_fwd_impl(
                     q, k_buf, v_buf, _blk_mask(mask, owner, tn),
                     (idx - owner) * tn, scale, causal, interpret,
-                    save_lse=True)
+                    save_lse=True, window=window)
             else:
                 out_b, lse_b = _flash_fwd_impl(
                     q, k_buf, v_buf, None, 0, scale, False, interpret,
                     save_lse=True,
                     positions=(my_pos,
-                               _layout_positions(layout, owner, W, tn)))
+                               _layout_positions(layout, owner, W, tn)),
+                    window=window)
             # A block-empty row (all its columns masked / causal-future)
             # has lse_b ≈ log-of-large-finite-negative ⇒ combine weight 0:
             # garbage block outputs never enter the merge.
@@ -253,13 +287,15 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
         if not causal or my_pos is not None:
             # Zigzag: every (shard, owner) pair owns some past half-block
             # (that is the point — balanced folds), so there is no
-            # whole-fold skip; the kernel still skips future HALF-blocks
-            # from the position interval tables.
+            # whole-fold skip; the kernel still skips future (and
+            # out-of-window) HALF-blocks from the position interval tables.
             return rot, compute(acc)
-        # Whole-block causal skip: the owner's column range lies entirely
-        # in this shard's future — not even a kernel launch. (The kernel
-        # also block-skips internally for partially-causal blocks.)
-        return rot, lax.cond(owner > idx, lambda a: a, compute, acc)
+        # Whole-block causal/window skip: the owner's column range lies
+        # entirely in this shard's future — or entirely outside the
+        # sliding window — not even a kernel launch. (The kernel also
+        # block-skips internally for partially-covered blocks.)
+        return rot, lax.cond(_fold_skip(idx, owner, tn, window),
+                             lambda a: a, compute, acc)
 
     _, (m, den, num), _ = _ring_sweep(axis_name, fold, (k, v),
                                       (m0, den0, num0))
@@ -276,7 +312,7 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
 
 
 def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
-                         scale, interpret, layout='contiguous'):
+                         scale, interpret, layout='contiguous', window=None):
     """Backward ring: the flash backward decomposes over K/V blocks given
     the GLOBAL ``lse`` (and ``Δ = rowsum(g·out)``), so a second ring pass
     rotates ``(k, v, dk, dv)`` together — each rank folds its dq
@@ -302,18 +338,20 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
                 dq_b, dk_b, dv_b = _flash_bwd_impl(
                     q, k_buf, v_buf, _blk_mask(mask, owner, tn),
                     (idx - owner) * tn, out, lse, g, scale, causal,
-                    interpret, grad_dtype=jnp.float32)
+                    interpret, grad_dtype=jnp.float32, window=window)
             else:
                 dq_b, dk_b, dv_b = _flash_bwd_impl(
                     q, k_buf, v_buf, None, 0, out, lse, g, scale, False,
                     interpret, grad_dtype=jnp.float32,
                     positions=(my_pos,
-                               _layout_positions(layout, owner, W, tn)))
+                               _layout_positions(layout, owner, W, tn)),
+                    window=window)
             return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
 
         if causal and my_pos is None:
             dq, dk_buf, dv_buf = lax.cond(
-                owner > idx, lambda a: a, compute, (dq, dk_buf, dv_buf))
+                _fold_skip(idx, owner, tn, window), lambda a: a, compute,
+                (dq, dk_buf, dv_buf))
         else:
             dq, dk_buf, dv_buf = compute((dq, dk_buf, dv_buf))
         return (k_buf, v_buf, dk_buf, dv_buf), dq
@@ -329,24 +367,27 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret, layout):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret, layout,
+                window):
     out, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                  interpret, layout)
+                                  interpret, layout, window)
     return out
 
 
 def _ring_flash_vjp_fwd(q, k, v, mask, axis_name, causal, scale, interpret,
-                        layout):
+                        layout, window):
     out, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                    interpret, layout)
+                                    interpret, layout, window)
     return out, (q, k, v, mask, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, layout, res, g):
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, layout, window,
+                        res, g):
     q, k, v, mask, out, lse = res
     dq, dk, dv = _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name,
-                                      causal, scale, interpret, layout)
+                                      causal, scale, interpret, layout,
+                                      window)
     return dq, dk, dv, None
 
 
@@ -358,7 +399,7 @@ _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 # ---------------------------------------------------------------------------
 
 def _ring_xla(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
-              scale=None, precision=None):
+              scale=None, precision=None, window=None):
     """The plain-XLA block fold (pre-fusion implementation, kept as the
     portable backend and as an oracle for the kernel path). Differentiable
     through the scan; each step rematerializes in the backward
@@ -393,6 +434,10 @@ def _ring_xla(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
             if causal:
                 col_pos = owner * tn + jnp.arange(tn)
                 future = row_pos[:, None] < col_pos[None, :]
+                if window is not None:
+                    far_past = (row_pos[:, None] - col_pos[None, :]
+                                >= window)
+                    future = jnp.logical_or(future, far_past)
                 scores = jnp.where(future, jnp.finfo(dtype).min / 2, scores)
 
             m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -407,15 +452,17 @@ def _ring_xla(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
 
         if not causal:
             return compute(acc)
-        # Causal block skip: when the block owner's whole column range lies
-        # in this shard's future (owner > idx), the block contributes
-        # nothing — skip both einsums. NOTE this halves AVERAGE compute
+        # Causal/window block skip: when the block owner's whole column
+        # range lies in this shard's future (owner > idx) — or wholly
+        # outside the sliding window — the block contributes nothing: skip
+        # both einsums. NOTE the causal-only skip halves AVERAGE compute
         # (energy / chip-seconds), not the step's wall-clock: with
         # contiguous sharding the last shard still folds every block, and
-        # the scan keeps folds sequential. Balancing the critical path
-        # would need zigzag/striped row assignment, which changes the
-        # sharding contract — deliberately not done here.
-        return lax.cond(owner > idx, lambda acc: acc, compute, acc)
+        # the scan keeps folds sequential (layout='zigzag' on the flash
+        # backend balances the critical path). A window ≪ T bounds EVERY
+        # shard's live folds, so there it cuts wall-clock too.
+        return lax.cond(_fold_skip(idx, owner, tn, window),
+                        lambda acc: acc, compute, acc)
 
     def fold(rot, acc, s):
         return rot, fold_block(acc, *rot, s)
@@ -437,7 +484,8 @@ def _ring_xla(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     return out.astype(v.dtype)
 
 
-def local_attention_reference(q, k, v, mask=None, causal=False, scale=None):
+def local_attention_reference(q, k, v, mask=None, causal=False, scale=None,
+                              window=None):
     """Unsharded oracle: same math on full arrays (for tests/benchmarks)."""
     dtype = jnp.promote_types(q.dtype, jnp.float32)
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
@@ -446,14 +494,18 @@ def local_attention_reference(q, k, v, mask=None, causal=False, scale=None):
     if mask is not None:
         scores = scores + _mask_bias(mask, dtype)
     if causal:
-        t = q.shape[-2]
-        future = jnp.arange(t)[:, None] < jnp.arange(k.shape[-2])[None, :]
+        rows = jnp.arange(q.shape[-2])[:, None]
+        cols = jnp.arange(k.shape[-2])[None, :]
+        future = rows < cols
+        if window is not None:
+            future = jnp.logical_or(future, rows - cols >= window)
         scores = jnp.where(future, jnp.finfo(dtype).min / 2, scores)
     attn = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('...to,...od->...td', attn, v.astype(dtype))
     if mask is not None:
         # Union semantics via the shared helper, as in ring_attention.
         out = jnp.where(
-            _row_has_valid(mask, causal, q.shape[-2], k.shape[-2]),
+            _row_has_valid(mask, causal, q.shape[-2], k.shape[-2],
+                           window=window),
             out, jnp.zeros((), out.dtype))
     return out.astype(v.dtype)

@@ -47,7 +47,7 @@ __all__ = ['ulysses_attention']
 
 def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
                       causal=False, scale=None, softmax_mode='exact',
-                      segment_ids=None):
+                      segment_ids=None, window=None):
     """Sequence-parallel attention via head↔time all-to-all re-sharding.
 
     ``q, k, v``: local shards ``(..., H, T/N, d)`` (``v`` may differ in its
@@ -128,6 +128,9 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
         seg_full = seg_full[..., None, :]
         seg_pair = (seg_full, seg_full)
 
+    # After the head scatter every device owns whole rows at global
+    # positions, so causal/window need no offset plumbing.
     out = flash_attention(qh, kh, vh, full_mask, causal=causal, scale=scale,
-                          softmax_mode=softmax_mode, segment_ids=seg_pair)
+                          softmax_mode=softmax_mode, segment_ids=seg_pair,
+                          window=window)
     return gather_heads(out)

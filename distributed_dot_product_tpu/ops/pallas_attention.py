@@ -32,9 +32,11 @@ performance pass). Layout, per the TPU Pallas playbook:
   lane(128)/sublane aligned;
 - causal programs whose whole K block lies in the masked future skip the
   matmuls entirely (``pl.when``) — ~2× for causal attention, forward and
-  backward;
-- masked logits use a large-finite negative (not ``-inf``) and fully-masked
-  rows return 0 with zero gradients, matching
+  backward; sliding-window programs additionally skip blocks wholly past
+  the window (compute linear in T);
+- masked logits are ``-inf`` (safe: every shift is clamped finite, see
+  ``_apply_masks``), so fully-masked rows return 0 with zero gradients
+  in-kernel, matching
   :mod:`distributed_dot_product_tpu.models.ring_attention` semantics (the
   reference NaNs on fully-masked rows, SURVEY §4).
 
@@ -98,7 +100,7 @@ def _pad_dim(x, axis, mult):
 
 
 def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
-                 seg=None, pos=None, mask_live=None):
+                 seg=None, pos=None, mask_live=None, window=None):
     """Shared logit masking: user mask block, segment ids, causal future,
     Tk padding.
 
@@ -135,36 +137,54 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
         s = jnp.where(seg[0][0] != seg[1][0], -jnp.inf, s)
     if pos is not None:
         s = jnp.where(pos[0][0] < pos[1][0], -jnp.inf, s)
+        if window is not None:
+            # Sliding window over explicit positions: a pair whose key
+            # lies ≥ window positions in the query's past is masked.
+            s = jnp.where(pos[0][0] - pos[1][0] >= window, -jnp.inf, s)
     if causal:
         rows = (off_ref[0, 0] + qi * bq
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(rows < cols, -jnp.inf, s)
+        if window is not None:
+            s = jnp.where(rows - cols >= window, -jnp.inf, s)
     if kv_len % bk:
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(cols >= kv_len, -jnp.inf, s)
     return s
 
 
-def _causal_run(causal, off_ref, qi, ki, bq, bk):
+def _causal_run(causal, off_ref, qi, ki, bq, bk, window=None):
     """Block-skip predicate: does this (Q block, K block) pair contain any
     un-masked causal entry? With a traced row offset this is a dynamic
-    scalar — ``pl.when`` still skips the matmuls at run time."""
+    scalar — ``pl.when`` still skips the matmuls at run time. ``window``
+    additionally skips blocks wholly ≥ window positions in the past (the
+    oldest pair is newest-query − oldest-key = block row 0 vs the K
+    block's LAST column): compute becomes O(Tq·window), not O(Tq·Tk)."""
     if not causal:
         return True
-    return off_ref[0, 0] + (qi + 1) * bq - 1 >= ki * bk
+    run = off_ref[0, 0] + (qi + 1) * bq - 1 >= ki * bk
+    if window is not None:
+        run = jnp.logical_and(
+            run, off_ref[0, 0] + qi * bq - (ki * bk + bk - 1) < window)
+    return run
 
 
-def _row_has_valid(mask, causal, tq, tk, row_offset=0):
+def _row_has_valid(mask, causal, tq, tk, row_offset=0, window=None):
     """(..., Tq, 1) bool: does row i have ANY attendable key, counting the
-    causal restriction too? Rows without one output 0 with zero gradients
-    (in every softmax path — the kernels' semantics must not depend on
-    WHICH mask made the row empty). ``row_offset`` is the global index of
-    row 0 (sequence-sharded callers pass their shard offset)."""
+    causal (and sliding-window) restriction too? Rows without one output 0
+    with zero gradients (in every softmax path — the kernels' semantics
+    must not depend on WHICH mask made the row empty). ``row_offset`` is
+    the global index of row 0 (sequence-sharded callers pass their shard
+    offset)."""
     valid = ~mask
     if causal:
         rows = row_offset + jnp.arange(tq)
-        allowed = rows[:, None] >= jnp.arange(tk)[None, :]
+        cols = jnp.arange(tk)
+        allowed = rows[:, None] >= cols[None, :]
+        if window is not None:
+            allowed = jnp.logical_and(
+                allowed, rows[:, None] - cols[None, :] < window)
         valid = jnp.logical_and(valid, allowed)
     return jnp.any(valid, axis=-1, keepdims=True)
 
@@ -299,6 +319,11 @@ _RUNSUM_SMEM_CAP = 512 * 1024
 # shapes.
 _REDIRECT_ON_INTERPRET = False
 
+# Test hook: likewise for the banded window grid (scalar-prefetch index
+# maps need the Mosaic interpreter off-TPU; the full-grid window path with
+# in-kernel skipping is the off-TPU default and is numerically identical).
+_BAND_ON_INTERPRET = False
+
 
 def _mask_streams_per_tile(nb, tq, tk, dtype, d_total, allow_redirect,
                            bwd=False):
@@ -313,6 +338,20 @@ def _mask_streams_per_tile(nb, tq, tk, dtype, d_total, allow_redirect,
     f = _bwd_block_sizes if bwd else _block_sizes
     bq, bk = f(tq, tk, dtype, d_total=d_total, has_mask=False)
     return nb * (-(-tq // bq)) * (-(-tk // bk)) * 4 > _RUNSUM_SMEM_CAP
+
+
+def _band_size(b_outer, b_inner, window, n_inner):
+    """Number of inner-axis blocks a sliding-window band can touch per
+    outer block: the band spans ``b_outer + window − 1`` positions, so at
+    most ``ceil((b_outer + window − 2)/b_inner) + 1`` blocks."""
+    return min(n_inner, (b_outer + window - 2) // b_inner + 2)
+
+
+def _band_lo(raw, n_inner, band):
+    """Clamp a band's first inner block so ``[lo, lo + band)`` stays in
+    range; edge blocks pulled into the band are masked/skipped in-kernel
+    (the run predicate uses the ACTUAL block index)."""
+    return jnp.clip(raw, 0, n_inner - band)
 
 
 def _split_aux(rest, has_mask, has_seg, has_pos):
@@ -332,7 +371,8 @@ def _split_aux(rest, has_mask, has_seg, has_pos):
     return mask_ref, seg, pos, rest
 
 
-def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref):
+def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref,
+              window=None):
     """Combined block-skip predicate from scalar SMEM tables (vector
     reductions to scalars trip Mosaic relayouts, and (1, 1, ·) VMEM blocks
     are rejected outright — SMEM with program-id indexing is the TPU way):
@@ -352,7 +392,7 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref):
     ``_apply_masks``), so skipping a fully-masked block is identical to
     folding it.
     """
-    run = _causal_run(causal, off_ref, qi, ki, bq, bk)
+    run = _causal_run(causal, off_ref, qi, ki, bq, bk, window)
 
     def _and(a, x):
         return x if a is True else jnp.logical_and(a, x)
@@ -364,14 +404,20 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref):
     if pos is not None:
         _, _, qmm, kmm = pos
         run = _and(run, qmm[b, qi, 1] >= kmm[b, ki, 0])
+        if window is not None:
+            # Whole block ≥ window in the past when even its NEWEST key
+            # precedes its OLDEST query by window or more.
+            run = _and(run, qmm[b, qi, 0] - kmm[b, ki, 1] < window)
     if runsum_ref is not None:
         run = _and(run, runsum_ref[b, qi, ki] != 0)
     return run
 
 
 def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
-                     has_mask_skip, save_lse):
+                     has_mask_skip, save_lse, window=None, band_fn=None):
     def kernel(*refs):
+        if band_fn is not None:
+            bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
         else:
@@ -384,19 +430,24 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
         else:
             (o_ref, m_s, l_s, acc_s), lse_ref = rest, None
         qi = pl.program_id(1)
-        ki = pl.program_id(2)
+        kj = pl.program_id(2)
+        # Banded window grid: the K sweep covers only this Q block's band;
+        # ki is the ACTUAL K block index (all masking/skip arithmetic uses
+        # it), kj the program position (init/finalize conditions).
+        ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
         last_k = pl.num_programs(2) - 1
 
-        @pl.when(ki == 0)
+        @pl.when(kj == 0)
         def _():
             m_s[:] = jnp.full_like(m_s, _NEG_BIG)
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
         # Block skip: K block strictly in the causal future of every query
-        # row, or provably fully masked → contributes nothing.
+        # row, fully past the sliding window, or provably fully masked →
+        # contributes nothing.
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), seg, pos, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref, window)
 
         @pl.when(run)
         def _():
@@ -416,7 +467,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, seg, pos, mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live,
+                             window)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -428,7 +480,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        @pl.when(ki == last_k)
+        @pl.when(kj == last_k)
         def _():
             l = l_s[:]
             safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -446,7 +498,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
 
 
 def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
-               bq, bk, allow_redirect=True):
+               bq, bk, allow_redirect=True, k_of=None, q_of_t=None):
     """Specs (both grid orders) + args + presence flags for the optional
     (mask, segments, block-skip table) kernel inputs, shared by the
     forward and both backward passes — args are computed ONCE (the int8
@@ -456,7 +508,17 @@ def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
 
     The skip tables (segment per-block [min, max], dense any-unmasked
     summary) are whole-array SMEM inputs pre-broadcast to the flat batch —
-    kernels index them by raw program ids, no per-input batch maps."""
+    kernels index them by raw program ids, no per-input batch maps.
+
+    ``k_of`` / ``q_of_t``: banded-window grid translations — map the
+    (batch, outer, inner, prefetch-refs) grid coordinates to the ACTUAL
+    K block (normal grids) / Q block (transposed grid). None = identity
+    (the grid axis IS the block index). Banded grids carry no dense mask
+    (asserted), so only the per-position vec specs need them."""
+    kof = k_of or (lambda b, i, j, rs: j)
+    qot = q_of_t or (lambda b, j, i, rs: i)
+    assert mask is None or (k_of is None and q_of_t is None), \
+        'banded window grids do not support dense masks'
     nqb, nkb = tq_p // bq, tk_p // bk
     nb = int(math.prod(batch)) if batch else 1
     smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -505,9 +567,11 @@ def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
         specs.append(pl.BlockSpec(
             (1, bq, 1), lambda b, i, j, *rs, f=vq_idx: (f(b), i, 0)))
         specs.append(pl.BlockSpec(
-            (1, 1, bk), lambda b, i, j, *rs, f=vk_idx: (f(b), 0, j)))
+            (1, 1, bk),
+            lambda b, i, j, *rs, f=vk_idx: (f(b), 0, kof(b, i, j, rs))))
         specs_t.append(pl.BlockSpec(
-            (1, bq, 1), lambda b, j, i, *rs, f=vq_idx: (f(b), i, 0)))
+            (1, bq, 1),
+            lambda b, j, i, *rs, f=vq_idx: (f(b), qot(b, j, i, rs), 0)))
         specs_t.append(pl.BlockSpec(
             (1, 1, bk), lambda b, j, i, *rs, f=vk_idx: (f(b), 0, j)))
         args.extend([vqf, vkf])
@@ -532,23 +596,27 @@ def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
 
 
 def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
-                 interpret, runsum):
-    """Build + invoke: a scalar-prefetch grid when a block-skip summary is
-    live (``runsum``), a plain grid otherwise. Index maps are variadic
-    (``*rs``) so the same lambdas serve both. ``interpret=True`` under
-    prefetch upgrades to the Mosaic TPU interpreter — the default HLO
-    interpreter cannot evaluate scalar-prefetch grids ("MLIR translation
-    rule for primitive 'program_id' not found for platform cpu")."""
-    if runsum is not None:
+                 interpret, prefetch):
+    """Build + invoke: a scalar-prefetch grid when any prefetch operands
+    are live (the dense-mask block-skip summary and/or the window band
+    offset), a plain grid otherwise. Prefetch refs reach both the index
+    maps (as trailing ``*rs`` args — the same lambdas serve both modes)
+    and the kernel (as leading refs). ``interpret=True`` under prefetch
+    upgrades to the Mosaic TPU interpreter — the default HLO interpreter
+    cannot evaluate scalar-prefetch grids ("MLIR translation rule for
+    primitive 'program_id' not found for platform cpu")."""
+    prefetch = [p for p in prefetch if p is not None]
+    if prefetch:
         call = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
-                out_specs=out_specs, scratch_shapes=scratch),
+                num_scalar_prefetch=len(prefetch), grid=grid,
+                in_specs=in_specs, out_specs=out_specs,
+                scratch_shapes=scratch),
             out_shape=out_shape,
             interpret=(pltpu.InterpretParams() if interpret is True
                        else interpret))
-        return lambda *a: call(runsum, *a)
+        return lambda *a: call(*prefetch, *a)
     return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
                           out_specs=out_specs, scratch_shapes=scratch,
                           out_shape=out_shape, interpret=interpret)
@@ -556,7 +624,7 @@ def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
 
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                     mode='exact', save_lse=False, segment_ids=None,
-                    positions=None):
+                    positions=None, window=None):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -581,17 +649,49 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
     vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
     tq_p, tk_p = qf.shape[1], kf.shape[1]
-    grid = (nb, tq_p // bq, tk_p // bk)
+    nqb, nkb = tq_p // bq, tk_p // bk
+
+    # Banded window grid: with a contiguous causal window, each Q block
+    # only ever folds the ~window/bk K blocks of its band — shrink the K
+    # grid axis to the band and select the actual K block in the index
+    # maps from the (scalar-prefetched) global row offset. Out-of-band
+    # blocks then cost NOTHING (no grid step, no DMA): compute and HBM
+    # traffic are O(Tq·window). Dense masks keep the full grid (their
+    # runsum tables are indexed by absolute blocks and T²-masks don't
+    # arise in the long-context configs that use windows); explicit
+    # positions keep it too (a shard's rows are not one contiguous band).
+    banded = (window is not None and causal and mask is None
+              and positions is None
+              and ((not interpret) or _BAND_ON_INTERPRET))
+    band_fn = bandoff = kof = None
+    if banded:
+        band = _band_size(bq, bk, window, nkb)
+
+        def band_fn(i, off_s):
+            return _band_lo((off_s + i * bq - (window - 1)) // bk,
+                            nkb, band)
+
+        def kof(b, i, j, rs):
+            # Single source of truth for the band's K-block translation —
+            # the q/k/v BlockSpec maps and the aux (segment) maps both
+            # derive from it (rs[0] is the prefetched global row offset).
+            return band_fn(i, rs[0][0]) + j
+        bandoff = off.reshape(1)
+        grid = (nb, nqb, band)
+    else:
+        grid = (nb, nqb, nkb)
+    k_map = lambda b, i, j, *rs: (  # noqa: E731
+        b, j if kof is None else kof(b, i, j, rs), 0)
 
     specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j, *rs: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, i, j, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), k_map),
+        pl.BlockSpec((1, bk, d_v), k_map),
     ]
     args = [qf, kf, vf]
     aux_specs, _, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
-        allow_redirect=allow_redirect)
+        allow_redirect=allow_redirect, k_of=kof)
 
     out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0))
     out_shape = jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype)
@@ -603,10 +703,11 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                      jax.ShapeDtypeStruct((nb, tq_p, 1), jnp.float32)]
 
     def run_exact(*_):
-        kernel = _make_fwd_kernel(causal, bq, bk, tk, *flags, save_lse)
+        kernel = _make_fwd_kernel(causal, bq, bk, tk, *flags, save_lse,
+                                  window, band_fn)
         return _pallas_call(
             kernel, grid, [off_spec] + specs + aux_specs, out_specs,
-            _scratch(bq, d_v), out_shape, interpret, runsum,
+            _scratch(bq, d_v), out_shape, interpret, [bandoff, runsum],
         )(off, *args, *aux_args)
 
     if mode == 'bounded':
@@ -623,11 +724,11 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
 
         def run_bounded(*_):
             kernel = _make_fwd_kernel_bounded(
-                causal, bq, bk, tk, *flags, save_lse)
+                causal, bq, bk, tk, *flags, save_lse, window, band_fn)
             return _pallas_call(
                 kernel, grid, [off_spec] + specs + [mvec_spec] + aux_specs,
                 out_specs, _scratch(bq, d_v)[1:],  # no m buffer
-                out_shape, interpret, runsum,
+                out_shape, interpret, [bandoff, runsum],
             )(off, *args, mvecf, *aux_args)
 
         # Safety net: the bound shift is only exact while
@@ -658,7 +759,8 @@ def _scratch(bq, d_v):
 
 
 def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
-                             has_pos, has_mask_skip, save_lse):
+                             has_pos, has_mask_skip, save_lse, window=None,
+                             band_fn=None):
     """Forward kernel for ``softmax_mode='bounded'``: the per-row shift is
     a precomputed upper bound on the row max (Cauchy-Schwarz,
     ``‖q_i‖·max_j‖k_j‖``, fed as an input), so the kernel drops the
@@ -672,6 +774,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
     the worst-case gap ``2·max(bound)`` exceeds ``_BOUNDED_SAFE_GAP``).
     """
     def kernel(*refs):
+        if band_fn is not None:
+            bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
         else:
@@ -684,16 +788,17 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
         else:
             (o_ref, l_s, acc_s), lse_ref = rest, None
         qi = pl.program_id(1)
-        ki = pl.program_id(2)
+        kj = pl.program_id(2)
+        ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
         last_k = pl.num_programs(2) - 1
 
-        @pl.when(ki == 0)
+        @pl.when(kj == 0)
         def _():
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), seg, pos, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref, window)
 
         @pl.when(run)
         def _():
@@ -706,14 +811,15 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, seg, pos, mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live,
+                             window)
             p = jnp.exp2(s - m_ref[0])                      # bound shift
             l_s[:] += p.sum(axis=-1, keepdims=True)
             acc_s[:] += jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        @pl.when(ki == last_k)
+        @pl.when(kj == last_k)
         def _():
             l = l_s[:]
             safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -727,8 +833,10 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                    has_pos, has_mask_skip):
+                    has_pos, has_mask_skip, window=None, band_fn=None):
     def kernel(*refs):
+        if band_fn is not None:
+            bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
         else:
@@ -739,15 +847,16 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                                               has_pos)
         dq_ref, dq_acc = rest
         qi = pl.program_id(1)
-        ki = pl.program_id(2)
+        kj = pl.program_id(2)
+        ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
         last_k = pl.num_programs(2) - 1
 
-        @pl.when(ki == 0)
+        @pl.when(kj == 0)
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
-                        pl.program_id(0), seg, pos, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref, window)
 
         @pl.when(run)
         def _():
@@ -765,7 +874,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, seg, pos, mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live,
+                             window)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
@@ -775,7 +885,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                 ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BQ, d)
 
-        @pl.when(ki == last_k)
+        @pl.when(kj == last_k)
         def _():
             dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -783,8 +893,10 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                     has_pos, has_mask_skip):
+                     has_pos, has_mask_skip, window=None, band_fn=None):
     def kernel(*refs):
+        if band_fn is not None:
+            bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
         else:
@@ -795,16 +907,19 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                                               has_pos)
         dk_ref, dv_ref, dk_acc, dv_acc = rest
         kj = pl.program_id(1)
-        qi = pl.program_id(2)
+        qr = pl.program_id(2)
+        # Banded: qr sweeps only the Q blocks whose window band touches
+        # this K block; qi is the ACTUAL Q block index.
+        qi = qr if band_fn is None else band_fn(kj, bandoff_ref[0]) + qr
         last_q = pl.num_programs(2) - 1
 
-        @pl.when(qi == 0)
+        @pl.when(qr == 0)
         def _():
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
         run = _run_pred(causal, off_ref, qi, kj, bq, bk,
-                        pl.program_id(0), seg, pos, runsum_ref)
+                        pl.program_id(0), seg, pos, runsum_ref, window)
 
         @pl.when(run)
         def _():
@@ -822,7 +937,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             mask_live = (None if runsum_ref is None else
                          runsum_ref[pl.program_id(0), qi, kj] == 1)
             s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len,
-                             mask_ref, off_ref, seg, pos, mask_live)
+                             mask_ref, off_ref, seg, pos, mask_live,
+                             window)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dv_acc[:] += jax.lax.dot_general(
                 p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -835,7 +951,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                 ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BK, d)
 
-        @pl.when(qi == last_q)
+        @pl.when(qr == last_q)
         def _():
             dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -845,7 +961,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
                     causal, interpret, grad_dtype=None, segment_ids=None,
-                    positions=None):
+                    positions=None, window=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -890,44 +1006,82 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     tq_p, tk_p = qf.shape[1], kf.shape[1]
 
     args = [qf, kf, vf, gf, lsef, deltaf]
+    nqb, nkb = tq_p // bq, tk_p // bk
+
+    # Banded window grids (see _flash_fwd_impl): the dq pass sweeps only
+    # each Q block's K band; the dk/dv pass sweeps only each K block's Q
+    # band (the transposed band, width ~window/bq).
+    banded = (window is not None and causal and mask is None
+              and positions is None
+              and ((not interpret) or _BAND_ON_INTERPRET))
+    kband_fn = qband_fn = bandoff = kof = qot = None
+    if banded:
+        kband = _band_size(bq, bk, window, nkb)
+        qband = _band_size(bk, bq, window, nqb)
+
+        def kband_fn(i, off_s):
+            return _band_lo((off_s + i * bq - (window - 1)) // bk,
+                            nkb, kband)
+
+        def qband_fn(j, off_s):
+            # First Q block with a causal view of K block j:
+            # ceil((j·bk − off − bq + 1)/bq) = floor((j·bk − off)/bq).
+            return _band_lo((j * bk - off_s) // bq, nqb, qband)
+
+        # Single source of truth for each grid's band translation — the
+        # main BlockSpec maps and the aux (segment) maps derive from
+        # these (rs[0] is the prefetched global row offset).
+        def kof(b, i, j, rs):
+            return kband_fn(i, rs[0][0]) + j
+
+        def qot(b, j, i, rs):
+            return qband_fn(j, rs[0][0]) + i
+        bandoff = off.reshape(1)
+    k_map = lambda b, i, j, *rs: (  # noqa: E731
+        b, j if kof is None else kof(b, i, j, rs), 0)
+    q_map_t = lambda b, j, i, *rs: (  # noqa: E731
+        b, i if qot is None else qot(b, j, i, rs), 0)
+
     aux_specs, aux_specs_t, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
-        allow_redirect=allow_redirect)
+        allow_redirect=allow_redirect, k_of=kof, q_of_t=qot)
 
     off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
 
-    # --- dq pass: grid (batch, Q block, K block), K innermost ---
+    # --- dq pass: grid (batch, Q block, K band), K innermost ---
     dq_in_specs = [
         off_spec,
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j, *rs: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, i, j, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), k_map),
+        pl.BlockSpec((1, bk, d_v), k_map),
         pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0)),
         pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
         pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
     ] + aux_specs
     dq = _pallas_call(
-        _make_dq_kernel(scale, causal, bq, bk, tk, *flags),
-        (nb, tq_p // bq, tk_p // bk), dq_in_specs,
+        _make_dq_kernel(scale, causal, bq, bk, tk, *flags, window=window,
+                        band_fn=kband_fn),
+        (nb, nqb, kband if banded else nkb), dq_in_specs,
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
         [pltpu.VMEM((bq, d), jnp.float32)],
         jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
-        interpret, runsum,
+        interpret, [bandoff, runsum],
     )(off, *args, *aux_args)
 
-    # --- dk/dv pass: grid (batch, K block, Q block), Q innermost ---
+    # --- dk/dv pass: grid (batch, K block, Q band), Q innermost ---
     dkv_in_specs = [
         off_spec,
-        pl.BlockSpec((1, bq, d), lambda b, j, i, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, d), q_map_t),
         pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
         pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
-        pl.BlockSpec((1, bq, d_v), lambda b, j, i, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, j, i, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, j, i, *rs: (b, i, 0)),
+        pl.BlockSpec((1, bq, d_v), q_map_t),
+        pl.BlockSpec((1, bq, 1), q_map_t),
+        pl.BlockSpec((1, bq, 1), q_map_t),
     ] + aux_specs_t
     dk, dv = _pallas_call(
-        _make_dkv_kernel(scale, causal, bq, bk, tk, *flags),
-        (nb, tk_p // bk, tq_p // bq), dkv_in_specs,
+        _make_dkv_kernel(scale, causal, bq, bk, tk, *flags, window=window,
+                         band_fn=qband_fn),
+        (nb, nkb, qband if banded else nqb), dkv_in_specs,
         [
             pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
             pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
@@ -938,7 +1092,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
             jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
             jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
         ],
-        interpret, runsum,
+        interpret, [bandoff, runsum],
     )(off, *args, *aux_args)
 
     dq = dq[:, :tq].reshape(q.shape)
@@ -968,33 +1122,36 @@ def _seg_pair(seg_q, seg_k):
     return None if seg_q is None else (seg_q, seg_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
 def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, scale,
-           causal, interpret, mode):
+           causal, interpret, mode, window):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                            interpret, mode,
                            segment_ids=_seg_pair(seg_q, seg_k),
-                           positions=_seg_pair(pos_q, pos_k))
+                           positions=_seg_pair(pos_q, pos_k),
+                           window=window)
 
 
 def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-               scale, causal, interpret, mode):
+               scale, causal, interpret, mode, window):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                                interpret, mode, save_lse=True,
                                segment_ids=_seg_pair(seg_q, seg_k),
-                               positions=_seg_pair(pos_q, pos_k))
+                               positions=_seg_pair(pos_q, pos_k),
+                               window=window)
     return out, (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
                  out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, mode, res, g):
+def _flash_bwd(scale, causal, interpret, mode, window, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
     q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, out, lse = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
                                  scale, causal, interpret,
                                  segment_ids=_seg_pair(seg_q, seg_k),
-                                 positions=_seg_pair(pos_q, pos_k))
+                                 positions=_seg_pair(pos_q, pos_k),
+                                 window=window)
     return dq, dk, dv, None, None, None, None, None, None
 
 
@@ -1003,7 +1160,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                     scale=None, interpret=None, softmax_mode='exact',
-                    segment_ids=None, positions=None):
+                    segment_ids=None, positions=None, window=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -1030,6 +1187,16 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     provably all-future are skipped like the contiguous causal skip.
     Mutually exclusive with ``causal``; composes with ``mask`` and
     ``segment_ids``.
+
+    ``window``: sliding-window (local) attention — a static positive int;
+    query at global position ``p`` attends only keys in
+    ``(p − window, p]``. Requires causal semantics (``causal=True`` or
+    ``positions``), composing as the intersection; K blocks wholly past
+    the window are skipped via the same SMEM tables as the causal skip,
+    so compute AND HBM traffic drop to O(Tq·window) — long-context cost
+    becomes linear in T. No reference analog (its module materializes
+    every (T/N, T) score row, reference module.py:66-67).
+
     Differentiable end-to-end with blockwise Pallas kernels in both
     directions — peak memory is O(T·d) for forward AND backward (the
     backward recomputes score blocks from the saved row logsumexp).
@@ -1083,5 +1250,14 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
         raise ValueError(
             'positions IS causal masking (over explicit global positions) '
             '— pass one or the other, not both')
+    if window is not None:
+        if not isinstance(window, int) or window < 1:
+            raise ValueError(f'window must be a positive int, got {window!r}')
+        if not causal and positions is None:
+            raise ValueError(
+                'window is a lookback cap and needs causal semantics: pass '
+                'causal=True (contiguous rows) or positions (explicit '
+                'layouts)')
     return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                  float(scale), bool(causal), bool(interpret), softmax_mode)
+                  float(scale), bool(causal), bool(interpret), softmax_mode,
+                  window)
