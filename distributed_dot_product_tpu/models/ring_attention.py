@@ -117,6 +117,11 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     if block_impl not in ('flash', 'xla'):
         raise ValueError(
             f"block_impl must be 'flash' or 'xla', got {block_impl!r}")
+    if (block_impl == 'xla'
+            and tuple(k.shape[:-2]) != tuple(q.shape[:-2])):
+        raise ValueError(
+            "grouped-query (GQA) k/v heads require block_impl='flash' "
+            '(the xla fold contracts q and k head axes directly)')
     if layout not in ('contiguous', 'zigzag'):
         raise ValueError(
             f"layout must be 'contiguous' or 'zigzag', got {layout!r}")

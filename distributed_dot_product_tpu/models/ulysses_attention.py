@@ -51,7 +51,10 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
     """Sequence-parallel attention via head↔time all-to-all re-sharding.
 
     ``q, k, v``: local shards ``(..., H, T/N, d)`` (``v`` may differ in its
-    feature dim). Requires ``H % N == 0`` for mesh width ``N``. ``mask``:
+    feature dim). Requires ``H % N == 0`` for mesh width ``N``. Grouped
+    K/V heads (GQA) are accepted with the extra constraint
+    ``H_kv % N == 0`` — the kv heads ride their own all_to_all, so they
+    must split over the mesh too (use the ring path when they can't). ``mask``:
     optional boolean ``(..., T/N, T)`` broadcastable over the leading dims
     — NOTE it is gathered to full ``(T, T)`` per device (see module
     docstring). ``segment_ids``: optional non-negative int ``(..., T/N)``
@@ -76,6 +79,13 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
             f'ulysses_attention requires heads ({heads}) divisible by the '
             f'mesh width ({world}); use softmax_impl="online" (ring) when '
             f'N > H')
+    if k.shape[-3] != heads and k.shape[-3] % world:
+        # GQA: the kv heads ride their own all_to_all, so they must split
+        # over the mesh too (the flash kernel then sees Hq/N : Hkv/N —
+        # the same group ratio).
+        raise ValueError(
+            f'ulysses_attention GQA requires kv heads ({k.shape[-3]}) '
+            f'divisible by the mesh width ({world})')
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
 

@@ -622,6 +622,24 @@ def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
                           out_shape=out_shape, interpret=interpret)
 
 
+def _kv_group(q, k):
+    """Grouped-query (GQA/MQA) factor: q may carry more heads than k/v —
+    lead dims must match except the head axis (-3), which must divide.
+    Returns how many consecutive flat q-batch indices share one kv block
+    (1 = standard multi-head). The flat mapping is ``b_kv = b // group``
+    because the head axis is the innermost lead dim."""
+    if tuple(k.shape[:-2]) == tuple(q.shape[:-2]):
+        return 1
+    if (q.ndim < 3 or k.ndim != q.ndim
+            or k.shape[:-3] != q.shape[:-3]
+            or q.shape[-3] % k.shape[-3]):
+        raise ValueError(
+            f'k/v lead dims {k.shape[:-2]} must equal q lead dims '
+            f'{q.shape[:-2]} or differ only on the head axis (-3) with '
+            f'q heads divisible by kv heads (GQA)')
+    return q.shape[-3] // k.shape[-3]
+
+
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                     mode='exact', save_lse=False, segment_ids=None,
                     positions=None, window=None):
@@ -629,6 +647,8 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     tk = k.shape[-2]
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
+    kv_group = _kv_group(q, k)
+    nbk = nb // kv_group
     # Scalar (1, 1) int32 input: the global index of query row 0 (possibly
     # traced, e.g. lax.axis_index under shard_map). Always fed — a dead
     # scalar read costs nothing and keeps the kernel signatures uniform.
@@ -646,8 +666,8 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     # class of error as the bf16 inputs themselves.
     q2 = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     qf = _pad_dim(q2.reshape(nb, tq, d), 1, bq)
-    kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
-    vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
+    kf = _pad_dim(k.reshape(nbk, tk, d), 1, bk)
+    vf = _pad_dim(v.reshape(nbk, tk, d_v), 1, bk)
     tq_p, tk_p = qf.shape[1], kf.shape[1]
     nqb, nkb = tq_p // bq, tk_p // bk
 
@@ -681,7 +701,7 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     else:
         grid = (nb, nqb, nkb)
     k_map = lambda b, i, j, *rs: (  # noqa: E731
-        b, j if kof is None else kof(b, i, j, rs), 0)
+        b // kv_group, j if kof is None else kof(b, i, j, rs), 0)
 
     specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
@@ -715,9 +735,11 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
         # |s2_ij| ≤ ‖q2_i‖·‖k_j‖ ≤ ‖q2_i‖·max_j‖k_j‖. The +1 covers fp32
         # accumulation rounding in the kernel's dot.
         q32 = q2.reshape(nb, tq, d).astype(jnp.float32)
-        k32 = k.reshape(nb, tk, d).astype(jnp.float32)
+        k32 = k.reshape(nbk, tk, d).astype(jnp.float32)
         qn = jnp.sqrt(jnp.sum(q32 * q32, axis=-1, keepdims=True))
         kn = jnp.sqrt(jnp.max(jnp.sum(k32 * k32, axis=-1), axis=-1))
+        if kv_group > 1:   # per-kv-head max norm → its q-head group
+            kn = jnp.repeat(kn, kv_group)
         mvec = qn * kn[:, None, None] + 1.0                 # (nb, Tq, 1)
         mvecf = _pad_dim(mvec, 1, bq)
         mvec_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0))
@@ -978,6 +1000,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     tk = k.shape[-2]
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
+    kv_group = _kv_group(q, k)
+    nbk = nb // kv_group
 
     off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -993,8 +1017,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     # no per-element multiply.
     q2 = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     qf = _pad_dim(q2.reshape(nb, tq, d), 1, bq)
-    kf = _pad_dim(k.reshape(nb, tk, d), 1, bk)
-    vf = _pad_dim(v.reshape(nb, tk, d_v), 1, bk)
+    kf = _pad_dim(k.reshape(nbk, tk, d), 1, bk)
+    vf = _pad_dim(v.reshape(nbk, tk, d_v), 1, bk)
     gf = _pad_dim(g.reshape(nb, tq, d_v), 1, bq)            # zero-padded
     # Clamp: a fully-masked row's lse is ln2·_NEG_BIG, whose ·log2e
     # conversion overflows fp32 to -inf — and the kernels' recompute
@@ -1038,7 +1062,12 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
             return qband_fn(j, rs[0][0]) + i
         bandoff = off.reshape(1)
     k_map = lambda b, i, j, *rs: (  # noqa: E731
-        b, j if kof is None else kof(b, i, j, rs), 0)
+        b // kv_group, j if kof is None else kof(b, i, j, rs), 0)
+    # dk/dv are computed as PER-Q-HEAD partials (the K/V INPUT blocks are
+    # group-shared via b // kv_group, the outputs are not) and group-summed
+    # after the call — the sequential grid cannot carry one accumulator
+    # across the group's separated kj sweeps.
+    kv_map_t = lambda b, j, i, *rs: (b // kv_group, j, 0)  # noqa: E731
     q_map_t = lambda b, j, i, *rs: (  # noqa: E731
         b, i if qot is None else qot(b, j, i, rs), 0)
 
@@ -1072,8 +1101,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     dkv_in_specs = [
         off_spec,
         pl.BlockSpec((1, bq, d), q_map_t),
-        pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), kv_map_t),
+        pl.BlockSpec((1, bk, d_v), kv_map_t),
         pl.BlockSpec((1, bq, d_v), q_map_t),
         pl.BlockSpec((1, bq, 1), q_map_t),
         pl.BlockSpec((1, bq, 1), q_map_t),
@@ -1096,8 +1125,17 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     )(off, *args, *aux_args)
 
     dq = dq[:, :tq].reshape(q.shape)
-    dk = dk[:, :tk].reshape(k.shape)
-    dv = dv[:, :tk].reshape(v.shape)
+    dk = dk[:, :tk]
+    dv = dv[:, :tk]
+    if kv_group > 1:
+        # Group members are consecutive flat q-batch indices (head axis is
+        # the innermost lead dim): sum each group's partials in fp32.
+        dk = dk.reshape(nbk, kv_group, tk, d).astype(jnp.float32).sum(1)
+        dv = dv.reshape(nbk, kv_group, tk, d_v).astype(jnp.float32).sum(1)
+        dk = dk.astype(grad_dtype or k.dtype)
+        dv = dv.astype(grad_dtype or v.dtype)
+    dk = dk.reshape(k.shape)
+    dv = dv.reshape(v.shape)
     return dq, dk, dv
 
 
@@ -1166,6 +1204,13 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
     boolean ``mask (..., Tq, Tk)`` broadcastable over the leading dims
     (True = masked out, the reference's convention, reference README.md:67).
+
+    Grouped-query attention (GQA/MQA): k/v may carry FEWER heads than q —
+    lead dims equal except the head axis (-3), q heads divisible by kv
+    heads (``Hkv = 1`` is multi-query). Each group of ``Hq/Hkv``
+    consecutive q heads attends the same K/V head; K/V HBM residency is
+    O(Hkv·T·d). Backward returns kv-head-shaped dk/dv (per-q-head
+    partials group-summed in fp32). No reference analog.
     ``segment_ids``: the compact packed-sequence mask form — a
     ``(seg_q, seg_kv)`` pair of non-negative int arrays with trailing
     shapes ``(Tq,)`` / ``(Tk,)`` (leading dims broadcastable like the
@@ -1228,6 +1273,11 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     if softmax_mode not in ('exact', 'bounded'):
         raise ValueError(f"softmax_mode must be 'exact' or 'bounded', "
                          f'got {softmax_mode!r}')
+    if v.shape[:-2] != k.shape[:-2] or v.shape[-2] != k.shape[-2]:
+        raise ValueError(
+            f'k and v must agree on lead dims and Tk; got k {k.shape}, '
+            f'v {v.shape}')
+    _kv_group(q, k)  # validate GQA lead-dim contract up front
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:

@@ -1,0 +1,219 @@
+# -*- coding: utf-8 -*-
+"""
+Grouped-query / multi-query attention (GQA/MQA) tests.
+
+Oracle pattern: repeat each K/V head over its query group
+(``jnp.repeat(k, group, axis=-3)``) and run the standard multi-head
+kernel — the GQA kernel must match, and the true ``dk``/``dv`` must equal
+the per-repeated-head gradients summed over each group. No reference
+analog (the reference module shares one head count across K/Q/V,
+reference module.py:29-39).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.ops.pallas_attention import (
+    flash_attention,
+)
+
+B, HQ, HKV, D = 2, 6, 2, 16
+GROUP = HQ // HKV
+
+pytestmark = pytest.mark.slow
+
+
+def _qkv(t, hkv=HKV, key=0):
+    k1, k2, k3 = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(k1, (B, HQ, t, D), jnp.float32)
+    k = jax.random.normal(k2, (B, hkv, t, D), jnp.float32)
+    v = jax.random.normal(k3, (B, hkv, t, D), jnp.float32)
+    return q, k, v
+
+
+def _rep(x, hkv):
+    return jnp.repeat(x, HQ // hkv, axis=-3)
+
+
+@pytest.mark.parametrize('t', [64, 100])
+@pytest.mark.parametrize('hkv', [HKV, 1])   # grouped and multi-query
+def test_gqa_forward_matches_repeated_kv(t, hkv):
+    q, k, v = _qkv(t, hkv)
+    out = flash_attention(q, k, v)
+    ref = flash_attention(q, _rep(k, hkv), _rep(v, hkv))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_gqa_gradients_are_group_sums(t=100):
+    q, k, v = _qkv(t)
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v) ** 2).sum()
+
+    def f_rep(q, kr, vr):
+        return (flash_attention(q, kr, vr) ** 2).sum()
+
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    dq_r, dk_r, dv_r = jax.grad(f_rep, argnums=(0, 1, 2))(
+        q, _rep(k, HKV), _rep(v, HKV))
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_r),
+                               atol=1e-5, rtol=1e-5)
+    for got, rep in ((dk, dk_r), (dv, dv_r)):
+        want = rep.reshape(B, HKV, GROUP, t, D).sum(2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_gqa_composes_with_mask_causal_segments():
+    t = 64
+    q, k, v = _qkv(t, key=1)
+    mask = jax.random.bernoulli(jax.random.key(5), 0.2, (B, 1, t, t))
+    seg = (jnp.arange(t, dtype=jnp.int32) * 3 // t)
+    out = flash_attention(q, k, v, mask, causal=True, segment_ids=seg)
+    ref = flash_attention(q, _rep(k, HKV), _rep(v, HKV), mask, causal=True,
+                          segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_gqa_with_window_banded(monkeypatch):
+    """GQA composes with the banded sliding-window grid: the K/V maps
+    stack the group division on the band translation."""
+    import distributed_dot_product_tpu.ops.pallas_attention as pa
+
+    t, window = 64, 11
+    q, k, v = _qkv(t, key=2)
+    ref = flash_attention(q, _rep(k, HKV), _rep(v, HKV), causal=True,
+                          window=window)
+    out_full = flash_attention(q, k, v, causal=True, window=window)
+    monkeypatch.setattr(pa, '_BAND_ON_INTERPRET', True)
+    out_band = flash_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_band), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_gqa_bounded_mode():
+    t = 64
+    q, k, v = _qkv(t, key=3)
+    out = flash_attention(q, k, v, softmax_mode='bounded')
+    ref = flash_attention(q, _rep(k, HKV), _rep(v, HKV),
+                          softmax_mode='bounded')
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_gqa_ring_attention(mesh8):
+    """Ring attention with grouped K/V heads on the CPU mesh: rotating
+    buffers carry the kv-head shapes; the per-block flash folds handle
+    the group."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+
+    t = 64
+    q, k, v = _qkv(t, key=4)
+
+    def run(q, k, v):
+        return ring_attention(q, k, v, causal=True)
+
+    out = jax.jit(jax.shard_map(
+        run, mesh=mesh8,
+        in_specs=(P(None, None, 'seq', None),) * 3,
+        out_specs=P(None, None, 'seq', None), check_vma=False))(q, k, v)
+    ref = flash_attention(q, _rep(k, HKV), _rep(v, HKV), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gqa_ring_gradients(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+
+    t = 32
+    q, k, v = _qkv(t, key=6)
+
+    def loss_ring(q, k, v):
+        fn = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=True),
+            mesh=mesh8, in_specs=(P(None, None, 'seq', None),) * 3,
+            out_specs=P(None, None, 'seq', None), check_vma=False)
+        return (fn(q, k, v) ** 2).sum()
+
+    def loss_rep(q, kr, vr):
+        return (flash_attention(q, kr, vr, causal=True) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    dq_r, dk_r, dv_r = jax.grad(loss_rep, argnums=(0, 1, 2))(
+        q, _rep(k, HKV), _rep(v, HKV))
+    want = (dq_r, dk_r.reshape(B, HKV, GROUP, t, D).sum(2),
+            dv_r.reshape(B, HKV, GROUP, t, D).sum(2))
+    for got, exp in zip(g_ring, want):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_gqa_ulysses(mesh8):
+    """Ulysses with GQA: q and kv heads ride separate all_to_alls (both
+    must divide the mesh width); HQ=8, HKV=... over an 8-wide mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ulysses_attention import (
+        ulysses_attention,
+    )
+
+    t = 64
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(k1, (B, 16, t, D), jnp.float32)
+    k = jax.random.normal(k2, (B, 8, t, D), jnp.float32)
+    v = jax.random.normal(k3, (B, 8, t, D), jnp.float32)
+
+    out = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v),
+        mesh=mesh8, in_specs=(P(None, None, 'seq', None),) * 3,
+        out_specs=P(None, None, 'seq', None), check_vma=False))(q, k, v)
+    ref = flash_attention(q, jnp.repeat(k, 2, axis=-3),
+                          jnp.repeat(v, 2, axis=-3))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gqa_validation():
+    q, k, v = _qkv(16)
+    with pytest.raises(ValueError, match='GQA'):
+        flash_attention(q, k[:1], v[:1])              # batch-dim mismatch
+    bad_k = jnp.zeros((B, 4, 16, D))                  # 6 % 4 != 0
+    with pytest.raises(ValueError, match='divisible|GQA'):
+        flash_attention(q, bad_k, bad_k)
+    with pytest.raises(ValueError, match='agree'):
+        flash_attention(q, k, v[:, :1])               # k/v head mismatch
+
+
+@pytest.fixture(scope='module')
+def mesh8():
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    return seq_mesh(8)
+
+
+def test_gqa_xla_ring_backend_rejected(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+    q, k, v = _qkv(32, key=8)
+    with pytest.raises(ValueError, match="block_impl='flash'"):
+        jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, block_impl='xla'),
+            mesh=mesh8, in_specs=(P(None, None, 'seq', None),) * 3,
+            out_specs=P(None, None, 'seq', None),
+            check_vma=False))(q, k, v)
