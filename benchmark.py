@@ -78,6 +78,10 @@ def parse_args():
                         help='attention heads (attn mode)')
     parser.add_argument('--head-dim', type=int, default=64,
                         help='per-head feature dim (attn mode)')
+    parser.add_argument('--kv-heads', type=int, default=None,
+                        help='attn mode: grouped-query K/V head count '
+                             '(< --heads, must divide it); default = '
+                             '--heads (standard multi-head)')
     parser.add_argument(
         '--offset', default=32,
         type=lambda s: None if s.lower() in ('none', 'full') else int(s),
@@ -202,10 +206,16 @@ def run_attn(args):
 
     from distributed_dot_product_tpu.parallel.mesh import globalize
     keys = jax.random.split(jax.random.key(111), 3)
-    shape = (1, h, t, d)
+    h_kv = args.kv_heads or h
+    if args.kv_heads and args.attn_impl not in ('flash', 'flash_bounded',
+                                                'online', 'ulysses'):
+        raise SystemExit('--kv-heads (GQA) needs a fused attn impl '
+                         '(flash/flash_bounded/online/ulysses)')
     spec = P(None, None, SEQ_AXIS, None)
-    q, k, v = (globalize(jax.random.normal(kk, shape, dtype),
-                         NamedSharding(mesh, spec)) for kk in keys)
+    q = globalize(jax.random.normal(keys[0], (1, h, t, d), dtype),
+                  NamedSharding(mesh, spec))
+    k, v = (globalize(jax.random.normal(kk, (1, h_kv, t, d), dtype),
+                      NamedSharding(mesh, spec)) for kk in keys[1:])
 
     # Every impl runs through shard_map (a W=1 mesh degenerates cleanly), so
     # the recorded attn_impl always names the code path actually measured.
@@ -238,7 +248,8 @@ def run_attn(args):
     peak = device_peak_bytes()
     record = {
         'mode': 'attn', 'attn_impl': args.attn_impl, 'scale': args.scale,
-        'T': t, 'heads': h, 'head_dim': d, 'world': world,
+        'T': t, 'heads': h, 'kv_heads': h_kv, 'head_dim': d,
+        'world': world,
         'dtype': args.dtype, 'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'dist_time': best, 'dist_time_mean': mean,
@@ -246,7 +257,8 @@ def run_attn(args):
         'dist_peak_bytes_per_chip': peak,
         'dist_memory_analysis': _memory_analysis(timed),
     }
-    print(f"attn[{args.attn_impl}] T={t} H={h} d={d} {world}-device: "
+    gq = '' if h_kv == h else f'/kv{h_kv}'
+    print(f"attn[{args.attn_impl}] T={t} H={h}{gq} d={d} {world}-device: "
           f"{best:.4f}s ({record['dist_gflops_per_chip']:.0f} GFLOP/s/chip"
           + (f", peak {peak / 2**30:.2f} GiB)" if peak else ")"))
     _append_record(args.file, record)

@@ -58,6 +58,9 @@ from distributed_dot_product_tpu.models.ulysses_attention import (  # noqa: F401
 from distributed_dot_product_tpu.ops.pallas_attention import (  # noqa: F401
     flash_attention,
 )
+from distributed_dot_product_tpu.ops.rope import (  # noqa: F401
+    rope, rope_seq_parallel,
+)
 from distributed_dot_product_tpu.utils.checkpoint import (  # noqa: F401
     TrainState, latest_step, restore, save,
 )

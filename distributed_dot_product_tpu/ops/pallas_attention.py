@@ -100,7 +100,8 @@ def _pad_dim(x, axis, mult):
 
 
 def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
-                 seg=None, pos=None, mask_live=None, window=None):
+                 seg=None, pos=None, mask_live=None, window=None,
+                 alibi=None):
     """Shared logit masking: user mask block, segment ids, causal future,
     Tk padding.
 
@@ -133,6 +134,22 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
             # must not be applied (``mask_live`` = this tile is mixed).
             masked = jnp.logical_and(masked, mask_live)
         s = jnp.where(masked, -jnp.inf, s)
+    if alibi is not None:
+        # ALiBi: additive relative-position bias slope·(col − row) over
+        # GLOBAL positions (the wrapper pre-folds log2e so the bias is in
+        # the kernel's log2 logit units). Distances come from the pos
+        # vectors when given (arbitrary layouts), else from the
+        # contiguous off_ref arithmetic — the wrapper guarantees one of
+        # the two (same requirement as ``window``).
+        if pos is not None:
+            dist = (pos[1][0] - pos[0][0]).astype(jnp.float32)
+        else:
+            rows = (off_ref[0, 0] + qi * bq
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (bq, bk), 1)
+            dist = (cols - rows).astype(jnp.float32)
+        s = s + alibi * dist
     if seg is not None:
         s = jnp.where(seg[0][0] != seg[1][0], -jnp.inf, s)
     if pos is not None:
@@ -354,12 +371,13 @@ def _band_lo(raw, n_inner, band):
     return jnp.clip(raw, 0, n_inner - band)
 
 
-def _split_aux(rest, has_mask, has_seg, has_pos):
-    """Pop the optional (mask, segments, positions) ref groups off the
-    input tail shared by every kernel signature (the block-skip summary
-    rides the scalar-prefetch slot instead, always ref 0). Segments and
-    positions each contribute (vec_q, vec_k, qmm, kmm) refs."""
-    mask_ref = seg = pos = None
+def _split_aux(rest, has_mask, has_seg, has_pos, has_alibi=False):
+    """Pop the optional (mask, segments, positions, alibi) ref groups off
+    the input tail shared by every kernel signature (the block-skip
+    summary rides the scalar-prefetch slot instead, always ref 0).
+    Segments and positions each contribute (vec_q, vec_k, qmm, kmm) refs;
+    alibi is one (nb,) SMEM slope table."""
+    mask_ref = seg = pos = alibi_ref = None
     if has_mask:
         mask_ref, *rest = rest
     if has_seg:
@@ -368,7 +386,9 @@ def _split_aux(rest, has_mask, has_seg, has_pos):
     if has_pos:
         vq, vk, qmm, kmm, *rest = rest
         pos = (vq, vk, qmm, kmm)
-    return mask_ref, seg, pos, rest
+    if has_alibi:
+        alibi_ref, *rest = rest
+    return mask_ref, seg, pos, alibi_ref, rest
 
 
 def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref,
@@ -414,7 +434,8 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref,
 
 
 def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
-                     has_mask_skip, save_lse, window=None, band_fn=None):
+                     has_alibi, has_mask_skip, save_lse, window=None,
+                     band_fn=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -423,8 +444,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
         else:
             runsum_ref = None
         off_ref, q_ref, k_ref, v_ref, *rest = refs
-        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
-                                              has_pos)
+        mask_ref, seg, pos, alibi_ref, rest = _split_aux(
+            rest, has_mask, has_seg, has_pos, has_alibi)
         if save_lse:
             o_ref, lse_ref, m_s, l_s, acc_s = rest
         else:
@@ -446,6 +467,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
         # Block skip: K block strictly in the causal future of every query
         # row, fully past the sliding window, or provably fully masked →
         # contributes nothing.
+        slope = None if alibi_ref is None else \
+            alibi_ref[pl.program_id(0)]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -468,7 +491,7 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
                              mask_ref, off_ref, seg, pos, mask_live,
-                             window)
+                             window, slope)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -498,7 +521,8 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
 
 
 def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
-               bq, bk, allow_redirect=True, k_of=None, q_of_t=None):
+               bq, bk, allow_redirect=True, k_of=None, q_of_t=None,
+               alibi=None):
     """Specs (both grid orders) + args + presence flags for the optional
     (mask, segments, block-skip table) kernel inputs, shared by the
     forward and both backward passes — args are computed ONCE (the int8
@@ -588,10 +612,21 @@ def _aux_setup(mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p,
         specs.extend([smem_spec, smem_spec])
         specs_t.extend([smem_spec, smem_spec])
         args.extend([qmm, kmm])
+    if alibi is not None:
+        # Per-head ALiBi slopes: one f32 scalar per flat batch entry,
+        # whole-array SMEM (kernels index by program id 0). Lead dims
+        # broadcast like a mask's (e.g. (H,) against (B, H)).
+        alead = _bcast_lead('alibi_slopes', alibi.shape, batch, 0)
+        aflat = jnp.broadcast_to(
+            jnp.asarray(alibi, jnp.float32).reshape(alead),
+            tuple(batch)).reshape(nb)
+        specs.append(smem_spec)
+        specs_t.append(smem_spec)
+        args.append(aflat)
     # prefetch == a live summary: the call becomes a scalar-prefetch grid
     # and kernels pop the summary as ref 0.
     flags = (mask is not None, segment_ids is not None,
-             positions is not None, runsum is not None)
+             positions is not None, alibi is not None, runsum is not None)
     return specs, specs_t, args, flags, runsum
 
 
@@ -642,7 +677,7 @@ def _kv_group(q, k):
 
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                     mode='exact', save_lse=False, segment_ids=None,
-                    positions=None, window=None):
+                    positions=None, window=None, alibi=None):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -711,7 +746,8 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     args = [qf, kf, vf]
     aux_specs, _, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
-        allow_redirect=allow_redirect, k_of=kof)
+        allow_redirect=allow_redirect, k_of=kof,
+        alibi=(None if alibi is None else alibi * _LOG2E))
 
     out_specs = pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0))
     out_shape = jax.ShapeDtypeStruct((nb, tq_p, d_v), v.dtype)
@@ -730,6 +766,12 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
             _scratch(bq, d_v), out_shape, interpret, [bandoff, runsum],
         )(off, *args, *aux_args)
 
+    if mode == 'bounded' and alibi is not None:
+        # The Cauchy-Schwarz row bound does not cover the additive ALiBi
+        # term (≤ 0 only for non-negative slopes on causal layouts, and
+        # slopes may be traced) — run the exact kernel instead of
+        # widening the bound; 'bounded' stays an optimization hint.
+        mode = 'exact'
     if mode == 'bounded':
         # Per-row upper bound on the (log2-unit) scores via Cauchy-Schwarz:
         # |s2_ij| ≤ ‖q2_i‖·‖k_j‖ ≤ ‖q2_i‖·max_j‖k_j‖. The +1 covers fp32
@@ -781,8 +823,8 @@ def _scratch(bq, d_v):
 
 
 def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
-                             has_pos, has_mask_skip, save_lse, window=None,
-                             band_fn=None):
+                             has_pos, has_alibi, has_mask_skip, save_lse,
+                             window=None, band_fn=None):
     """Forward kernel for ``softmax_mode='bounded'``: the per-row shift is
     a precomputed upper bound on the row max (Cauchy-Schwarz,
     ``‖q_i‖·max_j‖k_j‖``, fed as an input), so the kernel drops the
@@ -803,8 +845,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
         else:
             runsum_ref = None
         off_ref, q_ref, k_ref, v_ref, m_ref, *rest = refs
-        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
-                                              has_pos)
+        mask_ref, seg, pos, alibi_ref, rest = _split_aux(
+            rest, has_mask, has_seg, has_pos, has_alibi)
         if save_lse:
             o_ref, lse_ref, l_s, acc_s = rest
         else:
@@ -819,6 +861,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
+        slope = None if alibi_ref is None else \
+            alibi_ref[pl.program_id(0)]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -834,7 +878,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
                              mask_ref, off_ref, seg, pos, mask_live,
-                             window)
+                             window, slope)
             p = jnp.exp2(s - m_ref[0])                      # bound shift
             l_s[:] += p.sum(axis=-1, keepdims=True)
             acc_s[:] += jax.lax.dot_general(
@@ -855,7 +899,8 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                    has_pos, has_mask_skip, window=None, band_fn=None):
+                    has_pos, has_alibi, has_mask_skip, window=None,
+                    band_fn=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -865,8 +910,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref = None
         (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
-        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
-                                              has_pos)
+        mask_ref, seg, pos, alibi_ref, rest = _split_aux(
+            rest, has_mask, has_seg, has_pos, has_alibi)
         dq_ref, dq_acc = rest
         qi = pl.program_id(1)
         kj = pl.program_id(2)
@@ -877,6 +922,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
+        slope = None if alibi_ref is None else \
+            alibi_ref[pl.program_id(0)]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -897,7 +944,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                          runsum_ref[pl.program_id(0), qi, ki] == 1)
             s = _apply_masks(s, qi, ki, bq, bk, causal, kv_len,
                              mask_ref, off_ref, seg, pos, mask_live,
-                             window)
+                             window, slope)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
@@ -915,7 +962,8 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
-                     has_pos, has_mask_skip, window=None, band_fn=None):
+                     has_pos, has_alibi, has_mask_skip, window=None,
+                     band_fn=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -925,8 +973,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref = None
         (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
-        mask_ref, seg, pos, rest = _split_aux(rest, has_mask, has_seg,
-                                              has_pos)
+        mask_ref, seg, pos, alibi_ref, rest = _split_aux(
+            rest, has_mask, has_seg, has_pos, has_alibi)
         dk_ref, dv_ref, dk_acc, dv_acc = rest
         kj = pl.program_id(1)
         qr = pl.program_id(2)
@@ -940,6 +988,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
+        slope = None if alibi_ref is None else \
+            alibi_ref[pl.program_id(0)]
         run = _run_pred(causal, off_ref, qi, kj, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -960,7 +1010,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                          runsum_ref[pl.program_id(0), qi, kj] == 1)
             s = _apply_masks(s, qi, kj, bq, bk, causal, kv_len,
                              mask_ref, off_ref, seg, pos, mask_live,
-                             window)
+                             window, slope)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
             dv_acc[:] += jax.lax.dot_general(
                 p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -983,7 +1033,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
                     causal, interpret, grad_dtype=None, segment_ids=None,
-                    positions=None, window=None):
+                    positions=None, window=None, alibi=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -1073,7 +1123,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
 
     aux_specs, aux_specs_t, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
-        allow_redirect=allow_redirect, k_of=kof, q_of_t=qot)
+        allow_redirect=allow_redirect, k_of=kof, q_of_t=qot,
+        alibi=(None if alibi is None else alibi * _LOG2E))
 
     off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
 
@@ -1160,37 +1211,38 @@ def _seg_pair(seg_q, seg_k):
     return None if seg_q is None else (seg_q, seg_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
-def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, scale,
-           causal, interpret, mode, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14))
+def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
+           scale, causal, interpret, mode, window):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                            interpret, mode,
                            segment_ids=_seg_pair(seg_q, seg_k),
                            positions=_seg_pair(pos_q, pos_k),
-                           window=window)
+                           window=window, alibi=alibi)
 
 
 def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-               scale, causal, interpret, mode, window):
+               alibi, scale, causal, interpret, mode, window):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                                interpret, mode, save_lse=True,
                                segment_ids=_seg_pair(seg_q, seg_k),
                                positions=_seg_pair(pos_q, pos_k),
-                               window=window)
+                               window=window, alibi=alibi)
     return out, (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                 out, lse)
+                 alibi, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, mode, window, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
-    q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, out, lse = res
+    (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
+     out, lse) = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
                                  scale, causal, interpret,
                                  segment_ids=_seg_pair(seg_q, seg_k),
                                  positions=_seg_pair(pos_q, pos_k),
-                                 window=window)
-    return dq, dk, dv, None, None, None, None, None, None
+                                 window=window, alibi=alibi)
+    return dq, dk, dv, None, None, None, None, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -1198,7 +1250,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                     scale=None, interpret=None, softmax_mode='exact',
-                    segment_ids=None, positions=None, window=None):
+                    segment_ids=None, positions=None, window=None,
+                    alibi_slopes=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -1232,6 +1285,16 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     provably all-future are skipped like the contiguous causal skip.
     Mutually exclusive with ``causal``; composes with ``mask`` and
     ``segment_ids``.
+
+    ``alibi_slopes``: ALiBi — per-head additive bias
+    ``slope·(pos_k − pos_q)`` on the logits (lead dims broadcastable
+    against q/k/v's, e.g. ``(H,)``; the classic geometric slopes are the
+    user's choice). Needs ``causal=True`` or ``positions`` so the kernel
+    knows global positions; computed in-kernel from the same position
+    arithmetic as the causal triangle, so it costs no O(T²) input.
+    Treated as a constant in the VJP (no slope gradients — standard
+    ALiBi trains them frozen). With ``softmax_mode='bounded'`` the exact
+    kernel runs instead (the norm bound does not cover the bias term).
 
     ``window``: sliding-window (local) attention — a static positive int;
     query at global position ``p`` attends only keys in
@@ -1308,6 +1371,13 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                 'window is a lookback cap and needs causal semantics: pass '
                 'causal=True (contiguous rows) or positions (explicit '
                 'layouts)')
+    if alibi_slopes is not None:
+        alibi_slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        if not causal and positions is None:
+            raise ValueError(
+                'alibi_slopes bias by relative GLOBAL position: pass '
+                'causal=True (contiguous rows) or positions (explicit '
+                'layouts) so the kernel knows the positions')
     return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                  float(scale), bool(causal), bool(interpret), softmax_mode,
-                  window)
+                  alibi_slopes, float(scale), bool(causal), bool(interpret),
+                  softmax_mode, window)

@@ -163,6 +163,15 @@ property of compiled XLA programs, not an accounting trick.
               'intensity per score element grows with d, so the MXU rate '
               'climbs toward peak)', hdr_a, hd_rows)
 
+    gqa_rows = [
+        (f'flash H=8 kv=2 T={tlen}',
+         row(load(f'attn_benchmark_flash_gqa_kv2{suf}'), pad=False))
+        for suf, tlen in (('', 16384), ('_75k', 75000))]
+    if any(cells for _, cells in gqa_rows):
+        table('grouped-query attention (GQA, 4 q heads per K/V head: '
+              'same rate as multi-head — the kernel is compute-bound — '
+              'with 4× smaller K/V residency)', hdr_a, gqa_rows)
+
     def trow(rec):
         if rec is None:
             return None

@@ -86,6 +86,14 @@ SWEEPS = [
     # --- flash head-dim sweep: d in {64, 128, 256} x T in {16K, 75K} ---
     # Grounds the "d=64 bounds MFU" analysis in data: per-head arithmetic
     # intensity grows with d, so the rate climbs toward the MXU peak.
+    # Grouped-query attention: same compute rate as MHA (the kernel is
+    # compute-bound), 4x smaller K/V residency.
+    ('attn_benchmark_flash_gqa_kv2',
+     ['--mode', 'attn', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--kv-heads', '2', '--skip-local']),
+    ('attn_benchmark_flash_gqa_kv2_75k',
+     ['--mode', 'attn', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--kv-heads', '2', '--skip-local']),
     # (d=64, T=75000 is exactly attn_benchmark_flash above — the RESULTS
     # head-dim table reads that record instead of re-measuring it.)
     *[(f'attn_benchmark_flash_d{d}_{tag}',
