@@ -78,6 +78,9 @@ def parse_args():
                         help='attention heads (attn mode)')
     parser.add_argument('--head-dim', type=int, default=64,
                         help='per-head feature dim (attn mode)')
+    parser.add_argument('--qk-quant', choices=['int8'], default=None,
+                        help='attn mode (flash impls): int8-quantized '
+                             'QK^T on the MXU int8 path')
     parser.add_argument('--kv-heads', type=int, default=None,
                         help='attn mode: grouped-query K/V head count '
                              '(< --heads, must divide it); default = '
@@ -211,6 +214,10 @@ def run_attn(args):
                                                 'online', 'ulysses'):
         raise SystemExit('--kv-heads (GQA) needs a fused attn impl '
                          '(flash/flash_bounded/online/ulysses)')
+    if args.qk_quant and args.attn_impl not in ('flash', 'flash_bounded'):
+        raise SystemExit('--qk-quant applies to the flash impls only '
+                         '(the record must name the path actually '
+                         'measured)')
     spec = P(None, None, SEQ_AXIS, None)
     q = globalize(jax.random.normal(keys[0], (1, h, t, d), dtype),
                   NamedSharding(mesh, spec))
@@ -232,7 +239,8 @@ def run_attn(args):
         def body(q, k, v):
             kf = jax.lax.all_gather(k, SEQ_AXIS, axis=2, tiled=True)
             vf = jax.lax.all_gather(v, SEQ_AXIS, axis=2, tiled=True)
-            return flash_attention(q, kf, vf, softmax_mode=smode)
+            return flash_attention(q, kf, vf, softmax_mode=smode,
+                                   qk_quant=args.qk_quant)
     else:
         def body(q, k, v):
             s = distributed_matmul_nt(q, k, args.offset) / np.sqrt(d)
@@ -249,7 +257,7 @@ def run_attn(args):
     record = {
         'mode': 'attn', 'attn_impl': args.attn_impl, 'scale': args.scale,
         'T': t, 'heads': h, 'kv_heads': h_kv, 'head_dim': d,
-        'world': world,
+        'qk_quant': args.qk_quant, 'world': world,
         'dtype': args.dtype, 'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'dist_time': best, 'dist_time_mean': mean,

@@ -167,10 +167,16 @@ property of compiled XLA programs, not an accounting trick.
         (f'flash H=8 kv=2 T={tlen}',
          row(load(f'attn_benchmark_flash_gqa_kv2{suf}'), pad=False))
         for suf, tlen in (('', 16384), ('_75k', 75000))]
+    int8_row = row(load('attn_benchmark_flash_d256_16k_int8'), pad=False)
+    if int8_row:
+        gqa_rows.append(('flash d=256 T=16384 qk_quant=int8', int8_row))
     if any(cells for _, cells in gqa_rows):
         table('grouped-query attention (GQA, 4 q heads per K/V head: '
               'same rate as multi-head — the kernel is compute-bound — '
-              'with 4× smaller K/V residency)', hdr_a, gqa_rows)
+              'with 4× smaller K/V residency) and int8-quantized QK^T '
+              '(MXU int8 path: +11% at d=256 where the kernel is '
+              'MXU-bound; no win at d≤128 — dequant multiplies cost VPU '
+              'time)', hdr_a, gqa_rows)
 
     def trow(rec):
         if rec is None:

@@ -94,6 +94,11 @@ SWEEPS = [
     ('attn_benchmark_flash_gqa_kv2_75k',
      ['--mode', 'attn', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--kv-heads', '2', '--skip-local']),
+    # int8-quantized QK^T at the head dim where it wins (MXU-bound).
+    ('attn_benchmark_flash_d256_16k_int8',
+     ['--mode', 'attn', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--head-dim', '256', '--qk-quant', 'int8',
+      '--skip-local']),
     # (d=64, T=75000 is exactly attn_benchmark_flash above — the RESULTS
     # head-dim table reads that record instead of re-measuring it.)
     *[(f'attn_benchmark_flash_d{d}_{tag}',

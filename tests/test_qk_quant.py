@@ -1,0 +1,142 @@
+# -*- coding: utf-8 -*-
+"""
+int8-quantized QK^T flash attention tests.
+
+Two oracles: (a) the EXACT bf16/f32 kernel — the quantized forward must
+land within int8 rounding noise of it; (b) a dense jnp re-implementation
+of the SAME quantized math with straight-through rounding — the kernel's
+VJP must match ITS gradients to float precision (the quantized path is a
+different, self-consistent function, not a noisy version of the exact
+one). No reference analog.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.ops.pallas_attention import (
+    flash_attention,
+)
+
+B, H, D = 2, 3, 32
+
+pytestmark = pytest.mark.slow
+
+
+def _qkv(t, key=0, h=H):
+    ks = jax.random.split(jax.random.key(key), 3)
+    return tuple(jax.random.normal(kk, (B, h, t, D)) for kk in ks)
+
+
+def _dense_quant(q, k, v, causal=True, window=None):
+    """The same quantized computation in jnp, STE rounding."""
+    def ste_round(x):
+        return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+    t = q.shape[-2]
+    scale = 1.0 / np.sqrt(D)
+    sq = jax.lax.stop_gradient(
+        jnp.maximum(jnp.abs(q).max(-1, keepdims=True) / 127.0, 1e-20))
+    sk = jax.lax.stop_gradient(
+        jnp.maximum(jnp.abs(k).max(-1, keepdims=True) / 127.0, 1e-20))
+    s = jnp.einsum('...td,...od->...to', ste_round(q / sq) * sq,
+                   ste_round(k / sk) * sk) * scale
+    rows = jnp.arange(t)[:, None]
+    cols = jnp.arange(t)[None, :]
+    if causal:
+        s = jnp.where(rows < cols, -jnp.inf, s)
+    if window is not None:
+        s = jnp.where(rows - cols >= window, -jnp.inf, s)
+    return jnp.einsum('...to,...od->...td', jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize('t', [64, 100])
+def test_quant_forward_matches_quant_oracle(t):
+    q, k, v = _qkv(t)
+    out = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    ref = _dense_quant(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_quant_close_to_exact():
+    """Quantization noise stays in the int8 class (~1% of output scale)."""
+    q, k, v = _qkv(64, key=1)
+    out_q = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    out_e = flash_attention(q, k, v, causal=True)
+    err = float(jnp.abs(out_q - out_e).max())
+    assert err < 5e-2, err
+
+
+def test_quant_gradients_match_quant_oracle():
+    t = 100
+    q, k, v = _qkv(t, key=2)
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                qk_quant='int8') ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (_dense_quant(q, k, v) ** 2).sum()
+
+    lk, gk = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+    lr, gr = jax.value_and_grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(lk), float(lr), rtol=1e-6)
+    for got, want in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_quant_with_window_banded(monkeypatch):
+    import distributed_dot_product_tpu.ops.pallas_attention as pa
+
+    t, window = 64, 11
+    q, k, v = _qkv(t, key=3)
+    ref = _dense_quant(q, k, v, window=window)
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          qk_quant='int8')
+    monkeypatch.setattr(pa, '_BAND_ON_INTERPRET', True)
+    out_band = flash_attention(q, k, v, causal=True, window=window,
+                               qk_quant='int8')
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_band), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_quant_with_gqa():
+    t = 64
+    q, _, _ = _qkv(t, key=4)
+    kk, kv = jax.random.split(jax.random.key(5))
+    k = jax.random.normal(kk, (B, 1, t, D))      # MQA
+    v = jax.random.normal(kv, (B, 1, t, D))
+    out = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    ref = _dense_quant(q, jnp.broadcast_to(k, q.shape),
+                       jnp.broadcast_to(v, q.shape))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_quant_bounded_mode_falls_back():
+    q, k, v = _qkv(64, key=6)
+    out_b = flash_attention(q, k, v, causal=True, qk_quant='int8',
+                            softmax_mode='bounded')
+    out_e = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_e),
+                               atol=1e-6)
+
+
+def test_quant_zero_rows_safe():
+    """All-zero q/k rows: eps-clamped scales, no NaN."""
+    q, k, v = _qkv(64, key=7)
+    q = q.at[..., :8, :].set(0.0)
+    k = k.at[..., :8, :].set(0.0)
+    out = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_quant_validation():
+    q, k, v = _qkv(16)
+    with pytest.raises(ValueError, match='qk_quant'):
+        flash_attention(q, k, v, qk_quant='int4')
