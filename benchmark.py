@@ -214,10 +214,11 @@ def run_attn(args):
                                                 'online', 'ulysses'):
         raise SystemExit('--kv-heads (GQA) needs a fused attn impl '
                          '(flash/flash_bounded/online/ulysses)')
-    if args.qk_quant and args.attn_impl not in ('flash', 'flash_bounded'):
-        raise SystemExit('--qk-quant applies to the flash impls only '
+    if args.qk_quant and args.attn_impl != 'flash':
+        raise SystemExit('--qk-quant applies to --attn-impl flash only '
                          '(the record must name the path actually '
-                         'measured)')
+                         'measured; flash_bounded would silently coerce '
+                         'to the exact kernel when quantized)')
     spec = P(None, None, SEQ_AXIS, None)
     q = globalize(jax.random.normal(keys[0], (1, h, t, d), dtype),
                   NamedSharding(mesh, spec))

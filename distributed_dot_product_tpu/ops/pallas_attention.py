@@ -433,6 +433,38 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref,
     return run
 
 
+def _dropout_keep(seed_ref, b, qi, ki, bq, bk, rate, off=0):
+    """Per-block keep mask for attention-weight dropout, as a PURE
+    function of (seed, flat batch, GLOBAL element coordinates) — a
+    counter-based murmur3-finalizer hash, not a stateful PRNG. Element
+    coordinates make the mask independent of the block decomposition, so
+    the dq and dk/dv passes (whose block sizes legitimately differ from
+    the forward's at large head dims / streamed masks) regenerate the
+    forward's EXACT mask from any grid, banded or not — and the same
+    code runs under the plain interpreter (no TPU PRNG primitives).
+    ``off`` is the global index of query row 0 (the kernels pass their
+    ``off_ref``): sequence-parallel shards sharing one replicated seed
+    then hash DIFFERENT global rows instead of repeating one shard's
+    pattern. Returns a (bq, bk) bool and the 1/(1−rate) scale."""
+    u = jnp.uint32
+    rows = (off + qi * bq
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)).astype(u)
+    cols = (ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            ).astype(u)
+    x = (rows * u(2654435761)
+         ^ cols * u(2246822519)
+         ^ (seed_ref[0, 0].astype(u)
+            + jnp.asarray(b, jnp.int32).astype(u) * u(668265263)))
+    # murmur3 fmix32: full avalanche, so adjacent coordinates decorrelate.
+    x = x ^ (x >> u(16))
+    x = x * u(2246822507)
+    x = x ^ (x >> u(13))
+    x = x * u(3266489909)
+    x = x ^ (x >> u(16))
+    threshold = u(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= threshold, 1.0 / (1.0 - rate)
+
+
 def _score_block(q_ref, k_ref, quant):
     """(BQ, BK) score block in log2 logit units. Standard path: q arrived
     pre-folded by scale·log2e (the exp2 trick), one bf16 MXU dot.
@@ -454,7 +486,7 @@ def _score_block(q_ref, k_ref, quant):
 
 def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
                      has_alibi, has_mask_skip, save_lse, window=None,
-                     band_fn=None, quantized=False):
+                     band_fn=None, quantized=False, dropout=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -462,7 +494,10 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
             runsum_ref, *refs = refs
         else:
             runsum_ref = None
-        off_ref, q_ref, k_ref, v_ref, *rest = refs
+        off_ref, *refs = refs
+        if dropout is not None:
+            seed_ref, *refs = refs
+        q_ref, k_ref, v_ref, *rest = refs
         quant = None
         if quantized:
             sqf_ref, skr_ref, *rest = rest
@@ -490,8 +525,9 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
         # Block skip: K block strictly in the causal future of every query
         # row, fully past the sliding window, or provably fully masked →
         # contributes nothing.
-        slope = None if alibi_ref is None else \
-            alibi_ref[pl.program_id(0)]
+        pid_b = pl.program_id(0)  # hoisted: program_id inside a
+        # pl.when body is not substituted by the plain interpreter
+        slope = None if alibi_ref is None else alibi_ref[pid_b]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -517,9 +553,19 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
             p = jnp.exp2(s - m_new)
             corr = jnp.exp2(m_prev - m_new)
             m_s[:] = m_new
+            # Dropout acts on the NORMALIZED weights, so the denominator
+            # accumulates the undropped p while the numerator folds the
+            # kept entries (inverted-dropout scaled) — algebraically
+            # identical to dropout(softmax(s))·v.
             l_s[:] = l_s[:] * corr + p.sum(axis=-1, keepdims=True)
+            p_num = p
+            if dropout is not None:
+                keep, inv = _dropout_keep(seed_ref, pid_b, qi, ki,
+                                          bq, bk, dropout,
+                                          off_ref[0, 0])
+                p_num = jnp.where(keep, p, 0.0) * inv
             acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         @pl.when(kj == last_k)
@@ -660,6 +706,11 @@ def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
     cannot evaluate scalar-prefetch grids ("MLIR translation rule for
     primitive 'program_id' not found for platform cpu")."""
     prefetch = [p for p in prefetch if p is not None]
+    interp = interpret
+    if interpret is True and prefetch:
+        # The HLO interpreter cannot evaluate scalar-prefetch grids —
+        # upgrade to the Mosaic TPU interpreter.
+        interp = pltpu.InterpretParams()
     if prefetch:
         call = pl.pallas_call(
             kernel,
@@ -667,13 +718,11 @@ def _pallas_call(kernel, grid, in_specs, out_specs, scratch, out_shape,
                 num_scalar_prefetch=len(prefetch), grid=grid,
                 in_specs=in_specs, out_specs=out_specs,
                 scratch_shapes=scratch),
-            out_shape=out_shape,
-            interpret=(pltpu.InterpretParams() if interpret is True
-                       else interpret))
+            out_shape=out_shape, interpret=interp)
         return lambda *a: call(*prefetch, *a)
     return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
                           out_specs=out_specs, scratch_shapes=scratch,
-                          out_shape=out_shape, interpret=interpret)
+                          out_shape=out_shape, interpret=interp)
 
 
 def _quantize_rows(x, nb_x, t, d):
@@ -708,7 +757,8 @@ def _kv_group(q, k):
 
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                     mode='exact', save_lse=False, segment_ids=None,
-                    positions=None, window=None, alibi=None, qk_quant=None):
+                    positions=None, window=None, alibi=None, qk_quant=None,
+                    dropout_rate=0.0, dropout_seed=None):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
@@ -796,6 +846,11 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                 j if kof is None else kof(b, i, j, rs))),
         ]
         args += [sqf, skr]
+    dropout = float(dropout_rate) if dropout_rate else None
+    seed_specs, seed_args = [], []
+    if dropout is not None:
+        seed_specs = [off_spec]
+        seed_args = [jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)]
     aux_specs, _, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
         allow_redirect=allow_redirect, k_of=kof,
@@ -812,12 +867,15 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
 
     def run_exact(*_):
         kernel = _make_fwd_kernel(causal, bq, bk, tk, *flags, save_lse,
-                                  window, band_fn, quantized)
+                                  window, band_fn, quantized, dropout)
         return _pallas_call(
-            kernel, grid, [off_spec] + specs + aux_specs, out_specs,
-            _scratch(bq, d_v), out_shape, interpret, [bandoff, runsum],
-        )(off, *args, *aux_args)
+            kernel, grid, [off_spec] + seed_specs + specs + aux_specs,
+            out_specs, _scratch(bq, d_v), out_shape, interpret,
+            [bandoff, runsum],
+        )(off, *seed_args, *args, *aux_args)
 
+    if mode == 'bounded' and dropout is not None:
+        mode = 'exact'   # one exact-kernel surface carries dropout
     if mode == 'bounded' and quantized:
         # The bounded shift would need quantization-aware bounds; the
         # exact kernel's running max is already correct on the dequantized
@@ -918,8 +976,9 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
-        slope = None if alibi_ref is None else \
-            alibi_ref[pl.program_id(0)]
+        pid_b = pl.program_id(0)  # hoisted: program_id inside a
+        # pl.when body is not substituted by the plain interpreter
+        slope = None if alibi_ref is None else alibi_ref[pid_b]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -957,7 +1016,7 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                     has_pos, has_alibi, has_mask_skip, window=None,
-                    band_fn=None, quantized=False):
+                    band_fn=None, quantized=False, dropout=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -965,7 +1024,10 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref, *refs = refs
         else:
             runsum_ref = None
-        (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+        off_ref, *refs = refs
+        if dropout is not None:
+            seed_ref, *refs = refs
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
         quant = None
         if quantized:
@@ -983,8 +1045,9 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
-        slope = None if alibi_ref is None else \
-            alibi_ref[pl.program_id(0)]
+        pid_b = pl.program_id(0)  # hoisted: program_id inside a
+        # pl.when body is not substituted by the plain interpreter
+        slope = None if alibi_ref is None else alibi_ref[pid_b]
         run = _run_pred(causal, off_ref, qi, ki, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -1008,6 +1071,13 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BQ, BK)
+            if dropout is not None:
+                # Same element-coordinate mask as the forward; Δ already
+                # equals rowsum(m̃·a ⊙ dp) by the rowsum(dO⊙O) identity.
+                keep, inv = _dropout_keep(seed_ref, pid_b, qi, ki,
+                                          bq, bk, dropout,
+                                          off_ref[0, 0])
+                dp = jnp.where(keep, dp, 0.0) * inv
             if quantized:
                 k_op = (k_ref[0].astype(jnp.float32)
                         * skc_ref[0]).astype(v.dtype)
@@ -1027,7 +1097,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                      has_pos, has_alibi, has_mask_skip, window=None,
-                     band_fn=None, quantized=False):
+                     band_fn=None, quantized=False, dropout=None):
     def kernel(*refs):
         if band_fn is not None:
             bandoff_ref, *refs = refs
@@ -1035,7 +1105,10 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             runsum_ref, *refs = refs
         else:
             runsum_ref = None
-        (off_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+        off_ref, *refs = refs
+        if dropout is not None:
+            seed_ref, *refs = refs
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          *rest) = refs
         quant = None
         if quantized:
@@ -1056,8 +1129,9 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
 
-        slope = None if alibi_ref is None else \
-            alibi_ref[pl.program_id(0)]
+        pid_b = pl.program_id(0)  # hoisted: program_id inside a
+        # pl.when body is not substituted by the plain interpreter
+        slope = None if alibi_ref is None else alibi_ref[pid_b]
         run = _run_pred(causal, off_ref, qi, kj, bq, bk,
                         pl.program_id(0), seg, pos, runsum_ref, window)
 
@@ -1078,12 +1152,20 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                              mask_ref, off_ref, seg, pos, mask_live,
                              window, slope)
             p = jnp.exp2(s - lse_ref[0])                    # (BQ, BK)
+            p_num = p
+            if dropout is not None:
+                keep, inv = _dropout_keep(seed_ref, pid_b, qi, kj,
+                                          bq, bk, dropout,
+                                          off_ref[0, 0])
+                p_num = jnp.where(keep, p, 0.0) * inv
             dv_acc[:] += jax.lax.dot_general(
-                p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+                p_num.astype(g.dtype), g, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BK, dv)
             dp = jax.lax.dot_general(
                 g, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BQ, BK)
+            if dropout is not None:
+                dp = jnp.where(keep, dp, 0.0) * inv
             if quantized:
                 q_op = (q_ref[0].astype(jnp.float32)
                         * sqc_ref[0]).astype(v.dtype)
@@ -1106,7 +1188,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
                     causal, interpret, grad_dtype=None, segment_ids=None,
-                    positions=None, window=None, alibi=None, qk_quant=None):
+                    positions=None, window=None, alibi=None, qk_quant=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -1216,6 +1299,11 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         alibi=(None if alibi is None else alibi * _LOG2E))
 
     off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
+    dropout = float(dropout_rate) if dropout_rate else None
+    seed_specs, seed_args = [], []
+    if dropout is not None:
+        seed_specs = [off_spec]
+        seed_args = [jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)]
 
     quant_specs = quant_specs_t = []
     if quantized:
@@ -1241,6 +1329,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     # --- dq pass: grid (batch, Q block, K band), K innermost ---
     dq_in_specs = [
         off_spec,
+        *seed_specs,
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
         pl.BlockSpec((1, bk, d), k_map),
         pl.BlockSpec((1, bk, d_v), k_map),
@@ -1250,17 +1339,19 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     ] + quant_specs + aux_specs
     dq = _pallas_call(
         _make_dq_kernel(scale, causal, bq, bk, tk, *flags, window=window,
-                        band_fn=kband_fn, quantized=quantized),
+                        band_fn=kband_fn, quantized=quantized,
+                        dropout=dropout),
         (nb, nqb, kband if banded else nkb), dq_in_specs,
         pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
         [pltpu.VMEM((bq, d), jnp.float32)],
         jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
         interpret, [bandoff, runsum],
-    )(off, *args, *aux_args)
+    )(off, *seed_args, *args, *aux_args)
 
     # --- dk/dv pass: grid (batch, K block, Q band), Q innermost ---
     dkv_in_specs = [
         off_spec,
+        *seed_specs,
         pl.BlockSpec((1, bq, d), q_map_t),
         pl.BlockSpec((1, bk, d), kv_map_t),
         pl.BlockSpec((1, bk, d_v), kv_map_t),
@@ -1270,7 +1361,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     ] + quant_specs_t + aux_specs_t
     dk, dv = _pallas_call(
         _make_dkv_kernel(scale, causal, bq, bk, tk, *flags, window=window,
-                         band_fn=qband_fn, quantized=quantized),
+                         band_fn=qband_fn, quantized=quantized,
+                         dropout=dropout),
         (nb, nkb, qband if banded else nqb), dkv_in_specs,
         [
             pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
@@ -1283,7 +1375,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
             jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
         ],
         interpret, [bandoff, runsum],
-    )(off, *args, *aux_args)
+    )(off, *seed_args, *args, *aux_args)
 
     dq = dq[:, :tq].reshape(q.shape)
     dk = dk[:, :tk]
@@ -1322,40 +1414,49 @@ def _seg_pair(seg_q, seg_k):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(10, 11, 12, 13, 14, 15))
+                   nondiff_argnums=(11, 12, 13, 14, 15, 16, 17))
 def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
-           scale, causal, interpret, mode, window, qk_quant):
+           dropout_seed, scale, causal, interpret, mode, window, qk_quant,
+           dropout_rate):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                            interpret, mode,
                            segment_ids=_seg_pair(seg_q, seg_k),
                            positions=_seg_pair(pos_q, pos_k),
-                           window=window, alibi=alibi, qk_quant=qk_quant)
+                           window=window, alibi=alibi, qk_quant=qk_quant,
+                           dropout_rate=dropout_rate,
+                           dropout_seed=dropout_seed)
 
 
 def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-               alibi, scale, causal, interpret, mode, window, qk_quant):
+               alibi, dropout_seed, scale, causal, interpret, mode, window,
+               qk_quant, dropout_rate):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                                interpret, mode, save_lse=True,
                                segment_ids=_seg_pair(seg_q, seg_k),
                                positions=_seg_pair(pos_q, pos_k),
                                window=window, alibi=alibi,
-                               qk_quant=qk_quant)
+                               qk_quant=qk_quant,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
     return out, (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                 alibi, out, lse)
+                 alibi, dropout_seed, out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, mode, window, qk_quant, res, g):
+def _flash_bwd(scale, causal, interpret, mode, window, qk_quant,
+               dropout_rate, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
     (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
-     out, lse) = res
+     dropout_seed, out, lse) = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
                                  scale, causal, interpret,
                                  segment_ids=_seg_pair(seg_q, seg_k),
                                  positions=_seg_pair(pos_q, pos_k),
                                  window=window, alibi=alibi,
-                                 qk_quant=qk_quant)
-    return dq, dk, dv, None, None, None, None, None, None, None
+                                 qk_quant=qk_quant,
+                                 dropout_rate=dropout_rate,
+                                 dropout_seed=dropout_seed)
+    return (dq, dk, dv, None, None, None, None, None, None, None, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -1364,7 +1465,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                     scale=None, interpret=None, softmax_mode='exact',
                     segment_ids=None, positions=None, window=None,
-                    alibi_slopes=None, qk_quant=None):
+                    alibi_slopes=None, qk_quant=None, dropout_rate=0.0,
+                    dropout_seed=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -1398,6 +1500,16 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     provably all-future are skipped like the contiguous causal skip.
     Mutually exclusive with ``causal``; composes with ``mask`` and
     ``segment_ids``.
+
+    ``dropout_rate``/``dropout_seed``: attention-weight dropout
+    (inverted scaling, applied to the normalized weights) with the mask
+    generated IN-KERNEL as a pure hash of (seed, batch, global element
+    coordinates) — no O(Tq·Tk) mask tensor, no RNG state, and because
+    the mask depends only on element coordinates it is identical across
+    block decompositions (the backward's blocks legitimately differ),
+    grid orders AND backends: a given seed reproduces the same mask on
+    CPU and TPU. The seed is explicit (int or traced int32 scalar;
+    derive it from your ``jax.random`` key).
 
     ``qk_quant='int8'``: per-row symmetric int8 quantization of q and k —
     the score matmul runs on the MXU's int8 path (2× the bf16 rate raw;
@@ -1505,6 +1617,17 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     if qk_quant not in (None, 'int8'):
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')
+    dropout_rate = float(dropout_rate)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f'dropout_rate must be in [0, 1), '
+                         f'got {dropout_rate}')
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            'dropout needs an explicit dropout_seed (int or traced int32 '
+            'scalar) — the kernel holds no hidden RNG state; derive it '
+            'from your jax.random key, e.g. '
+            'jax.random.randint(key, (), 0, 2**31 - 1)')
     return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                  alibi_slopes, float(scale), bool(causal), bool(interpret),
-                  softmax_mode, window, qk_quant)
+                  alibi_slopes, dropout_seed, float(scale), bool(causal),
+                  bool(interpret), softmax_mode, window, qk_quant,
+                  dropout_rate)

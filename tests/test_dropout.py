@@ -1,0 +1,187 @@
+# -*- coding: utf-8 -*-
+"""
+Attention-weight dropout tests.
+
+The keep mask is a pure hash of (seed, batch, global element coords), so
+it can be RECOVERED exactly from the kernel itself: with ``v = I`` the
+output IS the dropped weight matrix (entries are exactly 0 where
+dropped — ``jnp.where`` semantics). That recovered mask feeds a dense
+jnp oracle for exact forward and gradient comparison on any backend —
+including the regimes where the forward and backward kernels use
+DIFFERENT block sizes (large ``d_total``), which a block-seeded PRNG
+would get wrong. No reference analog.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.ops.pallas_attention import (
+    flash_attention,
+)
+
+B, H, T, D = 2, 3, 64, 32
+RATE = 0.3
+
+pytestmark = pytest.mark.slow
+
+
+def _qkv(key=0, t=T, d=D, b=B):
+    ks = jax.random.split(jax.random.key(key), 3)
+    return tuple(jax.random.normal(kk, (b, H, t, d)) for kk in ks)
+
+
+def _recover_keep(q, k, seed, rate=RATE, **kw):
+    """Dropped-weights trick: v = I gives (m̃ ⊙ a); nonzero ⇔ kept.
+    (Entries where a == 0 — e.g. the causal future — are reported as
+    dropped, which is harmless: their weight contributes nothing.)"""
+    t = k.shape[-2]
+    eye = jnp.broadcast_to(jnp.eye(t, dtype=q.dtype),
+                           (*k.shape[:-2], t, t))
+    w = flash_attention(q, k, eye, dropout_rate=rate, dropout_seed=seed,
+                        **kw)
+    return w != 0
+
+
+def _dense(q, k, v, keep, rate=RATE, causal=True, window=None):
+    t, tk = q.shape[-2], k.shape[-2]
+    s = jnp.einsum('...td,...od->...to', q / np.sqrt(q.shape[-1]), k)
+    rows = jnp.arange(t)[:, None]
+    cols = jnp.arange(tk)[None, :]
+    if causal:
+        s = jnp.where(rows < cols, -jnp.inf, s)
+    if window is not None:
+        s = jnp.where(rows - cols >= window, -jnp.inf, s)
+    a = jax.nn.softmax(s, axis=-1)
+    m = jax.lax.stop_gradient(keep.astype(a.dtype)) / (1.0 - rate)
+    return jnp.einsum('...to,...od->...td', a * m, v)
+
+
+def test_dropout_forward_matches_dense_oracle():
+    q, k, v = _qkv()
+    keep = _recover_keep(q, k, seed=11, causal=True)
+    out = flash_attention(q, k, v, causal=True, dropout_rate=RATE,
+                          dropout_seed=11)
+    ref = _dense(q, k, v, keep)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_dropout_gradients_match_dense_oracle():
+    q, k, v = _qkv(key=1)
+    keep = _recover_keep(q, k, seed=5, causal=True)
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, causal=True, dropout_rate=RATE,
+                                dropout_seed=5) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (_dense(q, k, v, keep) ** 2).sum()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=1e-4)
+
+
+def test_dropout_mask_blocksize_invariant_gradients():
+    """The regression the element-coordinate hash exists for: at
+    d_total > 256 the backward uses SMALLER blocks than the forward
+    (``_bwd_block_sizes``); the mask must be identical anyway. b=1,
+    d=160 (d_total=320) with T=128 exercises exactly that divergence."""
+    t, d = 128, 160
+    ks = jax.random.split(jax.random.key(9), 3)
+    q, k, v = (jax.random.normal(kk, (1, t, d)) for kk in ks)
+    eye = jnp.eye(t, dtype=q.dtype)[None]
+    w = flash_attention(q, k, eye, dropout_rate=RATE, dropout_seed=3)
+    keep = w != 0
+
+    def f(q, k, v):
+        return (flash_attention(q, k, v, dropout_rate=RATE,
+                                dropout_seed=3) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (_dense(q, k, v, keep, causal=False) ** 2).sum()
+
+    np.testing.assert_allclose(float(f(q, k, v)), float(f_ref(q, k, v)),
+                               rtol=1e-5)
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-4, rtol=2e-4)
+
+
+def test_dropout_zero_rate_is_exact():
+    q, k, v = _qkv(key=2)
+    out = flash_attention(q, k, v, causal=True, dropout_rate=0.0)
+    ref = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_dropout_deterministic_and_seed_sensitive():
+    q, k, v = _qkv(key=3)
+    kw = dict(causal=True, dropout_rate=RATE)
+    a = flash_attention(q, k, v, dropout_seed=1, **kw)
+    b = flash_attention(q, k, v, dropout_seed=1, **kw)
+    c = flash_attention(q, k, v, dropout_seed=2, **kw)
+    assert bool(jnp.array_equal(a, b))
+    assert not bool(jnp.array_equal(a, c))
+
+
+def test_dropout_keep_rate_and_expectation():
+    q, k, v = _qkv(key=4)
+    keep = _recover_keep(q, k, seed=21, causal=False)
+    kept = float(jnp.mean(keep.astype(jnp.float32)))
+    assert abs(kept - (1 - RATE)) < 0.02, kept
+    # Inverted dropout: averaging over seeds recovers the exact output
+    # (non-causal so every row has T keys; loose LLN tolerance).
+    exact = flash_attention(q, k, v)
+    mean = jnp.stack([
+        flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=s)
+        for s in range(48)]).mean(0)
+    # Loose: the max over B·H·T·D elements of a 1/√48-scaled deviation.
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(exact),
+                               atol=0.25)
+
+
+def test_dropout_composes_with_window():
+    q, k, v = _qkv(key=6)
+    window = 17
+    kw = dict(causal=True, window=window)
+    keep = _recover_keep(q, k, seed=9, **kw)
+    out = flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=9,
+                          **kw)
+    ref = _dense(q, k, v, keep, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_dropout_validation():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match='dropout_seed'):
+        flash_attention(q, k, v, dropout_rate=0.5)
+    with pytest.raises(ValueError, match='dropout_rate'):
+        flash_attention(q, k, v, dropout_rate=1.0, dropout_seed=0)
+    with pytest.raises(ValueError, match='dropout_rate'):
+        flash_attention(q, k, v, dropout_rate=-0.1, dropout_seed=0)
+
+
+def test_dropout_shards_decorrelated_by_offset():
+    """Sequence-parallel shards share a replicated seed but pass their
+    global row offset — their masks must differ (the hash tracks global
+    rows, not shard-local ones)."""
+    q, k, _ = _qkv(key=8)
+    eye = jnp.broadcast_to(jnp.eye(T, dtype=q.dtype), (B, H, T, T))
+    w0 = flash_attention(q, k, eye, causal=True, causal_offset=0,
+                         dropout_rate=RATE, dropout_seed=4)
+    w1 = flash_attention(q, k, eye, causal=True, causal_offset=T,
+                         dropout_rate=RATE, dropout_seed=4)
+    # offset=T: every pair is causally visible; compare keep patterns on
+    # the lower triangle (visible in both).
+    tri = jnp.tril(jnp.ones((T, T), bool))
+    k0 = (w0 != 0) & tri
+    k1 = (w1 != 0) & tri
+    assert not bool(jnp.array_equal(k0, k1))
