@@ -38,7 +38,7 @@ reference module.py:19).
 """
 
 import math
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -103,6 +103,18 @@ class DistributedDotProductAttn(nn.Module):
     # (norm-bound shift — faster at small head dim; see
     # ops.pallas_attention.flash_attention for the accuracy contract).
     flash_softmax_mode: str = 'exact'
+    # Attention-weight dropout (flash/ulysses paths): flax-idiomatic —
+    # pass rngs={'dropout': key} to apply() (or deterministic=True to
+    # disable, e.g. at eval). The in-kernel mask needs no O(T²) tensor;
+    # see ops.pallas_attention.flash_attention.
+    dropout_rate: float = 0.0
+    # ALiBi slopes, shape (num_heads,) (flash/ulysses paths; requires
+    # causal=True). In the K-first convention attention rows follow
+    # keys, so the bias is over key-vs-query global positions — the same
+    # relative-distance bias as standard attention.
+    alibi_slopes: Optional[Any] = None
+    # 'int8' = quantized QK^T on the flash path (see flash_attention).
+    qk_quant: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -125,6 +137,20 @@ class DistributedDotProductAttn(nn.Module):
             if not self.causal:
                 raise ValueError('window is a lookback cap and requires '
                                  'causal=True')
+        if self.dropout_rate and self.softmax_impl not in ('flash',
+                                                           'ulysses'):
+            raise ValueError(
+                "dropout_rate needs softmax_impl='flash' or 'ulysses' "
+                '(the in-kernel mask lives in the fused kernels)')
+        if self.alibi_slopes is not None:
+            if self.softmax_impl not in ('flash', 'ulysses'):
+                raise ValueError("alibi_slopes needs softmax_impl='flash'"
+                                 " or 'ulysses'")
+            if not self.causal:
+                raise ValueError('alibi_slopes bias by relative global '
+                                 'position and require causal=True')
+        if self.qk_quant is not None and self.softmax_impl != 'flash':
+            raise ValueError("qk_quant needs softmax_impl='flash'")
         value_dim = self.value_dim if self.value_dim is not None \
             else self.key_dim
         if value_dim % self.num_heads:
@@ -145,7 +171,13 @@ class DistributedDotProductAttn(nn.Module):
         self.composition = dense(value_dim, 'composition')
 
     def __call__(self, keys, queries, values, attn_mask=None,
-                 segment_ids=None):
+                 segment_ids=None, deterministic=False,
+                 dropout_seed=None):
+        # ``deterministic=True`` disables dropout (eval). ``dropout_seed``:
+        # explicit traced int32 scalar for the in-kernel mask (e.g. the
+        # step counter) — the SPMD-simplest source; omitted, the seed is
+        # derived from the flax 'dropout' rng (pass
+        # ``apply(..., rngs={'dropout': key})``).
         # ``segment_ids``: optional non-negative int ``(B, T/N)`` local
         # shard — the compact packed-sequence mask (positions in different
         # segments don't attend; equivalent to the dense
@@ -235,6 +267,15 @@ class DistributedDotProductAttn(nn.Module):
                              else jnp.logical_or(attn_mask, dense))
                 seg_local = None  # consumed
 
+        drop_rate, drop_seed = 0.0, None
+        if (self.dropout_rate and not deterministic
+                and not self.is_initializing()):
+            drop_rate = self.dropout_rate
+            drop_seed = (dropout_seed if dropout_seed is not None else
+                         jax.random.randint(
+                             self.make_rng('dropout'), (), 0,
+                             jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
+
         if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
             # the gathered axis (reference module.py:61,67) is standard
@@ -256,10 +297,13 @@ class DistributedDotProductAttn(nn.Module):
             else:
                 q_full, v_full = queries, values
             # In the distributed K-first layout the kernel's query rows are
-            # this shard's keys — global positions start at idx·T/N.
+            # this shard's keys — global positions start at idx·T/N. Fed
+            # whenever distributed: causal/windows need it, and the
+            # dropout mask decorrelates shards through it (a dead scalar
+            # read otherwise).
             causal_offset = (
                 jax.lax.axis_index(self.axis_name) * keys.shape[-2]
-                if (native_causal and distributed) else 0)
+                if distributed else 0)
             seg_pair = None
             if seg_local is not None:
                 # K-first layout: the kernel's query rows are this shard's
@@ -277,7 +321,11 @@ class DistributedDotProductAttn(nn.Module):
                                       softmax_mode=self.flash_softmax_mode,
                                       segment_ids=seg_pair,
                                       window=(self.window if native_causal
-                                              else None))
+                                              else None),
+                                      alibi_slopes=self.alibi_slopes,
+                                      qk_quant=self.qk_quant,
+                                      dropout_rate=drop_rate,
+                                      dropout_seed=drop_seed)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
                 outputs = outputs.reshape(*outputs.shape[:-2],
@@ -296,7 +344,9 @@ class DistributedDotProductAttn(nn.Module):
                 axis_name=self.axis_name, scale=scale,
                 causal=native_causal,
                 softmax_mode=self.flash_softmax_mode,
-                segment_ids=seg_local, window=self.window)
+                segment_ids=seg_local, window=self.window,
+                alibi_slopes=self.alibi_slopes,
+                dropout_rate=drop_rate, dropout_seed=drop_seed)
             outputs = jnp.swapaxes(outputs, -3, -2)
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
             return self.composition(outputs)
@@ -349,11 +399,18 @@ class DistributedDotProductAttn(nn.Module):
 
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,
-                       attn_mask=None, mesh_axis=None, segment_ids=None):
+                       attn_mask=None, mesh_axis=None, segment_ids=None,
+                       deterministic=False, dropout_seed=None, rngs=None):
     """Apply a :class:`DistributedDotProductAttn` to **global** arrays on a
     mesh: params replicated (``P()``), activations sharded on the time axis
     (``P(None, 'seq', None)``); an optional global ``(B, T)``
     ``segment_ids`` is sharded on time too.
+
+    Dropout modules take their randomness either from ``dropout_seed``
+    (a scalar, e.g. the step counter — replicated; the in-kernel mask
+    decorrelates shards by global position) or from
+    ``rngs={'dropout': key}`` (the key is replicated so every shard
+    derives the same seed, then decorrelates the same way).
 
     Replaces the reference's launch convention where ``horovodrun`` starts N
     processes that each construct the module and feed it their shard
@@ -362,12 +419,18 @@ def apply_seq_parallel(module, params, mesh, keys, queries, values,
     mesh_axis = mesh_axis or module.axis_name
     act_spec = P(*([None] * (keys.ndim - 2) + [mesh_axis, None]))
     seg_spec = P(*([None] * (keys.ndim - 2) + [mesh_axis]))
+    drop_key = None if rngs is None else rngs.get('dropout')
 
-    def fn(p, k, q, v, m, seg):
-        return module.apply(p, k, q, v, m, segment_ids=seg)
+    def fn(p, k, q, v, m, seg, seed, dkey):
+        r = None if dkey is None else {'dropout': dkey}
+        return module.apply(p, k, q, v, m, segment_ids=seg,
+                            deterministic=deterministic,
+                            dropout_seed=seed, rngs=r)
 
     return jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(P(), act_spec, act_spec, act_spec, act_spec, seg_spec),
+        in_specs=(P(), act_spec, act_spec, act_spec, act_spec, seg_spec,
+                  P(), P()),
         out_specs=act_spec, check_vma=False,
-    )(params, keys, queries, values, attn_mask, segment_ids)
+    )(params, keys, queries, values, attn_mask, segment_ids,
+      dropout_seed, drop_key)

@@ -47,7 +47,8 @@ __all__ = ['ulysses_attention']
 
 def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
                       causal=False, scale=None, softmax_mode='exact',
-                      segment_ids=None, window=None):
+                      segment_ids=None, window=None, alibi_slopes=None,
+                      dropout_rate=0.0, dropout_seed=None):
     """Sequence-parallel attention via head↔time all-to-all re-sharding.
 
     ``q, k, v``: local shards ``(..., H, T/N, d)`` (``v`` may differ in its
@@ -140,7 +141,26 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
 
     # After the head scatter every device owns whole rows at global
     # positions, so causal/window need no offset plumbing.
+    slopes_local = None
+    if alibi_slopes is not None:
+        # Per-head slopes follow their heads through the scatter: device
+        # i holds the contiguous head chunk [i·H/N, (i+1)·H/N).
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
+        slopes_local = lax.dynamic_slice_in_dim(
+            slopes, lax.axis_index(axis_name) * (heads // world),
+            heads // world, axis=-1)
+    seed_local = None
+    if dropout_rate and dropout_seed is not None:
+        # Distinct per-device seeds: the flat batch indices repeat across
+        # devices after the head scatter (each holds batch×H/N rows), so
+        # a shared seed would repeat masks head-group-to-head-group.
+        # (A missing seed passes None through so flash_attention raises
+        # its actionable error instead of an opaque asarray failure.)
+        seed_local = (jnp.asarray(dropout_seed, jnp.int32)
+                      + lax.axis_index(axis_name) * jnp.int32(40503))
     out = flash_attention(qh, kh, vh, full_mask, causal=causal, scale=scale,
                           softmax_mode=softmax_mode, segment_ids=seg_pair,
-                          window=window)
+                          window=window, alibi_slopes=slopes_local,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=seed_local)
     return gather_heads(out)
