@@ -72,7 +72,8 @@ def _mask_bias(mask, dtype):
 
 def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                    scale=None, precision=None, block_impl='flash',
-                   layout='contiguous', window=None):
+                   layout='contiguous', window=None, segment_ids=None,
+                   alibi_slopes=None, dropout_rate=0.0, dropout_seed=None):
     """Sequence-parallel attention with O((T/N)²) score memory.
 
     ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
@@ -99,10 +100,11 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
       ``2W−1−i`` of length T/2N — every shard then attends W+1
       half-blocks, balancing the causal critical path (~2× faster steps
       at large W). Requires ``causal=True``, ``block_impl='flash'``, an
-      even per-shard length and ``mask=None``/no segments (a (T/N, T)
-      mask's columns are contiguous-global; re-indexing it per layout is
-      not implemented). Use :func:`zigzag_indices` to permute global
-      arrays into (and out of) this layout.
+      even per-shard length and ``mask=None`` (a (T/N, T) mask's columns
+      are contiguous-global; re-indexing it per layout is not
+      implemented — ``segment_ids`` ARE supported, ids need only
+      equality). Use :func:`zigzag_indices` to permute global arrays
+      into (and out of) this layout.
 
     ``window``: sliding-window lookback cap over global positions (see
     :func:`~distributed_dot_product_tpu.ops.pallas_attention.flash_attention`).
@@ -113,6 +115,32 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     communication stays O(T). ``block_impl='xla'`` supports window only
     with ``mask=None`` (its post-hoc empty-row zeroing is not
     window-aware; the flash backend handles mask+window exactly).
+
+    ``segment_ids``: THIS shard's packed-sequence ids — non-negative int,
+    trailing shape ``(T/N,)``, lead dims broadcastable against ``q``'s
+    (insert a head axis yourself, as with ``mask``). The vector rotates
+    around the ring with its K/V block, so each fold masks cross-segment
+    pairs in-kernel from two O(T/N) vectors — the ring path's memory
+    stays O((T/N)²) where densifying to a ``(T/N, T)`` mask would
+    reintroduce the O(T²/N) input ring attention exists to avoid.
+    Works on both layouts (ids need no positions, only equality — a
+    zigzag-permuted shard's ids line up with its rows by construction).
+
+    ``alibi_slopes``: per-head ALiBi slopes (see ``flash_attention``;
+    requires ``causal=True``). The per-fold kernels compute the bias from
+    global row/column offsets (contiguous) or explicit position vectors
+    (zigzag), so folds see exactly the distances a single-device kernel
+    would.
+
+    ``dropout_rate``/``dropout_seed``: attention-weight dropout. The
+    in-kernel keep mask hashes GLOBAL element coordinates (the fold's
+    rotating block reports its true column offset), so one replicated
+    seed draws a mask identical to the single-device flash kernel's for
+    the same elements — folds never repeat each other's patterns, and
+    the backward ring regenerates the forward's mask exactly.
+
+    Segments/ALiBi/dropout require ``block_impl='flash'`` (they live in
+    the fused kernels; the xla fold is the plain-einsum oracle path).
     """
     if block_impl not in ('flash', 'xla'):
         raise ValueError(
@@ -147,6 +175,21 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                 'empty-row zeroing is not window-aware); use the flash '
                 'backend for mask+window')
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
+    dropout_rate = float(dropout_rate)
+    if block_impl == 'xla' and (segment_ids is not None
+                                or alibi_slopes is not None
+                                or dropout_rate):
+        raise ValueError(
+            "segment_ids/alibi_slopes/dropout need block_impl='flash' "
+            '(they live in the fused per-fold kernels; the xla fold is '
+            'the plain-einsum oracle path)')
+    if alibi_slopes is not None and not causal:
+        raise ValueError('alibi_slopes bias by relative global position '
+                         'and require causal=True')
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            'dropout needs an explicit dropout_seed (int or traced int32 '
+            'scalar) — the kernels hold no hidden RNG state')
     if block_impl == 'flash':
         if precision is not None:
             # The Pallas kernels always accumulate in fp32 on the MXU; a
@@ -156,8 +199,14 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                 "precision is only configurable with block_impl='xla' "
                 '(the flash kernels fix fp32 MXU accumulation)')
         interpret = jax.default_backend() != 'tpu'
-        return _ring_flash(q, k, v, mask, axis_name, bool(causal),
-                           float(scale), bool(interpret), layout, window)
+        alibi = (None if alibi_slopes is None
+                 else jnp.asarray(alibi_slopes, jnp.float32))
+        seg = (None if segment_ids is None
+               else segment_ids.astype(jnp.int32))
+        return _ring_flash(q, k, v, mask, seg, alibi,
+                           None if not dropout_rate else dropout_seed,
+                           axis_name, bool(causal), float(scale),
+                           bool(interpret), layout, window, dropout_rate)
     return _ring_xla(q, k, v, mask, axis_name=axis_name, causal=causal,
                      scale=scale, precision=precision, window=window)
 
@@ -234,12 +283,22 @@ def _fold_skip(idx, owner, tn, window):
 
 
 def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
-                         layout='contiguous', window=None):
+                         layout='contiguous', window=None, seg=None,
+                         alibi=None, dropout_rate=0.0, dropout_seed=None):
     """Forward ring: per block, the flash kernel returns the block-local
     normalized output ``out_b`` and row logsumexp ``lse_b``; blocks merge by
     the shift-invariant identity ``num += e^{lse_b − m}·out_b,
     den += e^{lse_b − m}`` (``e^{lse_b − m}·out_b`` is exactly the block's
     unnormalized numerator re-shifted to the running max ``m``).
+
+    With dropout the per-block kernels drop entries of the NUMERATOR only
+    while ``lse_b`` stays undropped — the merge then reconstructs exactly
+    ``dropout(softmax(s))·v`` over the global row (the undropped
+    denominators sum to the global softmax denominator).
+
+    ``seg`` (this shard's packed-sequence id vector) rotates with its K/V
+    block, so fold ``s`` masks against the owner's ids — O(T/N) carried
+    bytes instead of a densified mask.
 
     Returns ``(out, lse)`` with the GLOBAL row logsumexp — the only
     residual (besides the inputs) the ring backward needs.
@@ -254,30 +313,35 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
     num0 = jnp.zeros((*q.shape[:-1], v.shape[-1]), jnp.float32)
 
     def fold(rot, acc, s):
-        k_buf, v_buf = rot
+        k_buf, v_buf, *seg_rest = rot
+        seg_buf = seg_rest[0] if seg_rest else None
         owner = (idx + s) % W
 
         def compute(acc):
             m, den, num = acc
-            # Contiguous: causal_offset = global row 0 of q MINUS global
-            # col 0 of the block — the kernel's causal triangle and
-            # block-skip then work over global positions with no
+            # Contiguous: row/column global offsets (idx·T/N, owner·T/N)
+            # — the kernel's causal triangle, ALiBi distances, dropout
+            # hash and block-skip then work over global positions with no
             # materialized mask. Zigzag: explicit per-row/col position
             # vectors instead (the rows aren't one contiguous run); the
             # kernel skips provably-future blocks from their position
             # interval tables.
+            seg_pair = None if seg is None else (seg, seg_buf)
             if my_pos is None:
                 out_b, lse_b = _flash_fwd_impl(
                     q, k_buf, v_buf, _blk_mask(mask, owner, tn),
-                    (idx - owner) * tn, scale, causal, interpret,
-                    save_lse=True, window=window)
+                    idx * tn, scale, causal, interpret,
+                    save_lse=True, window=window, kv_offset=owner * tn,
+                    segment_ids=seg_pair, alibi=alibi,
+                    dropout_rate=dropout_rate, dropout_seed=dropout_seed)
             else:
                 out_b, lse_b = _flash_fwd_impl(
                     q, k_buf, v_buf, None, 0, scale, False, interpret,
                     save_lse=True,
                     positions=(my_pos,
                                _layout_positions(layout, owner, W, tn)),
-                    window=window)
+                    window=window, segment_ids=seg_pair, alibi=alibi,
+                    dropout_rate=dropout_rate, dropout_seed=dropout_seed)
             # A block-empty row (all its columns masked / causal-future)
             # has lse_b ≈ log-of-large-finite-negative ⇒ combine weight 0:
             # garbage block outputs never enter the merge.
@@ -302,7 +366,8 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
         return rot, lax.cond(_fold_skip(idx, owner, tn, window),
                              lambda a: a, compute, acc)
 
-    _, (m, den, num), _ = _ring_sweep(axis_name, fold, (k, v),
+    rot0 = (k, v) if seg is None else (k, v, seg)
+    _, (m, den, num), _ = _ring_sweep(axis_name, fold, rot0,
                                       (m0, den0, num0))
 
     # den > 0 always: the own-diagonal block (s=0) is never skipped, and
@@ -317,14 +382,19 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
 
 
 def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
-                         scale, interpret, layout='contiguous', window=None):
+                         scale, interpret, layout='contiguous', window=None,
+                         seg=None, alibi=None, dropout_rate=0.0,
+                         dropout_seed=None):
     """Backward ring: the flash backward decomposes over K/V blocks given
     the GLOBAL ``lse`` (and ``Δ = rowsum(g·out)``), so a second ring pass
     rotates ``(k, v, dk, dv)`` together — each rank folds its dq
     contribution locally and adds its (dk, dv) partial for the RESIDENT
     block into the accumulators travelling with that block. After the full
     cycle each (dk, dv) has every rank's contribution and sits one hop from
-    home. Partials stay fp32 across the W folds (``grad_dtype``)."""
+    home. Partials stay fp32 across the W folds (``grad_dtype``). The
+    dropout hash keys on global element coordinates, so each fold's
+    backward regenerates the forward fold's exact keep mask; ``seg``
+    rotates with the block as in the forward."""
     W = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     tn = q.shape[-2]
@@ -334,23 +404,29 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     # weights are exactly 0 — all its gradient terms die in-kernel.
 
     def fold(rot, dq, s):
-        k_buf, v_buf, dk_buf, dv_buf = rot
+        k_buf, v_buf, dk_buf, dv_buf, *seg_rest = rot
+        seg_buf = seg_rest[0] if seg_rest else None
         owner = (idx + s) % W
 
         def compute(args):
             dq, dk_buf, dv_buf = args
+            seg_pair = None if seg is None else (seg, seg_buf)
             if my_pos is None:
                 dq_b, dk_b, dv_b = _flash_bwd_impl(
                     q, k_buf, v_buf, _blk_mask(mask, owner, tn),
-                    (idx - owner) * tn, out, lse, g, scale, causal,
-                    interpret, grad_dtype=jnp.float32, window=window)
+                    idx * tn, out, lse, g, scale, causal,
+                    interpret, grad_dtype=jnp.float32, window=window,
+                    kv_offset=owner * tn, segment_ids=seg_pair,
+                    alibi=alibi, dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed)
             else:
                 dq_b, dk_b, dv_b = _flash_bwd_impl(
                     q, k_buf, v_buf, None, 0, out, lse, g, scale, False,
                     interpret, grad_dtype=jnp.float32,
                     positions=(my_pos,
                                _layout_positions(layout, owner, W, tn)),
-                    window=window)
+                    window=window, segment_ids=seg_pair, alibi=alibi,
+                    dropout_rate=dropout_rate, dropout_seed=dropout_seed)
             return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
 
         if causal and my_pos is None:
@@ -359,11 +435,16 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
                 (dq, dk_buf, dv_buf))
         else:
             dq, dk_buf, dv_buf = compute((dq, dk_buf, dv_buf))
-        return (k_buf, v_buf, dk_buf, dv_buf), dq
+        rot_out = (k_buf, v_buf, dk_buf, dv_buf)
+        if seg_buf is not None:
+            rot_out += (seg_buf,)
+        return rot_out, dq
 
     rot0 = (k, v, jnp.zeros(k.shape, jnp.float32),
             jnp.zeros(v.shape, jnp.float32))
-    (_, _, dk_buf, dv_buf), dq, perm = _ring_sweep(
+    if seg is not None:
+        rot0 += (seg,)
+    (_, _, dk_buf, dv_buf, *_), dq, perm = _ring_sweep(
         axis_name, fold, rot0, jnp.zeros(q.shape, jnp.float32))
     # After the last fold rank r holds the COMPLETE (dk, dv) of block
     # (r−1) mod W; one final hop delivers them to their owner.
@@ -372,28 +453,32 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _ring_flash(q, k, v, mask, axis_name, causal, scale, interpret, layout,
-                window):
+@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _ring_flash(q, k, v, mask, seg, alibi, dropout_seed, axis_name, causal,
+                scale, interpret, layout, window, dropout_rate):
     out, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                  interpret, layout, window)
+                                  interpret, layout, window, seg, alibi,
+                                  dropout_rate, dropout_seed)
     return out
 
 
-def _ring_flash_vjp_fwd(q, k, v, mask, axis_name, causal, scale, interpret,
-                        layout, window):
+def _ring_flash_vjp_fwd(q, k, v, mask, seg, alibi, dropout_seed, axis_name,
+                        causal, scale, interpret, layout, window,
+                        dropout_rate):
     out, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
-                                    interpret, layout, window)
-    return out, (q, k, v, mask, out, lse)
+                                    interpret, layout, window, seg, alibi,
+                                    dropout_rate, dropout_seed)
+    return out, (q, k, v, mask, seg, alibi, dropout_seed, out, lse)
 
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, layout, window,
-                        res, g):
-    q, k, v, mask, out, lse = res
+                        dropout_rate, res, g):
+    q, k, v, mask, seg, alibi, dropout_seed, out, lse = res
     dq, dk, dv = _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name,
                                       causal, scale, interpret, layout,
-                                      window)
-    return dq, dk, dv, None
+                                      window, seg, alibi, dropout_rate,
+                                      dropout_seed)
+    return dq, dk, dv, None, None, None, None
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)

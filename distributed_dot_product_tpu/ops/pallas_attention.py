@@ -107,10 +107,12 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
 
     The mask arrives as int8 (1 = masked): Mosaic widens bool kernel
     operands to s32 — a full-size O(4·Tq·Tk) HBM copy — but takes int8
-    blocks natively. ``off_ref`` (scalar, (1, 1) int32) holds the GLOBAL
-    index of query row 0 — sequence-sharded callers pass their shard's
-    offset so the causal triangle is over global positions with no
-    materialized mask. ``seg``/``pos`` carry (1, B, 1)/(1, 1, B) int32
+    blocks natively. ``off_ref`` ((1, 2) int32) holds the GLOBAL indices
+    of query row 0 AND key column 0 — sequence-sharded callers pass their
+    shard offsets so the causal triangle is over global positions with no
+    materialized mask (ring folds report the rotating block's column
+    offset too, which also keys the dropout hash to true global
+    coordinates). ``seg``/``pos`` carry (1, B, 1)/(1, 1, B) int32
     per-position vector blocks (plus their SMEM skip tables, unused here):
     ``seg`` masks pairs in different segments (the packed-sequence mask
     form, O(T) not O(T²) HBM traffic); ``pos`` masks pairs where the query
@@ -146,8 +148,8 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
         else:
             rows = (off_ref[0, 0] + qi * bq
                     + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (bq, bk), 1)
+            cols = (off_ref[0, 1] + ki * bk
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
             dist = (cols - rows).astype(jnp.float32)
         s = s + alibi * dist
     if seg is not None:
@@ -161,7 +163,8 @@ def _apply_masks(s, qi, ki, bq, bk, causal, kv_len, mask_ref, off_ref,
     if causal:
         rows = (off_ref[0, 0] + qi * bq
                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        cols = (off_ref[0, 1] + ki * bk
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
         s = jnp.where(rows < cols, -jnp.inf, s)
         if window is not None:
             s = jnp.where(rows - cols >= window, -jnp.inf, s)
@@ -177,13 +180,16 @@ def _causal_run(causal, off_ref, qi, ki, bq, bk, window=None):
     scalar — ``pl.when`` still skips the matmuls at run time. ``window``
     additionally skips blocks wholly ≥ window positions in the past (the
     oldest pair is newest-query − oldest-key = block row 0 vs the K
-    block's LAST column): compute becomes O(Tq·window), not O(Tq·Tk)."""
+    block's LAST column): compute becomes O(Tq·window), not O(Tq·Tk).
+    Row/column global offsets both come from ``off_ref`` (see
+    ``_apply_masks``)."""
     if not causal:
         return True
-    run = off_ref[0, 0] + (qi + 1) * bq - 1 >= ki * bk
+    rel = off_ref[0, 0] - off_ref[0, 1]
+    run = rel + (qi + 1) * bq - 1 >= ki * bk
     if window is not None:
         run = jnp.logical_and(
-            run, off_ref[0, 0] + qi * bq - (ki * bk + bk - 1) < window)
+            run, rel + qi * bq - (ki * bk + bk - 1) < window)
     return run
 
 
@@ -341,6 +347,95 @@ _REDIRECT_ON_INTERPRET = False
 # in-kernel skipping is the off-TPU default and is numerically identical).
 _BAND_ON_INTERPRET = False
 
+# Test hook: likewise for the trapezoid causal grid.
+_TRAP_ON_INTERPRET = False
+
+# Trapezoid pair-table budget: 2 int32 tables of npairs entries ride SMEM
+# via scalar prefetch; past this many pairs fall back to the full grid
+# with in-kernel skipping (same 512 KiB SMEM thinking as _RUNSUM_SMEM_CAP).
+_TRAP_MAX_PAIRS = 64 * 1024
+
+
+def _trap_tables(rel, nqb, nkb, bq, bk):
+    """Flattened causal-trapezoid pair tables (STATIC offsets only).
+
+    Plain causal attention runs a full (nqb, nkb) grid where nearly half
+    the programs are skipped by ``pl.when`` — but a skipped program still
+    pays its block DMA and grid sequencing (RESULTS.md measured that
+    overhead at 19× on the window path, which is why windows got a banded
+    grid). The trapezoid grid removes it for causal: the K axis
+    flattens into ONE grid axis of exactly the valid (Q block, K block)
+    pairs, ordered Q-major with K ascending, and scalar-prefetched SMEM
+    tables map each program to its actual block indices. Out-of-triangle
+    blocks then cost nothing at all — no DMA, no sequencing.
+
+    Returns ``(qtab, ktab, ext)``: per-pair Q/K block indices and the
+    per-Q-block K extent (the kernels derive accumulator init/finalize
+    from ``ki == 0`` / ``ki == ext[qi] − 1``). ``rel`` is the static
+    row−column global offset. Rows whose extent would be 0 (entirely in
+    the future — negative ``rel``) keep one fully-masked pair so their
+    output block is still written (as 0).
+    """
+    import numpy as np
+    qi = np.arange(nqb)
+    ext = np.clip((rel + (qi + 1) * bq + bk - 1) // bk, 1, nkb)
+    qtab = np.repeat(qi, ext)
+    ktab = np.concatenate([np.arange(e) for e in ext])
+    return (jnp.asarray(qtab, jnp.int32), jnp.asarray(ktab, jnp.int32),
+            jnp.asarray(ext, jnp.int32))
+
+
+def _trap_tables_t(rel, nqb, nkb, bq, bk):
+    """Transposed trapezoid tables for the dk/dv pass (K-major, Q
+    ascending from each K block's first causally-visible Q block).
+    Returns ``(qtab, ktab, qlo)`` — init fires at ``qi == qlo[kj]``,
+    finalize at ``qi == nqb − 1`` (the bottom row block sees every K
+    block). K blocks beyond every row keep one fully-masked pair so
+    their dk/dv blocks are still written (as 0)."""
+    import numpy as np
+    kj = np.arange(nkb)
+    qlo = np.clip((kj * bk - rel + bq) // bq - 1, 0, nqb - 1)
+    counts = nqb - qlo
+    ktab = np.repeat(kj, counts)
+    qtab = np.concatenate([np.arange(lo, nqb) for lo in qlo])
+    return (jnp.asarray(qtab, jnp.int32), jnp.asarray(ktab, jnp.int32),
+            jnp.asarray(qlo, jnp.int32))
+
+
+def _trap_eligible(causal, window, mask, positions, causal_offset,
+                   kv_offset, mode, interpret):
+    """The trapezoid grid applies to plain causal attention with STATIC
+    offsets: a traced offset (sequence-sharded SPMD — every shard runs
+    one program, but their triangles differ) would make the pair count
+    dynamic, which a grid size cannot be. Windows have their own banded
+    grid; dense masks keep the full grid (their skip tables are indexed
+    by absolute blocks); 'bounded' keeps the full grid (its win case is
+    the forward-only sweep, see RESULTS.md)."""
+    import numpy as np
+    static = (isinstance(causal_offset, (int, np.integer))
+              and isinstance(kv_offset, (int, np.integer)))
+    return (causal and window is None and mask is None and positions is None
+            and static and mode == 'exact'
+            and ((not interpret) or _TRAP_ON_INTERPRET))
+
+
+def _wrap_specs_pairs(specs, transposed=False):
+    """Re-aim 3-axis index maps at the pair grid: program p's block
+    indices come from the prefetched tables (``rs[0]``/``rs[1]`` = the
+    Q/K tables). SMEM whole-array specs (block_shape None) pass through.
+    ``transposed``: inner maps have the (b, kj, qi) signature of the
+    dk/dv grid."""
+    def wrap(spec):
+        if spec.block_shape is None:
+            return spec
+        f = spec.index_map
+        if transposed:
+            g = lambda b, p, *rs, f=f: f(b, rs[1][p], rs[0][p], *rs)  # noqa: E731,E501
+        else:
+            g = lambda b, p, *rs, f=f: f(b, rs[0][p], rs[1][p], *rs)  # noqa: E731,E501
+        return pl.BlockSpec(spec.block_shape, g)
+    return [wrap(s) for s in specs]
+
 
 def _mask_streams_per_tile(nb, tq, tk, dtype, d_total, allow_redirect,
                            bwd=False):
@@ -433,7 +528,7 @@ def _run_pred(causal, off_ref, qi, ki, bq, bk, b, seg, pos, runsum_ref,
     return run
 
 
-def _dropout_keep(seed_ref, b, qi, ki, bq, bk, rate, off=0):
+def _dropout_keep(seed_ref, b, qi, ki, bq, bk, rate, off_ref, pos=None):
     """Per-block keep mask for attention-weight dropout, as a PURE
     function of (seed, flat batch, GLOBAL element coordinates) — a
     counter-based murmur3-finalizer hash, not a stateful PRNG. Element
@@ -442,15 +537,24 @@ def _dropout_keep(seed_ref, b, qi, ki, bq, bk, rate, off=0):
     the forward's at large head dims / streamed masks) regenerate the
     forward's EXACT mask from any grid, banded or not — and the same
     code runs under the plain interpreter (no TPU PRNG primitives).
-    ``off`` is the global index of query row 0 (the kernels pass their
-    ``off_ref``): sequence-parallel shards sharing one replicated seed
-    then hash DIFFERENT global rows instead of repeating one shard's
-    pattern. Returns a (bq, bk) bool and the 1/(1−rate) scale."""
+    Coordinates are GLOBAL on both axes: rows/columns come from the
+    explicit ``pos`` vectors when given (zigzag/striped layouts), else
+    from ``off_ref``'s (row, column) offsets — so sequence-parallel
+    shards AND ring folds sharing one replicated seed hash different
+    global elements instead of repeating one block's pattern, and a ring
+    fold draws the identical mask a single-device kernel would for the
+    same elements. Returns a (bq, bk) bool and the 1/(1−rate) scale."""
     u = jnp.uint32
-    rows = (off + qi * bq
-            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)).astype(u)
-    cols = (ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            ).astype(u)
+    if pos is not None:
+        rows = jnp.broadcast_to(pos[0][0], (bq, bk)).astype(u)
+        cols = jnp.broadcast_to(pos[1][0], (bq, bk)).astype(u)
+    else:
+        rows = (off_ref[0, 0] + qi * bq
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                ).astype(u)
+        cols = (off_ref[0, 1] + ki * bk
+                + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                ).astype(u)
     x = (rows * u(2654435761)
          ^ cols * u(2246822519)
          ^ (seed_ref[0, 0].astype(u)
@@ -486,9 +590,12 @@ def _score_block(q_ref, k_ref, quant):
 
 def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
                      has_alibi, has_mask_skip, save_lse, window=None,
-                     band_fn=None, quantized=False, dropout=None):
+                     band_fn=None, quantized=False, dropout=None,
+                     trap=False):
     def kernel(*refs):
-        if band_fn is not None:
+        if trap:
+            tq_ref, tk_ref, ext_ref, *refs = refs
+        elif band_fn is not None:
             bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
@@ -508,15 +615,27 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
             o_ref, lse_ref, m_s, l_s, acc_s = rest
         else:
             (o_ref, m_s, l_s, acc_s), lse_ref = rest, None
-        qi = pl.program_id(1)
-        kj = pl.program_id(2)
-        # Banded window grid: the K sweep covers only this Q block's band;
-        # ki is the ACTUAL K block index (all masking/skip arithmetic uses
-        # it), kj the program position (init/finalize conditions).
-        ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
-        last_k = pl.num_programs(2) - 1
+        if trap:
+            # Trapezoid pair grid: program_id(1) walks the flattened
+            # valid (Q block, K block) pairs Q-major; each Q block's run
+            # starts at K block 0 and ends at its causal extent.
+            p = pl.program_id(1)
+            qi = tq_ref[p]
+            ki = tk_ref[p]
+            first_k = ki == 0
+            last_k_cond = ki == ext_ref[qi] - 1
+        else:
+            qi = pl.program_id(1)
+            kj = pl.program_id(2)
+            # Banded window grid: the K sweep covers only this Q block's
+            # band; ki is the ACTUAL K block index (all masking/skip
+            # arithmetic uses it), kj the program position (init/finalize
+            # conditions).
+            ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
+            first_k = kj == 0
+            last_k_cond = kj == pl.num_programs(2) - 1
 
-        @pl.when(kj == 0)
+        @pl.when(first_k)
         def _():
             m_s[:] = jnp.full_like(m_s, _NEG_BIG)
             l_s[:] = jnp.zeros_like(l_s)
@@ -561,14 +680,13 @@ def _make_fwd_kernel(causal, bq, bk, kv_len, has_mask, has_seg, has_pos,
             p_num = p
             if dropout is not None:
                 keep, inv = _dropout_keep(seed_ref, pid_b, qi, ki,
-                                          bq, bk, dropout,
-                                          off_ref[0, 0])
+                                          bq, bk, dropout, off_ref, pos)
                 p_num = jnp.where(keep, p, 0.0) * inv
             acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
                 p_num.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        @pl.when(kj == last_k)
+        @pl.when(last_k_cond)
         def _():
             l = l_s[:]
             safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -758,18 +876,20 @@ def _kv_group(q, k):
 def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
                     mode='exact', save_lse=False, segment_ids=None,
                     positions=None, window=None, alibi=None, qk_quant=None,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None, kv_offset=0):
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
     nb = int(math.prod(batch)) if batch else 1
     kv_group = _kv_group(q, k)
     nbk = nb // kv_group
-    # Scalar (1, 1) int32 input: the global index of query row 0 (possibly
-    # traced, e.g. lax.axis_index under shard_map). Always fed — a dead
-    # scalar read costs nothing and keeps the kernel signatures uniform.
-    off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
-    off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
+    # (1, 2) int32 input: the global indices of query row 0 and key
+    # column 0 (possibly traced, e.g. lax.axis_index under shard_map /
+    # the ring fold's rotating owner). Always fed — a dead scalar read
+    # costs nothing and keeps the kernel signatures uniform.
+    off = jnp.stack([jnp.asarray(causal_offset, jnp.int32),
+                     jnp.asarray(kv_offset, jnp.int32)]).reshape(1, 2)
+    off_spec = pl.BlockSpec((1, 2), lambda b, i, j, *rs: (0, 0))
 
     allow_redirect = (not interpret) or _REDIRECT_ON_INTERPRET
     streams_mask = mask is not None and _mask_streams_per_tile(
@@ -781,6 +901,16 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     # lowering is exp2(x·log2e) anyway). One extra rounding of q, same
     # class of error as the bf16 inputs themselves.
     quantized = qk_quant == 'int8'
+    # Canonicalize the softmax mode BEFORE grid selection: dropout rides
+    # the exact kernel only, quantization's running max is already
+    # correct on the dequantized scores, and the Cauchy-Schwarz bound
+    # does not cover the additive ALiBi term (≤ 0 only for non-negative
+    # slopes, and slopes may be traced) — in each case 'bounded' is an
+    # optimization hint that resolves to the exact kernel, which must
+    # then still be eligible for the trapezoid pair grid below.
+    if mode == 'bounded' and (dropout_rate or quantized
+                              or alibi is not None):
+        mode = 'exact'
     sqf = skr = None
     if quantized:
         # int8 QK^T: the fwd score matmul runs on the int8 MXU path
@@ -813,6 +943,7 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
               and positions is None
               and ((not interpret) or _BAND_ON_INTERPRET))
     band_fn = bandoff = kof = None
+    trap = trap_pre = None
     if banded:
         band = _band_size(bq, bk, window, nkb)
 
@@ -823,12 +954,20 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
         def kof(b, i, j, rs):
             # Single source of truth for the band's K-block translation —
             # the q/k/v BlockSpec maps and the aux (segment) maps both
-            # derive from it (rs[0] is the prefetched global row offset).
+            # derive from it (rs[0] is the prefetched row−column offset).
             return band_fn(i, rs[0][0]) + j
-        bandoff = off.reshape(1)
+        bandoff = (off[0, 0] - off[0, 1]).reshape(1)
         grid = (nb, nqb, band)
     else:
         grid = (nb, nqb, nkb)
+        if _trap_eligible(causal, window, mask, positions, causal_offset,
+                          kv_offset, mode, interpret):
+            qtab, ktab, ext = _trap_tables(
+                int(causal_offset) - int(kv_offset), nqb, nkb, bq, bk)
+            if qtab.shape[0] <= _TRAP_MAX_PAIRS:
+                trap = True
+                trap_pre = [qtab, ktab, ext]
+                grid = (nb, int(qtab.shape[0]))
     k_map = lambda b, i, j, *rs: (  # noqa: E731
         b // kv_group, j if kof is None else kof(b, i, j, rs), 0)
 
@@ -849,7 +988,7 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     dropout = float(dropout_rate) if dropout_rate else None
     seed_specs, seed_args = [], []
     if dropout is not None:
-        seed_specs = [off_spec]
+        seed_specs = [pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))]
         seed_args = [jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)]
     aux_specs, _, aux_args, flags, runsum = _aux_setup(
         mask, segment_ids, positions, batch, tq, tk, tq_p, tk_p, bq, bk,
@@ -867,26 +1006,19 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
 
     def run_exact(*_):
         kernel = _make_fwd_kernel(causal, bq, bk, tk, *flags, save_lse,
-                                  window, band_fn, quantized, dropout)
+                                  window, band_fn, quantized, dropout,
+                                  trap=bool(trap))
+        in_specs = [off_spec] + seed_specs + specs + aux_specs
+        o_specs = out_specs
+        if trap:
+            in_specs = _wrap_specs_pairs(in_specs)
+            o_specs = (_wrap_specs_pairs(o_specs) if save_lse
+                       else _wrap_specs_pairs([o_specs])[0])
         return _pallas_call(
-            kernel, grid, [off_spec] + seed_specs + specs + aux_specs,
-            out_specs, _scratch(bq, d_v), out_shape, interpret,
-            [bandoff, runsum],
+            kernel, grid, in_specs, o_specs, _scratch(bq, d_v), out_shape,
+            interpret, trap_pre if trap else [bandoff, runsum],
         )(off, *seed_args, *args, *aux_args)
 
-    if mode == 'bounded' and dropout is not None:
-        mode = 'exact'   # one exact-kernel surface carries dropout
-    if mode == 'bounded' and quantized:
-        # The bounded shift would need quantization-aware bounds; the
-        # exact kernel's running max is already correct on the dequantized
-        # scores. 'bounded' stays an optimization hint.
-        mode = 'exact'
-    if mode == 'bounded' and alibi is not None:
-        # The Cauchy-Schwarz row bound does not cover the additive ALiBi
-        # term (≤ 0 only for non-negative slopes on causal layouts, and
-        # slopes may be traced) — run the exact kernel instead of
-        # widening the bound; 'bounded' stays an optimization hint.
-        mode = 'exact'
     if mode == 'bounded':
         # Per-row upper bound on the (log2-unit) scores via Cauchy-Schwarz:
         # |s2_ij| ≤ ‖q2_i‖·‖k_j‖ ≤ ‖q2_i‖·max_j‖k_j‖. The +1 covers fp32
@@ -1016,9 +1148,12 @@ def _make_fwd_kernel_bounded(causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                     has_pos, has_alibi, has_mask_skip, window=None,
-                    band_fn=None, quantized=False, dropout=None):
+                    band_fn=None, quantized=False, dropout=None,
+                    trap=False):
     def kernel(*refs):
-        if band_fn is not None:
+        if trap:
+            tq_ref, tk_ref, ext_ref, *refs = refs
+        elif band_fn is not None:
             bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
@@ -1036,12 +1171,20 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
         mask_ref, seg, pos, alibi_ref, rest = _split_aux(
             rest, has_mask, has_seg, has_pos, has_alibi)
         dq_ref, dq_acc = rest
-        qi = pl.program_id(1)
-        kj = pl.program_id(2)
-        ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
-        last_k = pl.num_programs(2) - 1
+        if trap:
+            p = pl.program_id(1)
+            qi = tq_ref[p]
+            ki = tk_ref[p]
+            first_k = ki == 0
+            last_k_cond = ki == ext_ref[qi] - 1
+        else:
+            qi = pl.program_id(1)
+            kj = pl.program_id(2)
+            ki = kj if band_fn is None else band_fn(qi, bandoff_ref[0]) + kj
+            first_k = kj == 0
+            last_k_cond = kj == pl.num_programs(2) - 1
 
-        @pl.when(kj == 0)
+        @pl.when(first_k)
         def _():
             dq_acc[:] = jnp.zeros_like(dq_acc)
 
@@ -1075,8 +1218,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                 # Same element-coordinate mask as the forward; Δ already
                 # equals rowsum(m̃·a ⊙ dp) by the rowsum(dO⊙O) identity.
                 keep, inv = _dropout_keep(seed_ref, pid_b, qi, ki,
-                                          bq, bk, dropout,
-                                          off_ref[0, 0])
+                                          bq, bk, dropout, off_ref, pos)
                 dp = jnp.where(keep, dp, 0.0) * inv
             if quantized:
                 k_op = (k_ref[0].astype(jnp.float32)
@@ -1088,7 +1230,7 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                 ds, k_op, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BQ, d)
 
-        @pl.when(kj == last_k)
+        @pl.when(last_k_cond)
         def _():
             dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -1097,9 +1239,12 @@ def _make_dq_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 
 def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                      has_pos, has_alibi, has_mask_skip, window=None,
-                     band_fn=None, quantized=False, dropout=None):
+                     band_fn=None, quantized=False, dropout=None,
+                     trap=False, nqb=None):
     def kernel(*refs):
-        if band_fn is not None:
+        if trap:
+            tq_ref, tk_ref, qlo_ref, *refs = refs
+        elif band_fn is not None:
             bandoff_ref, *refs = refs
         if has_mask_skip:
             runsum_ref, *refs = refs
@@ -1117,14 +1262,25 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
         mask_ref, seg, pos, alibi_ref, rest = _split_aux(
             rest, has_mask, has_seg, has_pos, has_alibi)
         dk_ref, dv_ref, dk_acc, dv_acc = rest
-        kj = pl.program_id(1)
-        qr = pl.program_id(2)
-        # Banded: qr sweeps only the Q blocks whose window band touches
-        # this K block; qi is the ACTUAL Q block index.
-        qi = qr if band_fn is None else band_fn(kj, bandoff_ref[0]) + qr
-        last_q = pl.num_programs(2) - 1
+        if trap:
+            # Transposed trapezoid: K-major pair walk; each K block's Q
+            # run starts at its first causally-visible Q block and always
+            # ends at the bottom row block.
+            p = pl.program_id(1)
+            qi = tq_ref[p]
+            kj = tk_ref[p]
+            first_q = qi == qlo_ref[kj]
+            last_q_cond = qi == nqb - 1
+        else:
+            kj = pl.program_id(1)
+            qr = pl.program_id(2)
+            # Banded: qr sweeps only the Q blocks whose window band
+            # touches this K block; qi is the ACTUAL Q block index.
+            qi = qr if band_fn is None else band_fn(kj, bandoff_ref[0]) + qr
+            first_q = qr == 0
+            last_q_cond = qr == pl.num_programs(2) - 1
 
-        @pl.when(qr == 0)
+        @pl.when(first_q)
         def _():
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -1155,8 +1311,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
             p_num = p
             if dropout is not None:
                 keep, inv = _dropout_keep(seed_ref, pid_b, qi, kj,
-                                          bq, bk, dropout,
-                                          off_ref[0, 0])
+                                          bq, bk, dropout, off_ref, pos)
                 p_num = jnp.where(keep, p, 0.0) * inv
             dv_acc[:] += jax.lax.dot_general(
                 p_num.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -1178,7 +1333,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
                 ds, q_op, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # (BK, d)
 
-        @pl.when(qr == last_q)
+        @pl.when(last_q_cond)
         def _():
             dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -1189,7 +1344,7 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
                     causal, interpret, grad_dtype=None, segment_ids=None,
                     positions=None, window=None, alibi=None, qk_quant=None,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None, kv_offset=0):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -1209,7 +1364,8 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     kv_group = _kv_group(q, k)
     nbk = nb // kv_group
 
-    off = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
+    off = jnp.stack([jnp.asarray(causal_offset, jnp.int32),
+                     jnp.asarray(kv_offset, jnp.int32)]).reshape(1, 2)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                 # (*batch, Tq, 1)
 
@@ -1261,6 +1417,17 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
               and positions is None
               and ((not interpret) or _BAND_ON_INTERPRET))
     kband_fn = qband_fn = bandoff = kof = qot = None
+    trap = trap_pre = trap_pre_t = None
+    if not banded and _trap_eligible(causal, window, mask, positions,
+                                     causal_offset, kv_offset, 'exact',
+                                     interpret):
+        rel = int(causal_offset) - int(kv_offset)
+        tabs = _trap_tables(rel, nqb, nkb, bq, bk)
+        tabs_t = _trap_tables_t(rel, nqb, nkb, bq, bk)
+        if max(tabs[0].shape[0], tabs_t[0].shape[0]) <= _TRAP_MAX_PAIRS:
+            trap = True
+            trap_pre = list(tabs)
+            trap_pre_t = list(tabs_t)
     if banded:
         kband = _band_size(bq, bk, window, nkb)
         qband = _band_size(bk, bq, window, nqb)
@@ -1282,7 +1449,7 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
 
         def qot(b, j, i, rs):
             return qband_fn(j, rs[0][0]) + i
-        bandoff = off.reshape(1)
+        bandoff = (off[0, 0] - off[0, 1]).reshape(1)
     k_map = lambda b, i, j, *rs: (  # noqa: E731
         b // kv_group, j if kof is None else kof(b, i, j, rs), 0)
     # dk/dv are computed as PER-Q-HEAD partials (the K/V INPUT blocks are
@@ -1298,11 +1465,11 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         allow_redirect=allow_redirect, k_of=kof, q_of_t=qot,
         alibi=(None if alibi is None else alibi * _LOG2E))
 
-    off_spec = pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))
+    off_spec = pl.BlockSpec((1, 2), lambda b, i, j, *rs: (0, 0))
     dropout = float(dropout_rate) if dropout_rate else None
     seed_specs, seed_args = [], []
     if dropout is not None:
-        seed_specs = [off_spec]
+        seed_specs = [pl.BlockSpec((1, 1), lambda b, i, j, *rs: (0, 0))]
         seed_args = [jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)]
 
     quant_specs = quant_specs_t = []
@@ -1337,15 +1504,21 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
         pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
     ] + quant_specs + aux_specs
+    dq_out_spec = pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0))
+    if trap:
+        dq_grid = (nb, int(trap_pre[0].shape[0]))
+        dq_in_specs = _wrap_specs_pairs(dq_in_specs)
+        dq_out_spec = _wrap_specs_pairs([dq_out_spec])[0]
+    else:
+        dq_grid = (nb, nqb, kband if banded else nkb)
     dq = _pallas_call(
         _make_dq_kernel(scale, causal, bq, bk, tk, *flags, window=window,
                         band_fn=kband_fn, quantized=quantized,
-                        dropout=dropout),
-        (nb, nqb, kband if banded else nkb), dq_in_specs,
-        pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
+                        dropout=dropout, trap=bool(trap)),
+        dq_grid, dq_in_specs, dq_out_spec,
         [pltpu.VMEM((bq, d), jnp.float32)],
         jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
-        interpret, [bandoff, runsum],
+        interpret, trap_pre if trap else [bandoff, runsum],
     )(off, *seed_args, *args, *aux_args)
 
     # --- dk/dv pass: grid (batch, K block, Q band), Q innermost ---
@@ -1359,22 +1532,28 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         pl.BlockSpec((1, bq, 1), q_map_t),
         pl.BlockSpec((1, bq, 1), q_map_t),
     ] + quant_specs_t + aux_specs_t
+    dkv_out_specs = [
+        pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
+        pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
+    ]
+    if trap:
+        dkv_grid = (nb, int(trap_pre_t[0].shape[0]))
+        dkv_in_specs = _wrap_specs_pairs(dkv_in_specs, transposed=True)
+        dkv_out_specs = _wrap_specs_pairs(dkv_out_specs, transposed=True)
+    else:
+        dkv_grid = (nb, nkb, qband if banded else nqb)
     dk, dv = _pallas_call(
         _make_dkv_kernel(scale, causal, bq, bk, tk, *flags, window=window,
                          band_fn=qband_fn, quantized=quantized,
-                         dropout=dropout),
-        (nb, nkb, qband if banded else nqb), dkv_in_specs,
-        [
-            pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
-            pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
-        ],
+                         dropout=dropout, trap=bool(trap), nqb=nqb),
+        dkv_grid, dkv_in_specs, dkv_out_specs,
         [pltpu.VMEM((bk, d), jnp.float32),
          pltpu.VMEM((bk, d_v), jnp.float32)],
         [
             jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
             jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
         ],
-        interpret, [bandoff, runsum],
+        interpret, trap_pre_t if trap else [bandoff, runsum],
     )(off, *seed_args, *args, *aux_args)
 
     dq = dq[:, :tq].reshape(q.shape)
@@ -1414,22 +1593,22 @@ def _seg_pair(seg_q, seg_k):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(11, 12, 13, 14, 15, 16, 17))
-def _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
-           dropout_seed, scale, causal, interpret, mode, window, qk_quant,
-           dropout_rate):
+                   nondiff_argnums=(12, 13, 14, 15, 16, 17, 18))
+def _flash(q, k, v, mask, causal_offset, kv_offset, seg_q, seg_k, pos_q,
+           pos_k, alibi, dropout_seed, scale, causal, interpret, mode,
+           window, qk_quant, dropout_rate):
     return _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                            interpret, mode,
                            segment_ids=_seg_pair(seg_q, seg_k),
                            positions=_seg_pair(pos_q, pos_k),
                            window=window, alibi=alibi, qk_quant=qk_quant,
                            dropout_rate=dropout_rate,
-                           dropout_seed=dropout_seed)
+                           dropout_seed=dropout_seed, kv_offset=kv_offset)
 
 
-def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-               alibi, dropout_seed, scale, causal, interpret, mode, window,
-               qk_quant, dropout_rate):
+def _flash_fwd(q, k, v, mask, causal_offset, kv_offset, seg_q, seg_k,
+               pos_q, pos_k, alibi, dropout_seed, scale, causal, interpret,
+               mode, window, qk_quant, dropout_rate):
     out, lse = _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal,
                                interpret, mode, save_lse=True,
                                segment_ids=_seg_pair(seg_q, seg_k),
@@ -1437,17 +1616,18 @@ def _flash_fwd(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
                                window=window, alibi=alibi,
                                qk_quant=qk_quant,
                                dropout_rate=dropout_rate,
-                               dropout_seed=dropout_seed)
-    return out, (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                 alibi, dropout_seed, out, lse)
+                               dropout_seed=dropout_seed,
+                               kv_offset=kv_offset)
+    return out, (q, k, v, mask, causal_offset, kv_offset, seg_q, seg_k,
+                 pos_q, pos_k, alibi, dropout_seed, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, mode, window, qk_quant,
                dropout_rate, res, g):
     # The backward is mode-independent: lse = log Σ exp(s) is invariant to
     # the forward's shift choice, and the bwd kernels recompute p from it.
-    (q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k, alibi,
-     dropout_seed, out, lse) = res
+    (q, k, v, mask, causal_offset, kv_offset, seg_q, seg_k, pos_q, pos_k,
+     alibi, dropout_seed, out, lse) = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g,
                                  scale, causal, interpret,
                                  segment_ids=_seg_pair(seg_q, seg_k),
@@ -1455,18 +1635,20 @@ def _flash_bwd(scale, causal, interpret, mode, window, qk_quant,
                                  window=window, alibi=alibi,
                                  qk_quant=qk_quant,
                                  dropout_rate=dropout_rate,
-                                 dropout_seed=dropout_seed)
-    return (dq, dk, dv, None, None, None, None, None, None, None, None)
+                                 dropout_seed=dropout_seed,
+                                 kv_offset=kv_offset)
+    return (dq, dk, dv, None, None, None, None, None, None, None, None,
+            None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
-                    scale=None, interpret=None, softmax_mode='exact',
-                    segment_ids=None, positions=None, window=None,
-                    alibi_slopes=None, qk_quant=None, dropout_rate=0.0,
-                    dropout_seed=None):
+                    kv_offset=0, scale=None, interpret=None,
+                    softmax_mode='exact', segment_ids=None, positions=None,
+                    window=None, alibi_slopes=None, qk_quant=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Fused attention ``softmax(q·kᵀ·scale [+mask])·v`` as TPU kernels.
 
     ``q (..., Tq, d)``, ``k (..., Tk, d)``, ``v (..., Tk, d_v)``; optional
@@ -1552,7 +1734,10 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
     lets sequence-sharded callers run causal attention of local query rows
     against gathered keys with no materialized O(Tq·Tk) triangle; the
     causal comparison and the block-skip predicate use
-    ``causal_offset + row`` as the global row position.
+    ``causal_offset + row`` as the global row position. ``kv_offset`` is
+    the same for key column 0 — callers whose k/v slab is itself a slice
+    of a longer global sequence (the ring path's rotating blocks) pass it
+    so causal masking AND the dropout hash see true global columns.
 
     ``softmax_mode``:
 
@@ -1627,7 +1812,7 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
             'scalar) — the kernel holds no hidden RNG state; derive it '
             'from your jax.random key, e.g. '
             'jax.random.randint(key, (), 0, 2**31 - 1)')
-    return _flash(q, k, v, mask, causal_offset, seg_q, seg_k, pos_q, pos_k,
-                  alibi_slopes, dropout_seed, float(scale), bool(causal),
-                  bool(interpret), softmax_mode, window, qk_quant,
-                  dropout_rate)
+    return _flash(q, k, v, mask, causal_offset, kv_offset, seg_q, seg_k,
+                  pos_q, pos_k, alibi_slopes, dropout_seed, float(scale),
+                  bool(causal), bool(interpret), softmax_mode, window,
+                  qk_quant, dropout_rate)
