@@ -82,9 +82,13 @@ def parse_args():
                         help='attn mode (flash impls): int8-quantized '
                              'QK^T on the MXU int8 path')
     parser.add_argument('--kv-heads', type=int, default=None,
-                        help='attn mode: grouped-query K/V head count '
-                             '(< --heads, must divide it); default = '
-                             '--heads (standard multi-head)')
+                        help='attn/train modes: grouped-query K/V head '
+                             'count (< --heads, must divide it); default '
+                             '= --heads (standard multi-head)')
+    parser.add_argument('--use-rope', action='store_true',
+                        help='train mode: rotary position embeddings on '
+                             'the projected score operands (module '
+                             'use_rope knob)')
     parser.add_argument(
         '--offset', default=32,
         type=lambda s: None if s.lower() in ('none', 'full') else int(s),
@@ -300,7 +304,8 @@ def _memory_analysis(compiled):
 def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
                        no_mask=False, causal=False, iters=3, devices=None,
                        impl='allgather', offset=32, heads=8,
-                       mask_kind=None, n_segments=8, window=None):
+                       mask_kind=None, n_segments=8, window=None,
+                       kv_heads=None, use_rope=False):
     """Measure one full training step — forward, loss, gradient psum, optax
     update as ONE compiled SPMD program (``train.make_train_step``).
     Returns the result record; shared by ``--mode train`` and ``bench.py``
@@ -332,11 +337,12 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     jdtype = jnp.float32 if dtype == 'f32' else jnp.bfloat16
 
     model = DistributedDotProductAttn(
-        key_dim=DIM, num_heads=heads, offset=offset,
+        key_dim=DIM, num_heads=heads, num_kv_heads=kv_heads, offset=offset,
         softmax_impl=attn_impl.replace('_bounded', ''),
         flash_softmax_mode=('bounded' if attn_impl == 'flash_bounded'
                             else 'exact'),
-        causal=causal, window=window, impl=impl, dtype=jdtype)
+        causal=causal, window=window, impl=impl, dtype=jdtype,
+        use_rope=use_rope)
 
     if mask_kind is None:
         mask_kind = 'none' if no_mask else 'dense'
@@ -386,10 +392,16 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         pairs = t * t / 2.0
     else:
         pairs = float(t) * t
-    flops = 3.0 * (8.0 * t * DIM * DIM + 4.0 * pairs * DIM)
+    # GQA shrinks the queries/values projections to kv_heads/heads of
+    # their features (keys/composition unchanged); the attention matmuls
+    # stay per-q-head, so their FLOPs don't change.
+    kvfrac = (kv_heads / heads) if kv_heads else 1.0
+    flops = 3.0 * (4.0 * t * DIM * DIM * (1.0 + kvfrac)
+                   + 4.0 * pairs * DIM)
     return {
         'mode': 'train', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
-        'heads': heads, 'world': world, 'dtype': dtype,
+        'heads': heads, 'kv_heads': kv_heads or heads,
+        'use_rope': use_rope, 'world': world, 'dtype': dtype,
         # offset/impl shape only the 'full' softmax path's matmuls, but are
         # recorded always so any run is reproducible from its record.
         'offset': offset, 'impl': impl,
@@ -414,10 +426,13 @@ def run_train(args):
         no_mask=args.no_mask, causal=args.causal, iters=args.iters,
         devices=args.devices, impl=args.impl, offset=args.offset,
         heads=args.heads, mask_kind=args.mask_kind, window=args.window,
-        n_segments=args.segments)
+        n_segments=args.segments, kv_heads=args.kv_heads,
+        use_rope=args.use_rope)
     ma = record['memory_analysis'] or {}
+    gq = ('' if record['kv_heads'] == record['heads']
+          else f"/kv{record['kv_heads']}")
     print(f"train[{args.attn_impl}] T={record['T']} dim={DIM} "
-          f"H={record['heads']} {record['world']}-device: "
+          f"H={record['heads']}{gq} {record['world']}-device: "
           f"{record['step_time']:.4f}s/step "
           f"({record['step_gflops_per_chip']:.0f} GFLOP/s/chip, "
           f"temp {ma.get('temp_bytes', 0) / 2**30:.2f} GiB)")

@@ -38,6 +38,7 @@ reference module.py:19).
 """
 
 import math
+import zlib
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -45,9 +46,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from distributed_dot_product_tpu.models import features
 from distributed_dot_product_tpu.models.ring_attention import (
-    local_attention_reference, ring_attention,
+    _layout_positions, local_attention_reference, ring_attention,
 )
+from distributed_dot_product_tpu.ops.rope import rope
 from distributed_dot_product_tpu.models.ulysses_attention import (
     ulysses_attention,
 )
@@ -75,6 +78,21 @@ class DistributedDotProductAttn(nn.Module):
     value_dim: Optional[int] = None
     query_dim: Optional[int] = None
     num_heads: int = 1
+    # Grouped-query attention (GQA; None = standard multi-head). The
+    # module's K-first convention (scores = K·Qᵀ softmaxed over the
+    # gathered axis, reference module.py:60-67) means its *queries* and
+    # *values* play standard attention's K/V role — they are the
+    # softmax-table side that gets gathered across shards — while output
+    # rows follow the keys. ``num_kv_heads`` therefore shrinks the
+    # queries/values projections to ``num_kv_heads`` heads (each group of
+    # ``num_heads // num_kv_heads`` key heads shares one); the gathered
+    # operand volume, K/V-analog memory and (on the flash path) ICI bytes
+    # all drop by that factor. ``num_kv_heads=1`` is multi-query.
+    # Extends the reference constructor (reference module.py:23-39, which
+    # has no GQA); supported on every softmax_impl — the fused kernels
+    # handle groups natively, the 'full' parity path repeats heads (it
+    # densifies everything anyway).
+    num_kv_heads: Optional[int] = None
     add_bias: bool = False
     offset: int = 32
     # Causal (autoregressive) masking over GLOBAL positions: output row i
@@ -97,24 +115,39 @@ class DistributedDotProductAttn(nn.Module):
     # softmax_impl='online' + causal only: 'zigzag' balances the causal
     # ring's critical path (shard i holds half-stripes {i, 2W-1-i}; feed
     # inputs permuted by models.ring_attention.zigzag_indices and invert
-    # on the output). Requires attn_mask=None and no segment_ids.
+    # on the output). Requires attn_mask=None; segment_ids ARE supported
+    # (ids need only equality, so the permuted layout carries them).
     ring_layout: str = 'contiguous'
     # For softmax_impl='flash': 'exact' running-max softmax, or 'bounded'
     # (norm-bound shift — faster at small head dim; see
     # ops.pallas_attention.flash_attention for the accuracy contract).
     flash_softmax_mode: str = 'exact'
-    # Attention-weight dropout (flash/ulysses paths): flax-idiomatic —
+    # Attention-weight dropout (flash/online/ulysses): flax-idiomatic —
     # pass rngs={'dropout': key} to apply() (or deterministic=True to
-    # disable, e.g. at eval). The in-kernel mask needs no O(T²) tensor;
-    # see ops.pallas_attention.flash_attention.
+    # disable, e.g. at eval). The in-kernel mask needs no O(T²) tensor
+    # and hashes GLOBAL element coordinates, so the ring path's folds
+    # draw exactly the single-device mask; see
+    # ops.pallas_attention.flash_attention.
     dropout_rate: float = 0.0
-    # ALiBi slopes, shape (num_heads,) (flash/ulysses paths; requires
+    # ALiBi slopes, shape (num_heads,) (flash/online/ulysses; requires
     # causal=True). In the K-first convention attention rows follow
     # keys, so the bias is over key-vs-query global positions — the same
     # relative-distance bias as standard attention.
     alibi_slopes: Optional[Any] = None
     # 'int8' = quantized QK^T on the flash path (see flash_attention).
     qk_quant: Optional[str] = None
+    # Rotary position embeddings on the projected score operands (keys
+    # AND queries — both sides of the K-first scoring, so logits depend
+    # on relative global distance; values are never rotated). Positions
+    # are GLOBAL: each shard rotates by its offset (or its zigzag
+    # position vector under ring_layout='zigzag'), so the sharded result
+    # equals the full-array rotation exactly (see ops/rope.py). No
+    # reference analog (it has no positional encoding); the natural
+    # companion to causal long-context training here. Reference anchor
+    # for where the rotation lands: the projections in the forward,
+    # reference module.py:41-58.
+    use_rope: bool = False
+    rope_base: float = 10000.0
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -130,6 +163,10 @@ class DistributedDotProductAttn(nn.Module):
         if self.impl not in ('allgather', 'ring'):
             raise ValueError(
                 f"impl must be 'allgather' or 'ring', got {self.impl!r}")
+        # Per-path knob support comes from the declarative matrix —
+        # models/features.py is the single source of truth shared with the
+        # README table and the matrix test. Knob-interaction rules
+        # (features.INTERACTION_RULES) stay explicit below.
         if self.window is not None:
             if not isinstance(self.window, int) or self.window < 1:
                 raise ValueError(
@@ -137,20 +174,20 @@ class DistributedDotProductAttn(nn.Module):
             if not self.causal:
                 raise ValueError('window is a lookback cap and requires '
                                  'causal=True')
-        if self.dropout_rate and self.softmax_impl not in ('flash',
-                                                           'ulysses'):
-            raise ValueError(
-                "dropout_rate needs softmax_impl='flash' or 'ulysses' "
-                '(the in-kernel mask lives in the fused kernels)')
+            features.check('window', self.softmax_impl)
+        if self.dropout_rate:
+            features.check('dropout_rate', self.softmax_impl)
         if self.alibi_slopes is not None:
-            if self.softmax_impl not in ('flash', 'ulysses'):
-                raise ValueError("alibi_slopes needs softmax_impl='flash'"
-                                 " or 'ulysses'")
+            features.check('alibi_slopes', self.softmax_impl)
             if not self.causal:
                 raise ValueError('alibi_slopes bias by relative global '
                                  'position and require causal=True')
-        if self.qk_quant is not None and self.softmax_impl != 'flash':
-            raise ValueError("qk_quant needs softmax_impl='flash'")
+        if self.qk_quant is not None:
+            features.check('qk_quant', self.softmax_impl)
+        if self.ring_layout == 'zigzag':
+            features.check('ring_layout=zigzag', self.softmax_impl)
+        if self.flash_softmax_mode == 'bounded':
+            features.check('flash_softmax_mode=bounded', self.softmax_impl)
         value_dim = self.value_dim if self.value_dim is not None \
             else self.key_dim
         if value_dim % self.num_heads:
@@ -161,13 +198,33 @@ class DistributedDotProductAttn(nn.Module):
                 f'{self.num_heads}')
         self.head_dim = self.key_dim // self.num_heads
         self._value_dim = value_dim
+        kv_heads = (self.num_kv_heads if self.num_kv_heads is not None
+                    else self.num_heads)
+        if not 1 <= kv_heads <= self.num_heads \
+                or self.num_heads % kv_heads:
+            raise ValueError(
+                f'num_kv_heads {kv_heads} must divide num_heads '
+                f'{self.num_heads} (and lie in [1, num_heads])')
+        if kv_heads != self.num_heads:
+            features.check('num_kv_heads', self.softmax_impl)
+        self._kv_heads = kv_heads
+        if self.use_rope:
+            features.check('use_rope', self.softmax_impl)
+            if self.head_dim % 2:
+                raise ValueError(
+                    f'use_rope needs an even head dim, got {self.head_dim}')
         dense = lambda feat, name: nn.Dense(  # noqa: E731
             feat, use_bias=self.add_bias, name=name, dtype=self.dtype,
             param_dtype=self.param_dtype)
-        # Same four projections as reference module.py:36-39.
+        # Same four projections as reference module.py:36-39. Under GQA
+        # the queries/values projections (the gathered, softmax-table
+        # side — standard attention's K/V under the module's K-first
+        # convention, see the num_kv_heads field comment) emit only
+        # kv_heads · head_dim features.
         self.keys_proj = dense(self.key_dim, 'keys')
-        self.queries_proj = dense(self.key_dim, 'queries')
-        self.values_proj = dense(value_dim, 'values')
+        self.queries_proj = dense(kv_heads * self.head_dim, 'queries')
+        self.values_proj = dense(
+            kv_heads * (value_dim // self.num_heads), 'values')
         self.composition = dense(value_dim, 'composition')
 
     def __call__(self, keys, queries, values, attn_mask=None,
@@ -196,15 +253,19 @@ class DistributedDotProductAttn(nn.Module):
         queries = self.queries_proj(queries)
         values = self.values_proj(values)
 
+        kv_group = self.num_heads // self._kv_heads
         if self.num_heads > 1:
             # (B, T/N, D) -> (B, H, T/N, dh); mask broadcasts over H
-            # (reference module.py:47-58).
-            def split(x, dh):
-                x = x.reshape(*x.shape[:-1], self.num_heads, dh)
+            # (reference module.py:47-58). Under GQA queries/values split
+            # into their OWN (fewer) heads — the fused kernels consume the
+            # grouped layout directly.
+            def split(x, heads, dh):
+                x = x.reshape(*x.shape[:-1], heads, dh)
                 return jnp.swapaxes(x, -2, -3)
-            keys = split(keys, self.head_dim)
-            queries = split(queries, self.head_dim)
-            values = split(values, self._value_dim // self.num_heads)
+            keys = split(keys, self.num_heads, self.head_dim)
+            queries = split(queries, self._kv_heads, self.head_dim)
+            values = split(values, self._kv_heads,
+                           self._value_dim // self.num_heads)
             if attn_mask is not None:
                 attn_mask = attn_mask[..., None, :, :]
 
@@ -220,6 +281,27 @@ class DistributedDotProductAttn(nn.Module):
             # branch: the math is identical through the flash path — route
             # there instead of duplicating it.
             softmax_impl = 'flash'
+
+        if self.use_rope:
+            # Rotate BOTH score operands by their GLOBAL positions (the
+            # rotation is orthogonal, so k_i·q_j then depends on i−j
+            # only). Keys and queries are both time-sharded local shards
+            # here — on every path — so one shard-offset (or zigzag
+            # position vector) serves both; the flash path's query gather
+            # happens AFTER rotation, reassembling exactly the full-array
+            # rotation.
+            tn = keys.shape[-2]
+            if distributed:
+                idx = jax.lax.axis_index(self.axis_name)
+                world = jax.lax.psum(1, self.axis_name)
+            else:
+                idx, world = 0, 1
+            if softmax_impl == 'online' and self.ring_layout == 'zigzag':
+                pos = _layout_positions('zigzag', idx, world, tn)
+            else:
+                pos = idx * tn + jnp.arange(tn)
+            keys = rope(keys, pos, base=self.rope_base)
+            queries = rope(queries, pos, base=self.rope_base)
 
         # Causal handling: ring/ulysses/flash take causal=True natively —
         # the kernels skip whole future blocks and need no materialized
@@ -253,10 +335,11 @@ class DistributedDotProductAttn(nn.Module):
         seg_local = None
         if segment_ids is not None:
             seg_local = segment_ids.astype(jnp.int32)
-            if softmax_impl in ('full', 'online'):
-                # These paths materialize (T/N, T) rows regardless — the
-                # compact form densifies into the boolean mask (rows =
-                # this shard's positions, columns global).
+            if softmax_impl == 'full':
+                # The parity path materializes (T/N, T) rows regardless —
+                # the compact form densifies into the boolean mask (rows =
+                # this shard's positions, columns global). Every other
+                # path consumes the O(T) vector form in-kernel.
                 seg_full = (jax.lax.all_gather(seg_local, self.axis_name,
                                                axis=-1, tiled=True)
                             if distributed else seg_local)
@@ -271,10 +354,20 @@ class DistributedDotProductAttn(nn.Module):
         if (self.dropout_rate and not deterministic
                 and not self.is_initializing()):
             drop_rate = self.dropout_rate
-            drop_seed = (dropout_seed if dropout_seed is not None else
-                         jax.random.randint(
-                             self.make_rng('dropout'), (), 0,
-                             jnp.iinfo(jnp.int32).max, dtype=jnp.int32))
+            if dropout_seed is not None:
+                # Per-layer salt: stacked layers sharing one explicit seed
+                # (the step counter) would otherwise draw IDENTICAL
+                # coordinate-hash masks — fold a hash of this module's
+                # flax path in, so each layer instance decorrelates while
+                # staying deterministic (the make_rng branch already
+                # decorrelates per path).
+                salt = zlib.crc32('/'.join(self.path).encode()) & 0x7fffffff
+                drop_seed = jnp.bitwise_xor(
+                    jnp.asarray(dropout_seed, jnp.int32), jnp.int32(salt))
+            else:
+                drop_seed = jax.random.randint(
+                    self.make_rng('dropout'), (), 0,
+                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
 
         if softmax_impl == 'flash':
             # Fused-kernel path: the module's K-first scoring + softmax over
@@ -300,10 +393,14 @@ class DistributedDotProductAttn(nn.Module):
             # this shard's keys — global positions start at idx·T/N. Fed
             # whenever distributed: causal/windows need it, and the
             # dropout mask decorrelates shards through it (a dead scalar
-            # read otherwise).
-            causal_offset = (
-                jax.lax.axis_index(self.axis_name) * keys.shape[-2]
-                if distributed else 0)
+            # read otherwise). On a 1-wide axis the offset is STATICALLY
+            # zero — keeping it a Python int lets the causal kernel take
+            # the trapezoid pair grid (static offsets only; see
+            # ops.pallas_attention._trap_eligible).
+            causal_offset = 0
+            if distributed and jax.lax.psum(1, self.axis_name) > 1:
+                causal_offset = (jax.lax.axis_index(self.axis_name)
+                                 * keys.shape[-2])
             seg_pair = None
             if seg_local is not None:
                 # K-first layout: the kernel's query rows are this shard's
@@ -357,17 +454,41 @@ class DistributedDotProductAttn(nn.Module):
             # (reference module.py:61,67) is standard attention with
             # q := keys, k := queries (see ring_attention docstring), so no
             # (T/N, T) score block is ever materialized. Fully-masked rows
-            # give 0 here (reference: NaN).
+            # give 0 here (reference: NaN). Segments ride the ring as
+            # O(T/N) vectors; dropout/ALiBi run in the per-fold kernels
+            # over global coordinates.
             scale = 1.0 / math.sqrt(self.head_dim)
+            seg_ring = seg_local
+            if seg_ring is not None and self.num_heads > 1:
+                seg_ring = seg_ring[..., None, :]
             if distributed:
                 outputs = ring_attention(
                     keys, queries, values, attn_mask,
                     axis_name=self.axis_name, scale=scale,
                     causal=native_causal, layout=self.ring_layout,
-                    window=self.window)
-            else:
-                outputs = local_attention_reference(
+                    window=self.window, segment_ids=seg_ring,
+                    alibi_slopes=self.alibi_slopes,
+                    dropout_rate=drop_rate, dropout_seed=drop_seed)
+            elif (seg_ring is not None or self.alibi_slopes is not None
+                    or drop_rate):
+                # Local oracle with in-kernel features: the fused kernel
+                # IS the local math for segments/ALiBi/dropout (the plain
+                # einsum oracle has none of them); GQA is native there
+                # too.
+                outputs = flash_attention(
                     keys, queries, values, attn_mask, scale=scale,
+                    causal=native_causal, window=self.window,
+                    segment_ids=(None if seg_ring is None
+                                 else (seg_ring, seg_ring)),
+                    alibi_slopes=self.alibi_slopes,
+                    dropout_rate=drop_rate, dropout_seed=drop_seed)
+            else:
+                q_loc, v_loc = queries, values
+                if kv_group > 1:
+                    q_loc = jnp.repeat(q_loc, kv_group, axis=-3)
+                    v_loc = jnp.repeat(v_loc, kv_group, axis=-3)
+                outputs = local_attention_reference(
+                    keys, q_loc, v_loc, attn_mask, scale=scale,
                     causal=native_causal, window=self.window)
             if self.num_heads > 1:
                 outputs = jnp.swapaxes(outputs, -3, -2)
@@ -375,6 +496,13 @@ class DistributedDotProductAttn(nn.Module):
                                           self._value_dim)
             return self.composition(outputs)
 
+        if kv_group > 1:
+            # Parity path under GQA: repeat the grouped heads up to H —
+            # this path materializes full (T/N, T) score rows anyway, so
+            # the repeat costs nothing it wasn't already paying; the fused
+            # paths consume the grouped layout natively.
+            queries = jnp.repeat(queries, kv_group, axis=-3)
+            values = jnp.repeat(values, kv_group, axis=-3)
         if distributed:
             scores = matmul_nt(keys, queries, self.offset,
                                axis_name=self.axis_name, impl=self.impl)

@@ -51,21 +51,24 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     (:func:`~distributed_dot_product_tpu.parallel.mesh.data_seq_mesh`).
     ``data_axis``: name of the batch mesh axis, or None for pure SP.
 
-    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
-    where ``batch = (keys, queries, values, attn_mask, target)`` — or
+    Returns ``step(params, opt_state, batch, dropout_seed=0) ->
+    (params, opt_state, loss)`` where
+    ``batch = (keys, queries, values, attn_mask, target)`` — or
     ``(..., target, segment_ids)`` with a global ``(B, T)`` packed-sequence
     id array — holds *global* arrays; activations are sharded
     ``(batch→data, time→seq)``, parameters and optimizer state stay
     replicated (the reference's weight-replication convention, reference
-    test_gradient.py:48).
+    test_gradient.py:48). ``dropout_seed`` (a traced int32 scalar — pass
+    the step counter) feeds modules with ``dropout_rate > 0``; modules
+    without dropout ignore it, so the default costs nothing.
     """
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
 
     def local_step(params, opt_state, keys, queries, values, mask, target,
-                   seg):
+                   seg, drop_seed):
         def local_loss(p):
             out = module.apply(p, keys, queries, values, mask,
-                               segment_ids=seg)
+                               segment_ids=seg, dropout_seed=drop_seed)
             l = loss_fn(out, target)
             for ax in axes:
                 l = lax.pmean(l, ax)
@@ -92,15 +95,15 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
                 else P(data_axis, seq_axis))
     sharded = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), a3, a3, a3, a3, a3, seg_spec),
+        in_specs=(P(), P(), a3, a3, a3, a3, a3, seg_spec, P()),
         out_specs=(P(), P(), P()),
         check_vma=False)
 
-    def step(params, opt_state, batch):
+    def step(params, opt_state, batch, dropout_seed=0):
         keys, queries, values, mask, target, *rest = batch
         seg = rest[0] if rest else None
         return sharded(params, opt_state, keys, queries, values, mask,
-                       target, seg)
+                       target, seg, jnp.asarray(dropout_seed, jnp.int32))
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)

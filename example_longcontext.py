@@ -35,6 +35,13 @@ def main():
                     help='global T (default: 512 on CPU, 16384 on TPU)')
     ap.add_argument('--dim', type=int, default=256)
     ap.add_argument('--heads', type=int, default=8)
+    ap.add_argument('--kv-heads', type=int, default=None,
+                    help='grouped-query K/V heads (default: --heads)')
+    ap.add_argument('--no-rope', action='store_true',
+                    help='disable rotary position embeddings')
+    ap.add_argument('--dropout', type=float, default=0.0,
+                    help='attention-weight dropout rate (in-kernel mask; '
+                         'seeded by the step counter)')
     ap.add_argument('--steps', type=int, default=4)
     ap.add_argument('--ckpt-dir', default=None,
                     help='checkpoint directory (default: a temp dir)')
@@ -50,9 +57,13 @@ def main():
     print(f'{world}-device mesh, T={t}, dim={args.dim}, '
           f'heads={args.heads}, dtype={dtype.__name__}')
 
+    # RoPE on by default: rotary embeddings over GLOBAL positions are the
+    # standard causal long-context setup, and the sharded rotation equals
+    # the full-array one exactly (ops/rope.py).
     model = ddp.DistributedDotProductAttn(
-        key_dim=args.dim, num_heads=args.heads, causal=True,
-        softmax_impl='flash', dtype=dtype)
+        key_dim=args.dim, num_heads=args.heads, num_kv_heads=args.kv_heads,
+        causal=True, use_rope=not args.no_rope,
+        dropout_rate=args.dropout, softmax_impl='flash', dtype=dtype)
 
     key = jax.random.key(111)
     x = jax.random.normal(key, (1, t, args.dim), dtype)
@@ -83,7 +94,10 @@ def main():
     batch = (x, x, x, None, target)          # attn_mask=None: no O(T^2) input
     for i in range(start, start + args.steps):
         tic = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, batch)
+        # The step counter seeds the in-kernel dropout mask (a fresh,
+        # reproducible mask per step; ignored when --dropout is 0).
+        params, opt_state, loss = step(params, opt_state, batch,
+                                       dropout_seed=i)
         loss = float(jax.block_until_ready(loss))
         print(f'step {i}: loss={loss:.6f} '
               f'({(time.perf_counter() - tic) * 1000:.1f} ms)')

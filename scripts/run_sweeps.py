@@ -151,6 +151,15 @@ SWEEPS = [
     ('train_benchmark_flash_segments',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '16384', '--mask-kind', 'segments', '--segments', '8']),
+    # --- round-4 module-surface records: GQA projections, RoPE, and the
+    # ring path carrying dropout + packed segments (the long-context
+    # training combo that used to raise) ---
+    ('train_benchmark_flash_gqa_kv2',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--no-mask', '--kv-heads', '2']),
+    ('train_benchmark_flash_rope',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '16384', '--no-mask', '--causal', '--use-rope']),
     # --- train-step head-dim sweep (dim=768 fixed, so d = 768/heads) ---
     *[(f'train_benchmark_flash_h{h}_{tag}_nomask',
        ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',

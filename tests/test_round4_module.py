@@ -1,0 +1,378 @@
+# -*- coding: utf-8 -*-
+"""
+Round-4 module surface: GQA (``num_kv_heads``) end-to-end on every
+softmax path, RoPE integration, and ring-path feature parity
+(dropout / ALiBi / native segments — the knobs that used to raise for
+``softmax_impl='online'``).
+
+Oracle strategy follows the reference's ``distributed=False`` pattern
+(reference test_gradient.py:45-47) plus a repeated-kv-head oracle for
+GQA: a module with ``num_kv_heads=None`` whose queries/values kernels
+are the GQA module's kernels tiled per group must produce bitwise the
+same forward (the grouped kernels read each kv head once per group
+member — identical math, different layout).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_dot_product_tpu.models.attention import (
+    DistributedDotProductAttn, apply_seq_parallel,
+)
+from distributed_dot_product_tpu.models.ring_attention import zigzag_indices
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+
+WORLD, LEN, DIM, HEADS, KV_HEADS = 4, 16, 32, 4, 2
+T = WORLD * LEN
+GROUP = HEADS // KV_HEADS
+
+pytestmark = pytest.mark.slow
+
+IMPLS = ['full', 'online', 'flash', 'ulysses']
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return seq_mesh(WORLD)
+
+
+def _inputs(key=0, t=T):
+    kk, kq, kv = jax.random.split(jax.random.key(key), 3)
+    return (jax.random.normal(kk, (2, t, DIM)),
+            jax.random.normal(kq, (2, t, DIM)),
+            jax.random.normal(kv, (2, t, DIM)))
+
+
+def _model(**kw):
+    kw.setdefault('num_heads', HEADS)
+    return DistributedDotProductAttn(key_dim=DIM, **kw)
+
+
+def _segments(t=T):
+    # Three ragged segments, same for both batch rows.
+    ids = np.zeros((2, t), np.int32)
+    ids[:, t // 3:] = 1
+    ids[:, 2 * t // 3 + 3:] = 2
+    return jnp.asarray(ids)
+
+
+def _tile_gqa_params(params):
+    """Repeated-kv-head oracle params: tile each kv head's queries/values
+    kernel columns for every member of its group."""
+    def tile(kernel):
+        d_in, d_out = kernel.shape
+        dh = d_out // KV_HEADS
+        k = kernel.reshape(d_in, KV_HEADS, dh)
+        return jnp.repeat(k, GROUP, axis=1).reshape(d_in, KV_HEADS * GROUP
+                                                    * dh)
+    p = jax.tree.map(lambda x: x, params)  # copy structure
+    for name in ('queries', 'values'):
+        p['params'][name]['kernel'] = tile(params['params'][name]['kernel'])
+    return p
+
+
+@pytest.mark.parametrize('impl', IMPLS)
+def test_gqa_module_matches_repeated_kv_oracle(mesh, impl):
+    kv = KV_HEADS if impl != 'ulysses' else WORLD  # ulysses: Hkv % N == 0
+    heads = HEADS if impl != 'ulysses' else 2 * WORLD
+    m = _model(num_heads=heads, num_kv_heads=kv, causal=True,
+               softmax_impl=impl)
+    k, q, v = _inputs()
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    out = apply_seq_parallel(m, params, mesh, k, q, v)
+
+    group = heads // kv
+
+    def tile(kernel):
+        d_in, d_out = kernel.shape
+        dh = d_out // kv
+        kk = kernel.reshape(d_in, kv, dh)
+        return jnp.repeat(kk, group, axis=1).reshape(d_in, heads * dh)
+    full_params = jax.tree.map(lambda x: x, params)
+    for name in ('queries', 'values'):
+        full_params['params'][name]['kernel'] = tile(
+            params['params'][name]['kernel'])
+    oracle = _model(num_heads=heads, causal=True, softmax_impl=impl)
+    ref = apply_seq_parallel(oracle, full_params, mesh, k, q, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_gqa_module_gradients_are_group_sums(mesh):
+    """The full-head oracle's queries/values kernel grads, summed over
+    each kv group, must equal the GQA module's grads — the module-level
+    version of the kernel's fp32 group-sum contract."""
+    m = _model(num_kv_heads=KV_HEADS, causal=True, softmax_impl='flash')
+    k, q, v = _inputs(key=1)
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    full_params = _tile_gqa_params(params)
+    oracle = _model(causal=True, softmax_impl='flash')
+
+    def loss_gqa(p):
+        return jnp.sum(apply_seq_parallel(m, p, mesh, k, q, v) ** 2)
+
+    def loss_full(p):
+        return jnp.sum(apply_seq_parallel(oracle, p, mesh, k, q, v) ** 2)
+
+    lg, gg = jax.value_and_grad(loss_gqa)(params)
+    lf, gf = jax.value_and_grad(loss_full)(full_params)
+    np.testing.assert_allclose(float(lg), float(lf), rtol=1e-5)
+
+    for name in ('queries', 'values'):
+        got = np.asarray(gg['params'][name]['kernel'])
+        full = np.asarray(gf['params'][name]['kernel'])
+        d_in, d_out = full.shape
+        dh = d_out // HEADS
+        want = full.reshape(d_in, KV_HEADS, GROUP, dh).sum(axis=2)
+        np.testing.assert_allclose(got.reshape(d_in, KV_HEADS, dh), want,
+                                   atol=1e-4)
+    # keys/composition grads agree outright (same shapes both modules).
+    for name in ('keys', 'composition'):
+        np.testing.assert_allclose(
+            np.asarray(gg['params'][name]['kernel']),
+            np.asarray(gf['params'][name]['kernel']), atol=1e-4)
+
+
+def test_gqa_train_step(mesh):
+    m = _model(num_kv_heads=KV_HEADS, causal=True, softmax_impl='flash',
+               dtype=jnp.bfloat16)
+    k, q, v = _inputs(key=2)
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    opt = optax.adam(1e-3)
+    step = make_train_step(m, opt, mesh)
+    opt_state = opt.init(params)
+    batch = (k, q, v, jnp.zeros((2, T, T), bool), jnp.zeros_like(v))
+    l0 = None
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+        l0 = l0 if l0 is not None else float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < l0 * 1.001
+
+
+@pytest.mark.parametrize('impl', IMPLS)
+def test_rope_module_sharded_matches_local(mesh, impl):
+    m = _model(use_rope=True, causal=True, softmax_impl=impl)
+    k, q, v = _inputs(key=3)
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    out = apply_seq_parallel(m, params, mesh, k, q, v)
+    local = _model(use_rope=True, causal=True, softmax_impl=impl,
+                   distributed=False)
+    ref = local.apply(params, k, q, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_rope_changes_output_and_base_matters(mesh):
+    k, q, v = _inputs(key=4)
+    base = _model(softmax_impl='flash', causal=True)
+    m1 = _model(softmax_impl='flash', causal=True, use_rope=True)
+    m2 = _model(softmax_impl='flash', causal=True, use_rope=True,
+                rope_base=500.0)
+    params = base.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8],
+                       None)
+    o0 = apply_seq_parallel(base, params, mesh, k, q, v)
+    o1 = apply_seq_parallel(m1, params, mesh, k, q, v)
+    o2 = apply_seq_parallel(m2, params, mesh, k, q, v)
+    assert not np.allclose(np.asarray(o0), np.asarray(o1))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_rope_zigzag_ring_matches_local(mesh):
+    """RoPE under the zigzag ring layout: feed zigzag-permuted shards,
+    invert the permutation on the output, compare against the local
+    (contiguous, unsharded) module — exercises the position-vector
+    plumbing end-to-end through the module."""
+    idx = zigzag_indices(T, WORLD)
+    inv = jnp.argsort(idx)
+    k, q, v = _inputs(key=5)
+    m = _model(use_rope=True, causal=True, softmax_impl='online',
+               ring_layout='zigzag')
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    out = apply_seq_parallel(m, params, mesh, k[:, idx], q[:, idx],
+                             v[:, idx])[:, inv]
+    local = _model(use_rope=True, causal=True, softmax_impl='online',
+                   distributed=False)
+    ref = local.apply(params, k, q, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_dropout_matches_flash_same_seed(mesh):
+    """The dropout hash keys on global coordinates, so the ring path must
+    draw EXACTLY the flash path's mask for one replicated seed."""
+    k, q, v = _inputs(key=6)
+    mo = _model(softmax_impl='online', dropout_rate=0.35)
+    mf = _model(softmax_impl='flash', dropout_rate=0.35)
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    oo = apply_seq_parallel(mo, params, mesh, k, q, v, dropout_seed=9)
+    of = apply_seq_parallel(mf, params, mesh, k, q, v, dropout_seed=9)
+    np.testing.assert_allclose(np.asarray(oo), np.asarray(of), atol=2e-5)
+    # And it actually drops: deterministic=True differs.
+    od = apply_seq_parallel(mo, params, mesh, k, q, v, deterministic=True)
+    assert not np.allclose(np.asarray(oo), np.asarray(od))
+
+
+def test_ring_dropout_gradients(mesh):
+    """Ring backward regenerates the forward's keep mask per fold: grads
+    must match the flash path's (same seed, same global mask)."""
+    k, q, v = _inputs(key=7)
+    mo = _model(softmax_impl='online', dropout_rate=0.25)
+    mf = _model(softmax_impl='flash', dropout_rate=0.25)
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+
+    def loss(m, p):
+        out = apply_seq_parallel(m, p, mesh, k, q, v, dropout_seed=11)
+        return jnp.sum(out ** 2)
+
+    go = jax.grad(lambda p: loss(mo, p))(params)
+    gf = jax.grad(lambda p: loss(mf, p))(params)
+    for name in ('keys', 'queries', 'values', 'composition'):
+        np.testing.assert_allclose(
+            np.asarray(go['params'][name]['kernel']),
+            np.asarray(gf['params'][name]['kernel']), atol=5e-4)
+
+
+def test_ring_alibi_matches_flash(mesh):
+    slopes = jnp.asarray([2.0 ** -(i + 1) for i in range(HEADS)])
+    k, q, v = _inputs(key=8)
+    mo = _model(softmax_impl='online', causal=True, alibi_slopes=slopes)
+    mf = _model(softmax_impl='flash', causal=True, alibi_slopes=slopes)
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    oo = apply_seq_parallel(mo, params, mesh, k, q, v)
+    of = apply_seq_parallel(mf, params, mesh, k, q, v)
+    np.testing.assert_allclose(np.asarray(oo), np.asarray(of), atol=2e-5)
+
+
+def test_ring_native_segments_match_flash_and_densified(mesh):
+    """Segments ride the ring as O(T/N) vectors — outputs must equal both
+    the flash path's in-kernel form and the 'full' path's densified
+    mask."""
+    seg = _segments()
+    k, q, v = _inputs(key=9)
+    mo = _model(softmax_impl='online')
+    mf = _model(softmax_impl='flash')
+    md = _model(softmax_impl='full')
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    oo = apply_seq_parallel(mo, params, mesh, k, q, v, segment_ids=seg)
+    of = apply_seq_parallel(mf, params, mesh, k, q, v, segment_ids=seg)
+    od = apply_seq_parallel(md, params, mesh, k, q, v, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(oo), np.asarray(of), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(oo), np.asarray(od), atol=2e-5)
+
+
+def test_ring_segments_gradients_match_flash(mesh):
+    seg = _segments()
+    k, q, v = _inputs(key=10)
+    mo = _model(softmax_impl='online', causal=True)
+    mf = _model(softmax_impl='flash', causal=True)
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+
+    def loss(m, p):
+        out = apply_seq_parallel(m, p, mesh, k, q, v, segment_ids=seg)
+        return jnp.sum(out ** 2)
+
+    go = jax.grad(lambda p: loss(mo, p))(params)
+    gf = jax.grad(lambda p: loss(mf, p))(params)
+    for name in ('keys', 'queries', 'values', 'composition'):
+        np.testing.assert_allclose(
+            np.asarray(go['params'][name]['kernel']),
+            np.asarray(gf['params'][name]['kernel']), atol=5e-4)
+
+
+def test_zigzag_ring_with_segments(mesh):
+    """Zigzag + packed sequences: ids follow their rows through the
+    permutation, so the permuted-shard result must invert back to the
+    contiguous local oracle."""
+    idx = zigzag_indices(T, WORLD)
+    inv = jnp.argsort(idx)
+    seg = _segments()
+    k, q, v = _inputs(key=11)
+    m = _model(softmax_impl='online', causal=True, ring_layout='zigzag')
+    params = m.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    out = apply_seq_parallel(m, params, mesh, k[:, idx], q[:, idx],
+                             v[:, idx], segment_ids=seg[:, idx])[:, inv]
+    local = _model(softmax_impl='online', causal=True, distributed=False)
+    ref = local.apply(params, k, q, v, None, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_zigzag_ring_dropout_positions_hash(mesh):
+    """Zigzag + dropout exercises the explicit-positions branch of the
+    in-kernel dropout hash (rows/cols come from the position vectors, not
+    the offset arithmetic): the permuted-shard result must invert back to
+    the contiguous flash path's output for the SAME seed — in forward and
+    backward (a row/col broadcast swap in any of the three kernels would
+    desynchronize the backward's mask from the forward's)."""
+    idx = zigzag_indices(T, WORLD)
+    inv = jnp.argsort(idx)
+    k, q, v = _inputs(key=14)
+    mz = _model(softmax_impl='online', causal=True, ring_layout='zigzag',
+                dropout_rate=0.3)
+    mf = _model(softmax_impl='flash', causal=True, dropout_rate=0.3)
+    params = mz.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+
+    def loss_z(p):
+        out = apply_seq_parallel(mz, p, mesh, k[:, idx], q[:, idx],
+                                 v[:, idx], dropout_seed=17)[:, inv]
+        return jnp.sum(out ** 2), out
+
+    def loss_f(p):
+        out = apply_seq_parallel(mf, p, mesh, k, q, v, dropout_seed=17)
+        return jnp.sum(out ** 2), out
+
+    (_, oz), gz = jax.value_and_grad(loss_z, has_aux=True)(params)
+    (_, of), gf = jax.value_and_grad(loss_f, has_aux=True)(params)
+    np.testing.assert_allclose(np.asarray(oz), np.asarray(of), atol=2e-5)
+    for name in ('keys', 'queries', 'values', 'composition'):
+        np.testing.assert_allclose(
+            np.asarray(gz['params'][name]['kernel']),
+            np.asarray(gf['params'][name]['kernel']), atol=5e-4)
+
+
+def test_ring_dropout_with_window_and_segments(mesh):
+    """The long-context training combo the verdict called out: ring path
+    with causal + window + packed sequences + dropout, at ring memory
+    cost — must agree with the flash path under one seed."""
+    seg = _segments()
+    k, q, v = _inputs(key=12)
+    kw = dict(causal=True, window=24, dropout_rate=0.2)
+    mo = _model(softmax_impl='online', **kw)
+    mf = _model(softmax_impl='flash', **kw)
+    params = mo.init(jax.random.key(0), k[:, :8], q[:, :8], v[:, :8], None)
+    oo = apply_seq_parallel(mo, params, mesh, k, q, v, segment_ids=seg,
+                            dropout_seed=13)
+    of = apply_seq_parallel(mf, params, mesh, k, q, v, segment_ids=seg,
+                            dropout_seed=13)
+    np.testing.assert_allclose(np.asarray(oo), np.asarray(of), atol=2e-5)
+
+
+def test_per_layer_dropout_salt(mesh):
+    """Two sibling attention layers given the SAME explicit seed must
+    draw different masks (the per-layer salt, advisor round-3 item 1)."""
+    import flax.linen as nn
+
+    class Stack(nn.Module):
+        @nn.compact
+        def __call__(self, k, q, v):
+            a = DistributedDotProductAttn(
+                key_dim=DIM, num_heads=HEADS, softmax_impl='flash',
+                dropout_rate=0.4, distributed=False,
+                name='layer_a')(k, q, v, None, dropout_seed=21)
+            b = DistributedDotProductAttn(
+                key_dim=DIM, num_heads=HEADS, softmax_impl='flash',
+                dropout_rate=0.4, distributed=False,
+                name='layer_b')(k, q, v, None, dropout_seed=21)
+            return a, b
+
+    k, q, v = _inputs(key=13)
+    stack = Stack()
+    params = stack.init(jax.random.key(0), k, q, v)
+    # Give both layers IDENTICAL weights so any output difference can only
+    # come from the dropout masks.
+    shared = {'params': {'layer_b': params['params']['layer_a'],
+                         'layer_a': params['params']['layer_a']}}
+    a, b = stack.apply(shared, k, q, v)
+    assert not np.allclose(np.asarray(a), np.asarray(b)), \
+        'identical layers + identical explicit seed must still decorrelate'
