@@ -12,6 +12,15 @@ unchanged on one host or a multi-host pod (every process calls
 sharding the provided template carries — so a checkpoint taken on one mesh
 can resume on another.
 
+Paths go through ``etils.epath``, so ``path`` may be a POSIX directory OR
+an object-store URL (``gs://bucket/run1`` — where real TPU pods
+checkpoint): listing, existence checks and the overwrite-backup dance all
+use epath's backend-portable operations, and orbax itself writes through
+the same abstraction. (On object stores a directory "rename" is
+per-object copy+delete — the backup dance costs one checkpoint's worth of
+copies there; orbax's own temp-write + commit-marker finalization is what
+makes the write itself atomic on every backend.)
+
 Durability: orbax finalizes a checkpoint only after all shards land
 (rename on POSIX, commit marker on object stores); ``latest_step`` asks
 orbax whether a step directory is finalized, so a crash mid-save is never
@@ -27,7 +36,6 @@ Usage::
 """
 
 import os
-import shutil
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -62,8 +70,18 @@ def _checkpointer():
     return _CKPTR
 
 
+def _root(path):
+    """The run directory as an epath.Path, absolutized for local paths
+    (orbax requires absolute paths; URLs are absolute by construction)."""
+    from etils import epath
+    s = os.fspath(path)
+    if '://' not in s:
+        s = os.path.abspath(s)
+    return epath.Path(s)
+
+
 def _step_dir(path, step):
-    return os.path.join(os.fspath(path), f'step_{step:09d}')
+    return _root(path) / f'step_{step:09d}'
 
 
 def _is_finalized(path):
@@ -76,10 +94,10 @@ def _is_finalized(path):
         # Orbax in-progress dirs carry an '.orbax-checkpoint-tmp' suffix,
         # and a finalized StandardCheckpointer dir contains its metadata
         # files; require positive evidence of the latter.
-        if '.orbax-checkpoint-tmp' in os.path.basename(os.fspath(path)):
+        if '.orbax-checkpoint-tmp' in path.name:
             return False
         try:
-            entries = set(os.listdir(path))
+            entries = {p.name for p in path.iterdir()}
         except OSError:
             return False
         return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
@@ -88,25 +106,21 @@ def _is_finalized(path):
 def save(path, state: TrainState, *, force: bool = True) -> str:
     """Write ``state`` under ``path/step_<step>/``; returns that directory.
 
-    Atomic: orbax writes to a temporary name and finalizes it afterwards.
-    If the step already exists and ``force`` is set, the old checkpoint is
-    kept as ``step_<step>.replaced`` until the new write finalizes, so a
-    crash mid-overwrite never destroys the only copy of a step.
+    ``path``: POSIX directory or object-store URL (``gs://...``) — see
+    the module docstring. Atomic: orbax writes to a temporary name and
+    finalizes it afterwards. If the step already exists and ``force`` is
+    set, the old checkpoint is kept as ``step_<step>.replaced`` until the
+    new write finalizes, so a crash mid-overwrite never destroys the only
+    copy of a step.
 
     Collective on multi-host: every process must call this with its view
-    of the same global arrays (directory juggling runs on process 0 only).
-    ``path`` must be a local/POSIX filesystem visible to process 0 — the
-    backup rename dance uses ``os.rename``/``shutil.rmtree``; object-store
-    URLs (``gs://`` etc.) are rejected up front (use orbax directly there).
+    of the same global arrays (directory juggling runs on process 0 only;
+    process 0's filesystem view decides the overwrite branch for
+    everyone).
     """
-    if '://' in os.fspath(path):
-        raise ValueError(
-            f'save() supports POSIX paths only, got {path!r} — the '
-            'overwrite-backup rename is a filesystem operation; for '
-            'object stores call orbax.checkpoint directly')
     target = _step_dir(path, int(state.step))
-    backup = target + '.replaced'
-    exists = os.path.isdir(target)
+    backup = target.parent / (target.name + '.replaced')
+    exists = target.is_dir()
     if jax.process_count() > 1:
         # Every process must take the same branch below (the orbax save is
         # collective; one process raising while others enter it would hang
@@ -119,34 +133,35 @@ def save(path, state: TrainState, *, force: bool = True) -> str:
         raise FileExistsError(
             f'{target} already exists; pass force=True to replace it')
     if exists and jax.process_index() == 0:
-        if os.path.isdir(backup):
-            shutil.rmtree(backup)
-        os.rename(target, backup)
+        if backup.is_dir():
+            backup.rmtree()
+        target.rename(backup)
     synchronize()
     ckptr = _checkpointer()
-    ckptr.save(os.path.abspath(target), state)
+    ckptr.save(target, state)
     ckptr.wait_until_finished()
     synchronize()
-    if exists and jax.process_index() == 0 and os.path.isdir(backup):
-        shutil.rmtree(backup)
-    return target
+    if exists and jax.process_index() == 0 and backup.is_dir():
+        backup.rmtree()
+    return os.fspath(target)
 
 
 def latest_step(path) -> Optional[int]:
     """Highest step with a FINALIZED checkpoint under ``path``, or None —
     a crash mid-save leaves an unfinalized directory, which is skipped."""
-    path = os.fspath(path)
-    if not os.path.isdir(path):
+    root = _root(path)
+    if not root.is_dir():
         return None
     steps = []
-    for name in os.listdir(path):
+    for child in root.iterdir():
+        name = child.name
         if not name.startswith('step_') or name.endswith('.replaced'):
             continue
         try:
             step = int(name[len('step_'):])
         except ValueError:
             continue
-        if _is_finalized(os.path.join(path, name)):
+        if _is_finalized(child):
             steps.append(step)
     return max(steps) if steps else None
 
@@ -162,8 +177,7 @@ def restore(path, template: TrainState, *, step: Optional[int] = None
         step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f'no checkpoint under {path!r}')
-    target = os.path.abspath(_step_dir(path, step))
-    restored = _checkpointer().restore(target, template)
+    restored = _checkpointer().restore(_step_dir(path, step), template)
     # orbax returns the same pytree type; ensure the step is a python int
     # (templates often carry traced/array steps).
     return restored._replace(step=int(jax.device_get(restored.step)))
