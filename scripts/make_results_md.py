@@ -301,7 +301,17 @@ counts only the lower-triangle work. The pair tables are gated at 64K
 pairs (~0.5 MiB SMEM), so T≤~360K takes the trapezoid at block 1024 and
 longer sequences keep the full grid with in-kernel skipping; traced
 (multi-shard SPMD) offsets keep the full grid too — each shard's
-triangle differs, and a grid size cannot be data-dependent.""")
+triangle differs, and a grid size cannot be data-dependent.
+
+A DMA-aliasing variant for those full-grid cases (clamp out-of-triangle
+K/V block indices to the row's last valid block via dynamic index maps,
+so skipped programs re-use the resident copy) was built and measured —
+and REJECTED: traced-offset causal forward at T=16K ran 7.45 ms aliased
+vs 4.80 ms plain (the scalar-prefetch dynamic maps cost ~2-3 µs of
+scalar-core work per program, more than the skipped blocks' DMA), while
+the trapezoid's 4.55 ms wins by halving the program count outright, not
+by saving DMA per skipped program. Negative result recorded so the next
+round doesn't re-derive it.""")
 
     print("""
 ### Communication model (multi-chip, analytic + HLO-validated)
