@@ -402,6 +402,45 @@ def _trap_tables_t(rel, nqb, nkb, bq, bk):
             jnp.asarray(qlo, jnp.int32))
 
 
+def _trap_chunk_bounds(rel, tq, tk, bq, bk):
+    """Q-row chunk boundaries such that each chunk's causal pair table
+    fits ``_TRAP_MAX_PAIRS``: beyond-cap sequences (T≈512K at block 1024)
+    split into a few row chunks, each of which the trapezoid grid then
+    covers — the kernels never see the full grid. Greedy accumulation of
+    per-Q-block extents; returns [(row0, row1), ...] (block-aligned,
+    one entry = no chunking needed)."""
+    import numpy as np
+    nqb = -(-tq // bq)
+    nkb = -(-tk // bk)
+    ext = np.clip((rel + (np.arange(nqb) + 1) * bq + bk - 1) // bk,
+                  1, nkb)
+    return _greedy_bounds(ext, bq, tq)
+
+
+def _greedy_bounds(counts, blk, total):
+    bounds = []
+    start = 0
+    acc = 0
+    for i, e in enumerate(counts):
+        if acc + e > _TRAP_MAX_PAIRS and i > start:
+            bounds.append((start * blk, min(i * blk, total)))
+            start, acc = i, 0
+        acc += int(e)
+    bounds.append((start * blk, total))
+    return bounds
+
+
+def _trap_chunk_bounds_t(rel, tq, tk, bq, bk):
+    """K-block chunk boundaries for the dk/dv pass (each K chunk's
+    transposed pair table fits the cap); chunks emit DISJOINT dk/dv
+    slices, so beyond-cap backward chunking needs no partial sums."""
+    import numpy as np
+    nqb = -(-tq // bq)
+    nkb = -(-tk // bk)
+    qlo = np.clip((np.arange(nkb) * bk - rel + bq) // bq - 1, 0, nqb - 1)
+    return _greedy_bounds(nqb - qlo, bk, tk)
+
+
 def _trap_eligible(causal, window, mask, positions, causal_offset,
                    kv_offset, mode, interpret):
     """The trapezoid grid applies to plain causal attention with STATIC
@@ -880,6 +919,38 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
+    if _trap_eligible(causal, window, mask, positions, causal_offset,
+                      kv_offset, mode, interpret):
+        # Beyond-cap pair tables: split the Q rows into chunks that each
+        # fit, and run each chunk through this same impl with a shifted
+        # row offset — every chunk then takes the trapezoid grid. Row
+        # chunking is exact: outputs are per-row, per-row int8 scales are
+        # per-row, the dropout hash keys on global coordinates (which the
+        # shifted offset preserves), and seg_q slices with its rows.
+        bq0, bk0 = _block_sizes(tq, tk, q.dtype, d_total=d + d_v)
+        bounds = _trap_chunk_bounds(
+            int(causal_offset) - int(kv_offset), tq, tk, bq0, bk0)
+        if len(bounds) > 1:
+            outs, lses = [], []
+            for r0, r1 in bounds:
+                seg = segment_ids
+                if seg is not None:
+                    seg = (seg[0][..., r0:r1], seg[1])
+                res = _flash_fwd_impl(
+                    q[..., r0:r1, :], k, v, None, causal_offset + r0,
+                    scale, causal, interpret, mode, save_lse=save_lse,
+                    segment_ids=seg, alibi=alibi, qk_quant=qk_quant,
+                    dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+                    kv_offset=kv_offset)
+                if save_lse:
+                    outs.append(res[0])
+                    lses.append(res[1])
+                else:
+                    outs.append(res)
+            out = jnp.concatenate(outs, axis=-2)
+            if save_lse:
+                return out, jnp.concatenate(lses, axis=-1)
+            return out
     nb = int(math.prod(batch)) if batch else 1
     kv_group = _kv_group(q, k)
     nbk = nb // kv_group
@@ -1344,7 +1415,8 @@ def _make_dkv_kernel(scale, causal, bq, bk, kv_len, has_mask, has_seg,
 def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
                     causal, interpret, grad_dtype=None, segment_ids=None,
                     positions=None, window=None, alibi=None, qk_quant=None,
-                    dropout_rate=0.0, dropout_seed=None, kv_offset=0):
+                    dropout_rate=0.0, dropout_seed=None, kv_offset=0,
+                    only='both'):
     """Blockwise flash backward: dq pass + dk/dv pass, O(block²) score
     memory. Algebra: with ``p = exp(s − lse)`` (the softmax weights),
     ``dv = pᵀ·dO``, ``ds = p ⊙ (dO·vᵀ − Δ)`` where ``Δ = rowsum(dO ⊙ O)``,
@@ -1360,6 +1432,51 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
+    if only == 'both' and _trap_eligible(causal, window, mask, positions,
+                                         causal_offset, kv_offset,
+                                         'exact', interpret):
+        rel = int(causal_offset) - int(kv_offset)
+        bq0, bk0 = _bwd_block_sizes(tq, tk, q.dtype, d_total=d + d_v)
+        q_bounds = _trap_chunk_bounds(rel, tq, tk, bq0, bk0)
+        k_bounds = _trap_chunk_bounds_t(rel, tq, tk, bq0, bk0)
+        if max(len(q_bounds), len(k_bounds)) > 1:
+            # Beyond-cap chunking: every chunk's output is a DISJOINT
+            # slice (dq rows from Q chunks, dk/dv rows from K chunks),
+            # so nothing is partial-summed and peak memory matches the
+            # unchunked program (an earlier Q-only variant summed fp32
+            # dk/dv partials per chunk and OOMed a 16 GiB chip at
+            # T=512K). Each per-chunk call runs only its own pass.
+            dqs = []
+            for r0, r1 in q_bounds:
+                seg = segment_ids
+                if seg is not None:
+                    seg = (seg[0][..., r0:r1], seg[1])
+                dq_c, _, _ = _flash_bwd_impl(
+                    q[..., r0:r1, :], k, v, None, causal_offset + r0,
+                    out[..., r0:r1, :], lse[..., r0:r1],
+                    g[..., r0:r1, :], scale, causal, interpret,
+                    grad_dtype=grad_dtype, segment_ids=seg, alibi=alibi,
+                    qk_quant=qk_quant, dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed, kv_offset=kv_offset,
+                    only='dq')
+                dqs.append(dq_c)
+            dks, dvs = [], []
+            for c0, c1 in k_bounds:
+                seg = segment_ids
+                if seg is not None:
+                    seg = (seg[0], seg[1][..., c0:c1])
+                _, dk_c, dv_c = _flash_bwd_impl(
+                    q, k[..., c0:c1, :], v[..., c0:c1, :], None,
+                    causal_offset, out, lse, g, scale, causal, interpret,
+                    grad_dtype=grad_dtype, segment_ids=seg, alibi=alibi,
+                    qk_quant=qk_quant, dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed, kv_offset=kv_offset + c0,
+                    only='dkv')
+                dks.append(dk_c)
+                dvs.append(dv_c)
+            return (jnp.concatenate(dqs, axis=-2),
+                    jnp.concatenate(dks, axis=-2),
+                    jnp.concatenate(dvs, axis=-2))
     nb = int(math.prod(batch)) if batch else 1
     kv_group = _kv_group(q, k)
     nbk = nb // kv_group
@@ -1494,80 +1611,91 @@ def _flash_bwd_impl(q, k, v, mask, causal_offset, out, lse, g, scale,
         ]
 
     # --- dq pass: grid (batch, Q block, K band), K innermost ---
-    dq_in_specs = [
-        off_spec,
-        *seed_specs,
-        pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), k_map),
-        pl.BlockSpec((1, bk, d_v), k_map),
-        pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
-        pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
-    ] + quant_specs + aux_specs
-    dq_out_spec = pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0))
-    if trap:
-        dq_grid = (nb, int(trap_pre[0].shape[0]))
-        dq_in_specs = _wrap_specs_pairs(dq_in_specs)
-        dq_out_spec = _wrap_specs_pairs([dq_out_spec])[0]
-    else:
-        dq_grid = (nb, nqb, kband if banded else nkb)
-    dq = _pallas_call(
-        _make_dq_kernel(scale, causal, bq, bk, tk, *flags, window=window,
-                        band_fn=kband_fn, quantized=quantized,
-                        dropout=dropout, trap=bool(trap)),
-        dq_grid, dq_in_specs, dq_out_spec,
-        [pltpu.VMEM((bq, d), jnp.float32)],
-        jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
-        interpret, trap_pre if trap else [bandoff, runsum],
-    )(off, *seed_args, *args, *aux_args)
+    dq = dk = dv = None
+    if only in ('both', 'dq'):
+        dq_in_specs = [
+            off_spec,
+            *seed_specs,
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *rs: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), k_map),
+            pl.BlockSpec((1, bk, d_v), k_map),
+            pl.BlockSpec((1, bq, d_v), lambda b, i, j, *rs: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j, *rs: (b, i, 0)),
+        ] + quant_specs + aux_specs
+        dq_out_spec = pl.BlockSpec((1, bq, d),
+                                   lambda b, i, j, *rs: (b, i, 0))
+        if trap:
+            dq_grid = (nb, int(trap_pre[0].shape[0]))
+            dq_in_specs = _wrap_specs_pairs(dq_in_specs)
+            dq_out_spec = _wrap_specs_pairs([dq_out_spec])[0]
+        else:
+            dq_grid = (nb, nqb, kband if banded else nkb)
+        dq = _pallas_call(
+            _make_dq_kernel(scale, causal, bq, bk, tk, *flags,
+                            window=window, band_fn=kband_fn,
+                            quantized=quantized, dropout=dropout,
+                            trap=bool(trap)),
+            dq_grid, dq_in_specs, dq_out_spec,
+            [pltpu.VMEM((bq, d), jnp.float32)],
+            jax.ShapeDtypeStruct((nb, tq_p, d), grad_dtype or q.dtype),
+            interpret, trap_pre if trap else [bandoff, runsum],
+        )(off, *seed_args, *args, *aux_args)
+        dq = dq[:, :tq].reshape(q.shape)
 
     # --- dk/dv pass: grid (batch, K block, Q band), Q innermost ---
-    dkv_in_specs = [
-        off_spec,
-        *seed_specs,
-        pl.BlockSpec((1, bq, d), q_map_t),
-        pl.BlockSpec((1, bk, d), kv_map_t),
-        pl.BlockSpec((1, bk, d_v), kv_map_t),
-        pl.BlockSpec((1, bq, d_v), q_map_t),
-        pl.BlockSpec((1, bq, 1), q_map_t),
-        pl.BlockSpec((1, bq, 1), q_map_t),
-    ] + quant_specs_t + aux_specs_t
-    dkv_out_specs = [
-        pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
-        pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
-    ]
-    if trap:
-        dkv_grid = (nb, int(trap_pre_t[0].shape[0]))
-        dkv_in_specs = _wrap_specs_pairs(dkv_in_specs, transposed=True)
-        dkv_out_specs = _wrap_specs_pairs(dkv_out_specs, transposed=True)
-    else:
-        dkv_grid = (nb, nkb, qband if banded else nqb)
-    dk, dv = _pallas_call(
-        _make_dkv_kernel(scale, causal, bq, bk, tk, *flags, window=window,
-                         band_fn=qband_fn, quantized=quantized,
-                         dropout=dropout, trap=bool(trap), nqb=nqb),
-        dkv_grid, dkv_in_specs, dkv_out_specs,
-        [pltpu.VMEM((bk, d), jnp.float32),
-         pltpu.VMEM((bk, d_v), jnp.float32)],
-        [
-            jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
-            jax.ShapeDtypeStruct((nb, tk_p, d_v), grad_dtype or v.dtype),
-        ],
-        interpret, trap_pre_t if trap else [bandoff, runsum],
-    )(off, *seed_args, *args, *aux_args)
+    if only in ('both', 'dkv'):
+        dkv_in_specs = [
+            off_spec,
+            *seed_specs,
+            pl.BlockSpec((1, bq, d), q_map_t),
+            pl.BlockSpec((1, bk, d), kv_map_t),
+            pl.BlockSpec((1, bk, d_v), kv_map_t),
+            pl.BlockSpec((1, bq, d_v), q_map_t),
+            pl.BlockSpec((1, bq, 1), q_map_t),
+            pl.BlockSpec((1, bq, 1), q_map_t),
+        ] + quant_specs_t + aux_specs_t
+        dkv_out_specs = [
+            pl.BlockSpec((1, bk, d), lambda b, j, i, *rs: (b, j, 0)),
+            pl.BlockSpec((1, bk, d_v), lambda b, j, i, *rs: (b, j, 0)),
+        ]
+        if trap:
+            dkv_grid = (nb, int(trap_pre_t[0].shape[0]))
+            dkv_in_specs = _wrap_specs_pairs(dkv_in_specs, transposed=True)
+            dkv_out_specs = _wrap_specs_pairs(dkv_out_specs,
+                                              transposed=True)
+        else:
+            dkv_grid = (nb, nkb, qband if banded else nqb)
+        dk, dv = _pallas_call(
+            _make_dkv_kernel(scale, causal, bq, bk, tk, *flags,
+                             window=window, band_fn=qband_fn,
+                             quantized=quantized, dropout=dropout,
+                             trap=bool(trap), nqb=nqb),
+            dkv_grid, dkv_in_specs, dkv_out_specs,
+            [pltpu.VMEM((bk, d), jnp.float32),
+             pltpu.VMEM((bk, d_v), jnp.float32)],
+            [
+                jax.ShapeDtypeStruct((nb, tk_p, d), grad_dtype or k.dtype),
+                jax.ShapeDtypeStruct((nb, tk_p, d_v),
+                                     grad_dtype or v.dtype),
+            ],
+            interpret, trap_pre_t if trap else [bandoff, runsum],
+        )(off, *seed_args, *args, *aux_args)
 
-    dq = dq[:, :tq].reshape(q.shape)
-    dk = dk[:, :tk]
-    dv = dv[:, :tk]
-    if kv_group > 1:
-        # Group members are consecutive flat q-batch indices (head axis is
-        # the innermost lead dim): sum each group's partials in fp32.
-        dk = dk.reshape(nbk, kv_group, tk, d).astype(jnp.float32).sum(1)
-        dv = dv.reshape(nbk, kv_group, tk, d_v).astype(jnp.float32).sum(1)
-        dk = dk.astype(grad_dtype or k.dtype)
-        dv = dv.astype(grad_dtype or v.dtype)
-    dk = dk.reshape(k.shape)
-    dv = dv.reshape(v.shape)
+        dk = dk[:, :tk]
+        dv = dv[:, :tk]
+        if kv_group > 1:
+            # Group members are consecutive flat q-batch indices (head
+            # axis is the innermost lead dim): sum each group's partials
+            # in fp32.
+            dk = dk.reshape(nbk, kv_group, tk, d).astype(jnp.float32
+                                                         ).sum(1)
+            dv = dv.reshape(nbk, kv_group, tk, d_v).astype(jnp.float32
+                                                           ).sum(1)
+            dk = dk.astype(grad_dtype or k.dtype)
+            dv = dv.astype(grad_dtype or v.dtype)
+        dk = dk.reshape(k.shape)
+        dv = dv.reshape(v.shape)
     return dq, dk, dv
 
 

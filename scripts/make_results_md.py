@@ -216,6 +216,8 @@ backward, flash recomputes blockwise from the saved row logsumexp.
              'train_benchmark_flash_128k_win4k'),
             ('flash T=524288 (causal, window=4096)',
              'train_benchmark_flash_512k_win4k'),
+            ('flash T=524288 (causal, no mask)',
+             'train_benchmark_flash_512k_causal'),
             ('flash T=16384 (no mask, GQA kv_heads=2)',
              'train_benchmark_flash_gqa_kv2'),
             ('flash T=16384 (causal, RoPE)',
@@ -298,10 +300,16 @@ out-of-triangle half of the grid costs no DMA and no sequencing at all
 banded grid). T=131,072 causal went 68.8 → **81.8 TF/s/chip**
 (1.20 → 0.99 s/step) with bitwise-identical results; the GFLOP/s figure
 counts only the lower-triangle work. The pair tables are gated at 64K
-pairs (~0.5 MiB SMEM), so T≤~360K takes the trapezoid at block 1024 and
-longer sequences keep the full grid with in-kernel skipping; traced
-(multi-shard SPMD) offsets keep the full grid too — each shard's
-triangle differs, and a grid size cannot be data-dependent.
+pairs (~0.5 MiB SMEM); beyond the cap the rows CHUNK — the forward and
+dq pass split over Q rows, the dk/dv pass over K blocks (disjoint output
+slices, so nothing is partial-summed; an earlier Q-only chunking that
+summed fp32 dk/dv partials OOMed the 16 GiB chip at T=512K and was
+replaced) — and every chunk takes the trapezoid. T=524,288 causal:
+full-grid 18.83 s/step (67.7 TF/s) → chunked trapezoid **17.20 s/step
+(74.1 TF/s)**, both records in
+`train_benchmark_flash_512k_causal.json`. Traced (multi-shard SPMD)
+offsets keep the full grid — each shard's triangle differs, and a grid
+size cannot be data-dependent.
 
 A DMA-aliasing variant for those full-grid cases (clamp out-of-triangle
 K/V block indices to the row's last valid block via dynamic index maps,

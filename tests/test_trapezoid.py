@@ -130,3 +130,41 @@ def test_trap_with_kv_offset_static():
 
     np.testing.assert_array_equal(np.asarray(run(True)),
                                   np.asarray(run(False)))
+
+
+def test_chunked_trapezoid_matches_full_grid(monkeypatch):
+    """Beyond-cap sequences split into Q-row chunks that each take the
+    trapezoid (fwd: rows concat; bwd: dk/dv partials sum in fp32) — a
+    tiny forced cap must still be bitwise identical to the full grid,
+    with dropout and segments composed."""
+    monkeypatch.setattr(pa, '_TRAP_ON_INTERPRET', True)
+    ks = jax.random.split(jax.random.key(3), 4)
+    q, k, v, g = (jax.random.normal(kk, (B, H, 96, D)) for kk in ks)
+    seg = (jnp.arange(96) // 40, jnp.arange(96) // 40)
+
+    def run(cap, trap):
+        monkeypatch.setattr(pa, '_TRAP_MAX_PAIRS', cap)
+        monkeypatch.setattr(pa, '_TRAP_ON_INTERPRET', trap)
+        f = lambda q, k, v: pa.flash_attention(  # noqa: E731
+            q, k, v, causal=True, segment_ids=seg, dropout_rate=0.25,
+            dropout_seed=3)
+        out, vjp = jax.vjp(f, q, k, v)
+        return (out, *vjp(g))
+
+    a = run(8, True)            # forced chunking
+    b = run(10 ** 9, False)     # plain full grid
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_chunk_bounds_cover_rows_exactly():
+    import distributed_dot_product_tpu.ops.pallas_attention as m
+    orig = m._TRAP_MAX_PAIRS
+    try:
+        m._TRAP_MAX_PAIRS = 10
+        bounds = m._trap_chunk_bounds(0, 512, 512, 8, 8)
+        assert bounds[0][0] == 0 and bounds[-1][1] == 512
+        for (a0, a1), (b0, b1) in zip(bounds, bounds[1:]):
+            assert a1 == b0 and a0 < a1
+    finally:
+        m._TRAP_MAX_PAIRS = orig
