@@ -52,6 +52,9 @@ from distributed_dot_product_tpu.models.attention import (  # noqa: F401
 from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,
 )
+from distributed_dot_product_tpu.models.decode import (  # noqa: F401
+    DecodeCache, append_kv, decode_attention, init_cache,
+)
 from distributed_dot_product_tpu.models.ulysses_attention import (  # noqa: F401
     ulysses_attention,
 )
@@ -62,5 +65,5 @@ from distributed_dot_product_tpu.ops.rope import (  # noqa: F401
     rope, rope_seq_parallel,
 )
 from distributed_dot_product_tpu.utils.checkpoint import (  # noqa: F401
-    TrainState, latest_step, restore, save,
+    TrainState, latest_step, restore, save, wait,
 )

@@ -1,0 +1,170 @@
+# -*- coding: utf-8 -*-
+"""
+Incremental decoding (KV-cache) attention — the inference companion to
+the training stack.
+
+No reference analog (the reference is a training-side library; its module
+recomputes full (T/N, T) scores every call, reference module.py:60-69).
+Autoregressive inference wants the standard KV-cache pattern instead:
+keep the projected k/v of all past positions in a pair of device buffers,
+append one position per step, and attend a single query row against the
+prefix — O(T·d) work per token with no O(T²) anything.
+
+TPU-first choices:
+
+- The cache is a **static-shape** ``(B, H_kv, T_max, d)`` buffer pair plus
+  a scalar length; every step is the same compiled program
+  (``lax.dynamic_update_slice`` append + masked attention over the full
+  buffer) — no dynamic shapes, no retraces, XLA keeps it on-device.
+- A decode step is bandwidth-bound (one query row): it runs as a plain
+  masked ``einsum`` softmax — at Tq=1 a Pallas kernel buys nothing over
+  XLA's fused reduction, and the einsum path is backend-portable. The
+  in-kernel features that matter at decode time (GQA via grouped heads,
+  ALiBi, sliding window, RoPE positions) are applied directly.
+- GQA: the cache holds ``H_kv`` heads; the query's ``H`` heads attend
+  their group's cached head — cache memory is the whole point of GQA at
+  inference, so the grouped layout is native here too.
+
+Usage::
+
+    cache = init_cache(batch, kv_heads, t_max, head_dim)
+    for t in range(steps):
+        cache = append_kv(cache, k_t, v_t)        # (B, H_kv, 1, d) each
+        out = decode_attention(q_t, cache, ...)   # (B, H, 1, d_v)
+
+Prefill: ``append_kv`` accepts any chunk length, so the prompt can be
+appended in one call (with outputs computed by
+:func:`~distributed_dot_product_tpu.ops.pallas_attention.flash_attention`
+over the prompt — the training kernels ARE the prefill kernels).
+"""
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['DecodeCache', 'init_cache', 'append_kv', 'decode_attention']
+
+
+class DecodeCache(NamedTuple):
+    """Static-shape KV cache: ``k``/``v`` are ``(B, H_kv, T_max, d·)``
+    buffers, ``length`` the number of valid positions (traced scalar)."""
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @property
+    def t_max(self):
+        return self.k.shape[-2]
+
+
+def init_cache(batch, kv_heads, t_max, head_dim, v_head_dim=None,
+               dtype=jnp.bfloat16):
+    """Zero cache for ``t_max`` positions (the compile-time ceiling; pick
+    the serving context limit)."""
+    v_head_dim = v_head_dim or head_dim
+    return DecodeCache(
+        k=jnp.zeros((batch, kv_heads, t_max, head_dim), dtype),
+        v=jnp.zeros((batch, kv_heads, t_max, v_head_dim), dtype),
+        length=jnp.zeros((), jnp.int32))
+
+
+def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
+    """Append ``k_new``/``v_new`` ``(B, H_kv, n, d·)`` at the cache head.
+    ``n`` is static per call site (1 for decode, the prompt length for
+    prefill); the write is a ``dynamic_update_slice`` at the traced
+    length, so one compiled program serves every step.
+
+    The caller owns the ``t_max`` budget: appending past it raises when
+    the length is concrete (the usual serving loop, where the cache
+    crosses the host between jitted steps). Under ``jit`` the length is
+    traced and cannot be checked — an overflowing write would clamp to
+    the last slot (``dynamic_update_slice`` semantics), silently
+    corrupting the newest entries, so bound your generation loop by
+    ``t_max``."""
+    n = k_new.shape[-2]
+    if n > cache.t_max:
+        raise ValueError(f'appending {n} positions to a t_max='
+                         f'{cache.t_max} cache')
+    try:
+        length = int(cache.length)
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        length = None  # traced (inside jit): not checkable here
+    if length is not None and length + n > cache.t_max:
+        raise ValueError(
+            f'KV-cache overflow: length {length} + {n} new positions '
+            f'exceeds t_max {cache.t_max} — grow the cache or stop the '
+            f'generation loop')
+    idx = (jnp.zeros((), jnp.int32),) * 2 + (cache.length,
+                                             jnp.zeros((), jnp.int32))
+    return DecodeCache(
+        k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype),
+                                   idx),
+        v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype),
+                                   idx),
+        length=cache.length + n)
+
+
+def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
+                     alibi_slopes=None, segment_ids=None, seg_q=None):
+    """One masked-softmax attention step of ``q (B, H, n, d)`` against the
+    cache prefix; returns ``(B, H, n, d_v)``.
+
+    ``n`` is usually 1 (token-by-token) but any static ``n`` works (the
+    queries are assumed to be the LAST ``n`` appended positions, i.e.
+    call :func:`append_kv` with their k/v first — standard causal
+    decode ordering; rows see themselves and everything before).
+
+    ``window``: sliding-window lookback cap over absolute positions —
+    matches the training kernels' semantics, so a model trained with
+    ``window=N`` decodes identically. ``alibi_slopes (H,)``: the same
+    relative-distance bias as training. ``segment_ids``: optional
+    ``(B, T_max)`` cached-side ids with ``seg_q (B, n)`` for the query
+    rows (packed multi-turn serving); pairs in different segments don't
+    attend. Fully-masked rows return 0, matching the training kernels.
+    """
+    b, h, n, d = q.shape
+    h_kv = cache.k.shape[1]
+    if h % h_kv:
+        raise ValueError(f'query heads {h} must be a multiple of cache '
+                         f'kv heads {h_kv}')
+    group = h // h_kv
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    t_max = cache.t_max
+
+    qg = q.reshape(b, h_kv, group * n, d)
+    s = jnp.einsum('bhqd,bhtd->bhqt', qg.astype(jnp.float32) * scale,
+                   cache.k.astype(jnp.float32))
+    s = s.reshape(b, h_kv, group, n, t_max)
+
+    # Query row i (0-based within the n new rows) sits at absolute
+    # position length - n + i; it attends positions <= its own.
+    pos_q = cache.length - n + jnp.arange(n)                # (n,)
+    pos_k = jnp.arange(t_max)                               # (t_max,)
+    allowed = pos_k[None, :] <= pos_q[:, None]              # (n, t_max)
+    if window is not None:
+        allowed = jnp.logical_and(
+            allowed, pos_q[:, None] - pos_k[None, :] < window)
+    if segment_ids is not None:
+        if seg_q is None:
+            raise ValueError('segment_ids needs seg_q (the query rows\' '
+                             'ids)')
+        same = (segment_ids[:, None, :] == seg_q[..., None])  # (B, n, Tm)
+        allowed = jnp.logical_and(allowed[None], same)[:, None, None]
+    else:
+        allowed = allowed[None, None, None]                 # bcast B,hkv,g
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            h_kv, group, 1, 1)
+        s = s + slopes * (pos_k[None, :] - pos_q[:, None]).astype(
+            jnp.float32)
+    s = jnp.where(allowed, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.maximum(m, jnp.float32(-1e30))             # empty rows
+    p = jnp.exp(s - m_safe)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(denom == 0.0, 1.0, denom)
+    out = jnp.einsum('bhgqt,bhtd->bhgqd', p.astype(cache.v.dtype), cache.v)
+    return out.reshape(b, h, n, cache.v.shape[-1])

@@ -1,0 +1,134 @@
+# -*- coding: utf-8 -*-
+"""
+KV-cache decode path (models/decode.py): token-by-token decoding must
+reproduce the training kernels' causal attention over the same sequence
+— prefill + N decode steps == one flash_attention(causal=True) call, for
+every knob the decode path carries (GQA, window, ALiBi, segments).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.models.decode import (
+    append_kv, decode_attention, init_cache,
+)
+from distributed_dot_product_tpu.ops.pallas_attention import flash_attention
+
+B, H, T, D = 2, 4, 48, 16
+PREFILL = 32
+
+
+def _seq(hkv=H, key=0):
+    ks = jax.random.split(jax.random.key(key), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, hkv, T, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, hkv, T, D), jnp.float32)
+    return q, k, v
+
+
+def _decode_all(q, k, v, t_max=T, **kw):
+    """Prefill the first PREFILL positions, then decode the rest one
+    token at a time; returns the decode-phase outputs."""
+    hkv = k.shape[1]
+    cache = init_cache(B, hkv, t_max, D, dtype=jnp.float32)
+    cache = append_kv(cache, k[:, :, :PREFILL], v[:, :, :PREFILL])
+    step = jax.jit(lambda q1, k1, v1, c: (
+        lambda c2: (c2, decode_attention(q1, c2, **kw)))(
+            append_kv(c, k1, v1)))
+    outs = []
+    for t in range(PREFILL, T):
+        cache, o = step(q[:, :, t:t + 1], k[:, :, t:t + 1],
+                        v[:, :, t:t + 1], cache)
+        outs.append(o)
+    assert int(cache.length) == T
+    return jnp.concatenate(outs, axis=2)
+
+
+@pytest.mark.parametrize('hkv', [H, 2, 1])
+def test_decode_matches_training_kernel(hkv):
+    q, k, v = _seq(hkv)
+    got = _decode_all(q, k, v)
+    want = flash_attention(q, k, v, causal=True)[:, :, PREFILL:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_decode_window():
+    q, k, v = _seq(key=1)
+    got = _decode_all(q, k, v, window=8)
+    want = flash_attention(q, k, v, causal=True, window=8)[:, :, PREFILL:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_decode_alibi():
+    slopes = jnp.asarray([2.0 ** -(i + 1) for i in range(H)])
+    q, k, v = _seq(key=2)
+    got = _decode_all(q, k, v, alibi_slopes=slopes)
+    want = flash_attention(q, k, v, causal=True,
+                           alibi_slopes=slopes)[:, :, PREFILL:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_decode_segments():
+    """Packed multi-turn serving: cached-side ids + query-row ids."""
+    q, k, v = _seq(key=3)
+    seg_full = jnp.broadcast_to((jnp.arange(T) // 20)[None], (B, T)
+                                ).astype(jnp.int32)
+    hkv = k.shape[1]
+    cache = init_cache(B, hkv, T, D, dtype=jnp.float32)
+    cache = append_kv(cache, k[:, :, :PREFILL], v[:, :, :PREFILL])
+    outs = []
+    for t in range(PREFILL, T):
+        cache = append_kv(cache, k[:, :, t:t + 1], v[:, :, t:t + 1])
+        # segment ids for positions not yet appended are irrelevant: the
+        # causal mask already excludes them — pass the full array.
+        outs.append(decode_attention(
+            q[:, :, t:t + 1], cache, segment_ids=seg_full,
+            seg_q=seg_full[:, t:t + 1]))
+    got = jnp.concatenate(outs, axis=2)
+    want = flash_attention(
+        q, k, v, causal=True,
+        segment_ids=(seg_full[:, None], seg_full[:, None]))[:, :, PREFILL:]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_decode_multi_row_and_t_max_headroom():
+    """n>1 query rows per step, and a cache larger than the sequence
+    (the serving configuration: t_max = context limit)."""
+    q, k, v = _seq(key=4)
+    cache = init_cache(B, H, T + 64, D, dtype=jnp.float32)
+    cache = append_kv(cache, k[:, :, :PREFILL], v[:, :, :PREFILL])
+    cache = append_kv(cache, k[:, :, PREFILL:], v[:, :, PREFILL:])
+    out = decode_attention(q[:, :, PREFILL:], cache)
+    want = flash_attention(q, k, v, causal=True)[:, :, PREFILL:]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_decode_validation():
+    cache = init_cache(B, 3, T, D)
+    with pytest.raises(ValueError, match='multiple'):
+        decode_attention(jnp.zeros((B, H, 1, D)), cache)
+    with pytest.raises(ValueError, match='t_max'):
+        append_kv(cache, jnp.zeros((B, 3, T + 1, D)),
+                  jnp.zeros((B, 3, T + 1, D)))
+    with pytest.raises(ValueError, match='seg_q'):
+        decode_attention(jnp.zeros((B, 3, 1, D)), cache,
+                         segment_ids=jnp.zeros((B, T), jnp.int32))
+
+
+def test_append_overflow_raises_eagerly():
+    """Cumulative overflow past t_max must raise when the length is
+    concrete (the serving-loop case) instead of silently clamping the
+    write onto the newest slot (the round-4 review repro)."""
+    cache = init_cache(B, H, 4, D, dtype=jnp.float32)
+    one = jnp.ones((B, H, 1, D))
+    for _ in range(4):
+        cache = append_kv(cache, one, one)
+    with pytest.raises(ValueError, match='overflow'):
+        append_kv(cache, one, one)
