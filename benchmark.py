@@ -218,9 +218,9 @@ def run_attn(args):
                                                 'online', 'ulysses'):
         raise SystemExit('--kv-heads (GQA) needs a fused attn impl '
                          '(flash/flash_bounded/online/ulysses)')
-    if args.qk_quant and args.attn_impl != 'flash':
-        raise SystemExit('--qk-quant applies to --attn-impl flash only '
-                         '(the record must name the path actually '
+    if args.qk_quant and args.attn_impl not in ('flash', 'ulysses'):
+        raise SystemExit('--qk-quant applies to --attn-impl flash or '
+                         'ulysses (the record must name the path actually '
                          'measured; flash_bounded would silently coerce '
                          'to the exact kernel when quantized)')
     spec = P(None, None, SEQ_AXIS, None)
@@ -237,7 +237,8 @@ def run_attn(args):
         from distributed_dot_product_tpu.models.ulysses_attention import (
             ulysses_attention,
         )
-        body = lambda q, k, v: ulysses_attention(q, k, v)  # noqa: E731
+        body = lambda q, k, v: ulysses_attention(  # noqa: E731
+            q, k, v, qk_quant=args.qk_quant)
     elif args.attn_impl in ('flash', 'flash_bounded'):
         smode = 'bounded' if args.attn_impl == 'flash_bounded' else 'exact'
 

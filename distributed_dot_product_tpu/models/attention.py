@@ -442,7 +442,7 @@ class DistributedDotProductAttn(nn.Module):
                 causal=native_causal,
                 softmax_mode=self.flash_softmax_mode,
                 segment_ids=seg_local, window=self.window,
-                alibi_slopes=self.alibi_slopes,
+                alibi_slopes=self.alibi_slopes, qk_quant=self.qk_quant,
                 dropout_rate=drop_rate, dropout_seed=drop_seed)
             outputs = jnp.swapaxes(outputs, -3, -2)
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)

@@ -73,7 +73,7 @@ FEATURE_MATRIX = {
         'full': False,
         'online': False,
         'flash': 'int8 MXU scoring',
-        'ulysses': False,
+        'ulysses': 'int8 MXU scoring (local flash kernel)',
     },
     'use_rope': {
         'full': 'shard-global rotation',

@@ -48,7 +48,7 @@ __all__ = ['ulysses_attention']
 def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
                       causal=False, scale=None, softmax_mode='exact',
                       segment_ids=None, window=None, alibi_slopes=None,
-                      dropout_rate=0.0, dropout_seed=None):
+                      qk_quant=None, dropout_rate=0.0, dropout_seed=None):
     """Sequence-parallel attention via head↔time all-to-all re-sharding.
 
     ``q, k, v``: local shards ``(..., H, T/N, d)`` (``v`` may differ in its
@@ -158,9 +158,13 @@ def ulysses_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS,
         # its actionable error instead of an opaque asarray failure.)
         seed_local = (jnp.asarray(dropout_seed, jnp.int32)
                       + lax.axis_index(axis_name) * jnp.int32(40503))
+    # qk_quant threads straight through: after the head scatter the flash
+    # kernel runs locally over the full sequence, so the per-row int8
+    # quantization is computed on exactly the rows a single-device kernel
+    # would see.
     out = flash_attention(qh, kh, vh, full_mask, causal=causal, scale=scale,
                           softmax_mode=softmax_mode, segment_ids=seg_pair,
                           window=window, alibi_slopes=slopes_local,
-                          dropout_rate=dropout_rate,
+                          qk_quant=qk_quant, dropout_rate=dropout_rate,
                           dropout_seed=seed_local)
     return gather_heads(out)

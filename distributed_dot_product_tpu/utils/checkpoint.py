@@ -42,7 +42,7 @@ import jax
 
 from distributed_dot_product_tpu.utils.comm import synchronize
 
-__all__ = ['TrainState', 'save', 'restore', 'latest_step']
+__all__ = ['TrainState', 'save', 'restore', 'latest_step', 'wait']
 
 
 class TrainState(NamedTuple):
@@ -103,7 +103,33 @@ def _is_finalized(path):
         return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
 
 
-def save(path, state: TrainState, *, force: bool = True) -> str:
+# Backups whose removal is deferred until their (async) save finalizes,
+# and whether ANY async save is outstanding (a non-overwrite async save
+# leaves no backup but must still be waited on before the next save's
+# filesystem inspection — its target directory may not exist yet).
+_PENDING_BACKUPS = []
+_ASYNC_PENDING = False
+
+
+def wait():
+    """Block until every outstanding ``save(..., blocking=False)`` has
+    finalized, then remove the overwrite backups it deferred. Collective
+    on multi-host (same contract as ``save``). A no-op when nothing is
+    pending."""
+    global _ASYNC_PENDING
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
+    synchronize()
+    if jax.process_index() == 0:
+        for backup in _PENDING_BACKUPS:
+            if backup.is_dir():
+                backup.rmtree()
+    _PENDING_BACKUPS.clear()
+    _ASYNC_PENDING = False
+
+
+def save(path, state: TrainState, *, force: bool = True,
+         blocking: bool = True) -> str:
     """Write ``state`` under ``path/step_<step>/``; returns that directory.
 
     ``path``: POSIX directory or object-store URL (``gs://...``) — see
@@ -113,11 +139,21 @@ def save(path, state: TrainState, *, force: bool = True) -> str:
     new write finalizes, so a crash mid-overwrite never destroys the only
     copy of a step.
 
+    ``blocking=False`` returns as soon as the device arrays are snapshot
+    and lets orbax flush to storage in the background — the training loop
+    keeps stepping while the previous checkpoint lands (call
+    :func:`wait` before exiting, and note ``latest_step`` simply skips a
+    still-unfinalized save). A new ``save`` first waits for any pending
+    one, so overlapping saves serialize instead of colliding.
+
     Collective on multi-host: every process must call this with its view
     of the same global arrays (directory juggling runs on process 0 only;
     process 0's filesystem view decides the overwrite branch for
     everyone).
     """
+    global _ASYNC_PENDING
+    if _ASYNC_PENDING:
+        wait()
     target = _step_dir(path, int(state.step))
     backup = target.parent / (target.name + '.replaced')
     exists = target.is_dir()
@@ -139,6 +175,11 @@ def save(path, state: TrainState, *, force: bool = True) -> str:
     synchronize()
     ckptr = _checkpointer()
     ckptr.save(target, state)
+    if not blocking:
+        _ASYNC_PENDING = True
+        if exists:
+            _PENDING_BACKUPS.append(backup)
+        return os.fspath(target)
     ckptr.wait_until_finished()
     synchronize()
     if exists and jax.process_index() == 0 and backup.is_dir():

@@ -150,3 +150,49 @@ def test_object_store_urls_accepted():
     assert str(ck._root('gs://bucket/run1')) == 'gs://bucket/run1'
     # Local relative paths still absolutize (orbax requires absolute).
     assert str(ck._root('relative/dir')).startswith('/')
+
+
+def test_async_save_overlaps_training(tmp_path):
+    """blocking=False returns before the write finalizes (training keeps
+    stepping); `wait()` finalizes; `latest_step` never selects an
+    in-flight save. Overlapping saves serialize safely."""
+    from distributed_dot_product_tpu.utils.checkpoint import wait
+
+    step, params, opt_state, batch = _setup()
+    ck = str(tmp_path / 'async')
+    p, o = params, opt_state
+    for i in range(1, 4):
+        p, o, loss = step(p, o, batch)
+        save(ck, TrainState(i, p, o), blocking=False)
+        # the loop continues immediately; a subsequent save waits for the
+        # previous flush internally, so this sequence is the real pattern
+    wait()
+    assert latest_step(ck) == 3
+    got = restore(ck, TrainState(0, params, opt_state))
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # async overwrite of an existing step: backup dance still crash-safe
+    save(ck, TrainState(3, p, o), blocking=False)
+    wait()
+    import os
+    names = set(os.listdir(ck))
+    assert 'step_000000003' in names
+    assert not any(n.endswith('.replaced') for n in names)
+
+
+def test_async_resave_same_step_without_overwrite(tmp_path):
+    """A second async save right after a non-overwrite async one must
+    wait for the first flush (no stale filesystem view): same-step
+    re-save goes through the backup dance instead of orbax's
+    'destination already exists' error (the round-4 review repro)."""
+    step, params, opt_state, batch = _setup()
+    ck = str(tmp_path / 'resave')
+    p, o, _ = step(params, opt_state, batch)
+    save(ck, TrainState(1, p, o), blocking=False)
+    p2, o2, _ = step(p, o, batch)
+    save(ck, TrainState(1, p2, o2), blocking=False)  # must not raise
+    from distributed_dot_product_tpu.utils.checkpoint import wait
+    wait()
+    got = restore(ck, TrainState(0, params, opt_state))
+    for a, b in zip(jax.tree.leaves(got.params), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
