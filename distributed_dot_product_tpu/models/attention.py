@@ -525,6 +525,76 @@ class DistributedDotProductAttn(nn.Module):
             outputs = outputs.reshape(*outputs.shape[:-2], self._value_dim)
         return self.composition(outputs)
 
+    def make_decode_cache(self, batch, t_max, dtype=None):
+        """A KV cache sized for this module's projections (GQA-aware:
+        ``num_kv_heads`` heads of queries/values — the softmax-table side
+        under the K-first convention). Plain Python (reads constructor
+        fields only), so no ``apply`` is needed."""
+        from distributed_dot_product_tpu.models.decode import init_cache
+        kv_heads = (self.num_kv_heads if self.num_kv_heads is not None
+                    else self.num_heads)
+        value_dim = (self.value_dim if self.value_dim is not None
+                     else self.key_dim)
+        return init_cache(
+            batch, kv_heads, t_max, self.key_dim // self.num_heads,
+            v_head_dim=value_dim // self.num_heads,
+            dtype=dtype or self.dtype or jnp.float32)
+
+    def decode(self, keys, queries, values, cache, segment_ids=None,
+               seg_cache=None):
+        """Incremental (KV-cache) inference step — the module-level
+        surface over :mod:`distributed_dot_product_tpu.models.decode`.
+
+        ``keys/queries/values (B, n, d·)`` are the NEW positions (n=1
+        token-by-token; the prompt for prefill). Projections, GQA head
+        grouping, RoPE (rotated at the true global positions
+        ``cache.length + arange(n)``), sliding window and ALiBi all
+        follow this module's training-time configuration, so a model
+        trained through ``__call__(causal=True)`` decodes identically:
+        under the K-first convention output row t is key_t attending
+        queries/values at positions ≤ t — exactly the causal forward's
+        row t. ``qk_quant='int8'`` carries over too (the decode path
+        reproduces the kernels' per-row quantization), as do packed
+        segments: pass this step's ``segment_ids (B, n)`` with the
+        cached positions' ``seg_cache (B, t_max)``. Requires
+        ``causal=True`` (autoregressive semantics); dropout is
+        inference-off; runs locally (replicate or batch-shard for
+        serving — sequence parallelism is a training concern). Use
+        ``apply(params, k, q, v, cache, method='decode')``; returns
+        ``(cache, out (B, n, value_dim))``.
+        """
+        from distributed_dot_product_tpu.models.decode import (
+            append_kv, decode_attention,
+        )
+        if not self.causal:
+            raise ValueError('decode() is autoregressive and requires '
+                             'causal=True')
+        keys = self.keys_proj(keys)
+        queries = self.queries_proj(queries)
+        values = self.values_proj(values)
+        n = keys.shape[-2]
+
+        def split(x, heads, dh):
+            x = x.reshape(*x.shape[:-1], heads, dh)
+            return jnp.swapaxes(x, -2, -3)
+        keys = split(keys, self.num_heads, self.head_dim)
+        queries = split(queries, self._kv_heads, self.head_dim)
+        values = split(values, self._kv_heads,
+                       self._value_dim // self.num_heads)
+        if self.use_rope:
+            pos = cache.length + jnp.arange(n)
+            keys = rope(keys, pos, base=self.rope_base)
+            queries = rope(queries, pos, base=self.rope_base)
+        cache = append_kv(cache, queries, values)
+        out = decode_attention(
+            keys, cache, scale=1.0 / math.sqrt(self.head_dim),
+            window=self.window, alibi_slopes=self.alibi_slopes,
+            qk_quant=self.qk_quant, segment_ids=seg_cache,
+            seg_q=segment_ids)
+        out = jnp.swapaxes(out, -3, -2)
+        out = out.reshape(*out.shape[:-2], self._value_dim)
+        return cache, self.composition(out)
+
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,
                        attn_mask=None, mesh_axis=None, segment_ids=None,

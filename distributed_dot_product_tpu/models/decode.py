@@ -108,7 +108,8 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
 
 
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
-                     alibi_slopes=None, segment_ids=None, seg_q=None):
+                     alibi_slopes=None, segment_ids=None, seg_q=None,
+                     qk_quant=None):
     """One masked-softmax attention step of ``q (B, H, n, d)`` against the
     cache prefix; returns ``(B, H, n, d_v)``.
 
@@ -123,7 +124,9 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     relative-distance bias as training. ``segment_ids``: optional
     ``(B, T_max)`` cached-side ids with ``seg_q (B, n)`` for the query
     rows (packed multi-turn serving); pairs in different segments don't
-    attend. Fully-masked rows return 0, matching the training kernels.
+    attend. ``qk_quant='int8'`` reproduces the training kernels'
+    quantized scoring exactly (see the inline comment). Fully-masked
+    rows return 0, matching the training kernels.
     """
     b, h, n, d = q.shape
     h_kv = cache.k.shape[1]
@@ -135,8 +138,27 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     t_max = cache.t_max
 
     qg = q.reshape(b, h_kv, group * n, d)
-    s = jnp.einsum('bhqd,bhtd->bhqt', qg.astype(jnp.float32) * scale,
-                   cache.k.astype(jnp.float32))
+    if qk_quant == 'int8':
+        # Reproduce the training kernels' quantized scoring: both sides
+        # per-row symmetrically quantized with the SAME rule as the
+        # fused kernel, so a model trained with int8 QK^T decodes to its
+        # training-time logits. The products stay exact in fp32
+        # (|int8·int8·d| ≪ 2²⁴) — no int path needed; decode is
+        # bandwidth-bound anyway.
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        qi, sq = _quantize_rows(qg, b * h_kv, group * n, d)
+        ki, sk = _quantize_rows(cache.k, b * h_kv, t_max, d)
+        q_eff = (qi.astype(jnp.float32) * sq).reshape(qg.shape)
+        k_eff = (ki.astype(jnp.float32) * sk).reshape(cache.k.shape)
+    elif qk_quant is not None:
+        raise ValueError(f"qk_quant must be None or 'int8', "
+                         f'got {qk_quant!r}')
+    else:
+        q_eff, k_eff = qg, cache.k
+    s = jnp.einsum('bhqd,bhtd->bhqt', q_eff.astype(jnp.float32) * scale,
+                   k_eff.astype(jnp.float32))
     s = s.reshape(b, h_kv, group, n, t_max)
 
     # Query row i (0-based within the n new rows) sits at absolute

@@ -43,6 +43,9 @@ def main():
                     help='attention-weight dropout rate (in-kernel mask; '
                          'seeded by the step counter)')
     ap.add_argument('--steps', type=int, default=4)
+    ap.add_argument('--generate', type=int, default=8,
+                    help='after training, decode this many tokens with '
+                         'the KV cache (0 to skip)')
     ap.add_argument('--ckpt-dir', default=None,
                     help='checkpoint directory (default: a temp dir)')
     args = ap.parse_args()
@@ -104,6 +107,26 @@ def main():
     final = ddp.save(ckpt_dir, ddp.TrainState(start + args.steps, params,
                                               opt_state))
     print(f'checkpointed -> {final}')
+
+    if args.generate:
+        # Inference with the SAME weights and configuration: prefill a
+        # prompt through the module's KV-cache decode surface, then
+        # decode autoregressively (each step feeds the previous output
+        # back in — the attention-only analog of LM generation).
+        local = model.bind(params)
+        prompt = 64
+        cache = model.make_decode_cache(1, prompt + args.generate)
+        xp = jax.device_get(x)[:, :prompt]
+        cache, out = local.decode(xp, xp, xp, cache)
+        tok = out[:, -1:]
+        tic = time.perf_counter()
+        for _ in range(args.generate):
+            cache, out = local.decode(tok, tok, tok, cache)
+            tok = out[:, -1:]
+        dt = (time.perf_counter() - tic) * 1000 / args.generate
+        print(f'decoded {args.generate} tokens with the KV cache '
+              f'({dt:.2f} ms/token; cache length '
+              f'{int(cache.length)}/{cache.t_max})')
 
 
 if __name__ == '__main__':

@@ -132,3 +132,85 @@ def test_append_overflow_raises_eagerly():
         cache = append_kv(cache, one, one)
     with pytest.raises(ValueError, match='overflow'):
         append_kv(cache, one, one)
+
+
+@pytest.mark.parametrize('kwargs', [
+    dict(),
+    dict(num_kv_heads=2),
+    dict(use_rope=True),
+    dict(num_kv_heads=2, use_rope=True, window=12),
+    dict(alibi_slopes=tuple(2.0 ** -(i + 1) for i in range(4))),
+    dict(qk_quant='int8'),
+])
+def test_module_decode_matches_causal_forward(kwargs):
+    """The flagship-module decode surface: prefill + token-by-token
+    module.decode must reproduce the module's causal __call__ over the
+    same inputs, for every knob combination the decode path carries."""
+    from distributed_dot_product_tpu.models.attention import (
+        DistributedDotProductAttn,
+    )
+    DIM = 32
+    m = DistributedDotProductAttn(key_dim=DIM, num_heads=4, causal=True,
+                                  softmax_impl='flash', distributed=False,
+                                  **kwargs)
+    x = jax.random.normal(jax.random.key(0), (B, T, DIM))
+    params = m.init(jax.random.key(1), x[:, :8], x[:, :8], x[:, :8], None)
+    want = m.apply(params, x, x, x, None)
+
+    cache = m.make_decode_cache(B, T)
+    # Prefill the first PREFILL positions in one call, then decode.
+    cache, out0 = m.apply(params, x[:, :PREFILL], x[:, :PREFILL],
+                          x[:, :PREFILL], cache, method='decode')
+    outs = [out0]
+    step = jax.jit(lambda p, xt, c: m.apply(p, xt, xt, xt, c,
+                                            method='decode'))
+    for t in range(PREFILL, T):
+        cache, o = step(params, x[:, t:t + 1], cache)
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5)
+
+
+def test_module_decode_requires_causal():
+    from distributed_dot_product_tpu.models.attention import (
+        DistributedDotProductAttn,
+    )
+    m = DistributedDotProductAttn(key_dim=32, num_heads=4,
+                                  distributed=False)
+    x = jnp.zeros((B, 4, 32))
+    params = m.init(jax.random.key(0), x, x, x, None)
+    cache = m.make_decode_cache(B, 16)
+    with pytest.raises(ValueError, match='causal'):
+        m.apply(params, x, x, x, cache, method='decode')
+
+
+def test_module_decode_segments():
+    """Packed multi-turn serving through the module surface: per-step
+    segment_ids + the cached positions' ids must match the causal
+    forward with the same packing."""
+    from distributed_dot_product_tpu.models.attention import (
+        DistributedDotProductAttn,
+    )
+    DIM = 32
+    m = DistributedDotProductAttn(key_dim=DIM, num_heads=4, causal=True,
+                                  softmax_impl='flash', distributed=False)
+    x = jax.random.normal(jax.random.key(5), (B, T, DIM))
+    seg = jnp.broadcast_to((jnp.arange(T) // 20)[None], (B, T)
+                           ).astype(jnp.int32)
+    params = m.init(jax.random.key(1), x[:, :8], x[:, :8], x[:, :8], None)
+    want = m.apply(params, x, x, x, None, segment_ids=seg)
+
+    cache = m.make_decode_cache(B, T)
+    cache, out0 = m.apply(params, x[:, :PREFILL], x[:, :PREFILL],
+                          x[:, :PREFILL], cache, method='decode',
+                          segment_ids=seg[:, :PREFILL], seg_cache=seg)
+    outs = [out0]
+    for t in range(PREFILL, T):
+        cache, o = m.apply(params, x[:, t:t + 1], x[:, t:t + 1],
+                           x[:, t:t + 1], cache, method='decode',
+                           segment_ids=seg[:, t:t + 1], seg_cache=seg)
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5)
