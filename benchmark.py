@@ -45,7 +45,7 @@ def parse_args():
     # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
-                                           'train'],
+                                           'train', 'decode'],
                         default='nt')
     parser.add_argument('--seq-len', type=int, default=None,
                         help='global sequence length (train mode default '
@@ -489,11 +489,72 @@ def _append_record(path, record):
     return record
 
 
+def run_decode(args):
+    """``--mode decode``: steady-state KV-cache decode latency through
+    the module surface (one token per step against a part-filled cache).
+    No reference analog (the reference has no inference path); the
+    honest metric is ms/token at a given cache fill — decode is
+    HBM-bandwidth-bound (the step streams the K/V cache once), so the
+    record also derives achieved GB/s over the cache bytes."""
+    from distributed_dot_product_tpu import DistributedDotProductAttn
+
+    t_max = args.seq_len or 16384
+    h, d = args.heads, args.head_dim
+    h_kv = args.kv_heads or h
+    dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
+    model = DistributedDotProductAttn(
+        key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
+        causal=True, use_rope=args.use_rope, softmax_impl='flash',
+        dtype=dtype)
+    b = 1
+    x0 = jnp.zeros((b, 16, h * d), dtype)
+    params = model.init(jax.random.key(0), x0, x0, x0, None)
+    fill = t_max - 64  # leave headroom for the timed decode steps
+    cache = model.make_decode_cache(b, t_max, dtype=dtype)
+    # Fill the cache directly with random projected operands: the timed
+    # quantity is the per-token step against a full cache, and its cost
+    # doesn't depend on the cached values (module.prefill would work too
+    # but compiles a full flash pass this measurement doesn't need).
+    from distributed_dot_product_tpu.models.decode import append_kv
+    kf = jax.random.normal(jax.random.key(1), (b, h_kv, fill, d), dtype)
+    vf = jax.random.normal(jax.random.key(4), (b, h_kv, fill, d), dtype)
+    cache = append_kv(cache, kf, vf)
+
+    tok = jax.random.normal(jax.random.key(2), (b, 1, h * d), dtype)
+    step = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
+                                                method='decode'))
+
+    def many(p, xt, c):
+        # The timed unit: one decode step (cache append + masked
+        # attention over the full buffer + 4 projections).
+        c2, out = step(p, xt, c)
+        return out
+    best, mean = time_fn(many, params, tok, cache, iters=args.iters)
+    cache_bytes = 2 * b * h_kv * t_max * d * jnp.dtype(dtype).itemsize
+    record = {
+        'mode': 'decode', 't_max': t_max, 'fill': fill, 'heads': h,
+        'kv_heads': h_kv, 'head_dim': d, 'dtype': args.dtype,
+        'use_rope': args.use_rope, 'world': 1,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'ms_per_token': best * 1e3, 'ms_per_token_mean': mean * 1e3,
+        'cache_gb_per_s': cache_bytes / best / 1e9,
+    }
+    gq = '' if h_kv == h else f'/kv{h_kv}'
+    print(f"decode t_max={t_max} fill={fill} H={h}{gq} d={d}: "
+          f"{record['ms_per_token']:.3f} ms/token "
+          f"({record['cache_gb_per_s']:.0f} GB/s over the cache)")
+    _append_record(args.file, record)
+    return record
+
+
 def run(args):
     if args.mode == 'attn':
         return run_attn(args)
     if args.mode == 'train':
         return run_train(args)
+    if args.mode == 'decode':
+        return run_decode(args)
     mesh = seq_mesh(args.devices)
     world = mesh.devices.size
     t = FULL_T // args.scale

@@ -538,7 +538,62 @@ class DistributedDotProductAttn(nn.Module):
         return init_cache(
             batch, kv_heads, t_max, self.key_dim // self.num_heads,
             v_head_dim=value_dim // self.num_heads,
-            dtype=dtype or self.dtype or jnp.float32)
+            dtype=dtype or self.dtype or jnp.float32,
+            qk_quant=self.qk_quant)
+
+    def _project_for_decode(self, keys, queries, values, cache):
+        """Shared front half of :meth:`prefill`/:meth:`decode`: the four
+        projections, GQA head split, and RoPE at the true global
+        positions ``cache.length + arange(n)`` — ONE definition so the
+        two inference entry points cannot drift."""
+        if not self.causal:
+            raise ValueError('cached decoding is autoregressive and '
+                             'requires causal=True')
+        keys = self.keys_proj(keys)
+        queries = self.queries_proj(queries)
+        values = self.values_proj(values)
+        n = keys.shape[-2]
+
+        def split(x, heads, dh):
+            x = x.reshape(*x.shape[:-1], heads, dh)
+            return jnp.swapaxes(x, -2, -3)
+        keys = split(keys, self.num_heads, self.head_dim)
+        queries = split(queries, self._kv_heads, self.head_dim)
+        values = split(values, self._kv_heads,
+                       self._value_dim // self.num_heads)
+        if self.use_rope:
+            pos = cache.length + jnp.arange(n)
+            keys = rope(keys, pos, base=self.rope_base)
+            queries = rope(queries, pos, base=self.rope_base)
+        return keys, queries, values
+
+    def _merge_decode_heads(self, out):
+        out = jnp.swapaxes(out, -3, -2)
+        out = out.reshape(*out.shape[:-2], self._value_dim)
+        return self.composition(out)
+
+    def prefill(self, keys, queries, values, cache):
+        """Prompt ingestion for :meth:`decode`: project the ``n`` new
+        positions, append the projected queries/values to the cache, and
+        compute their outputs with the FLASH kernel over the whole cache
+        buffer — the causal mask (rows at global positions
+        ``cache.length + i`` vs buffer columns ``0..t_max``) excludes
+        both the future prompt rows and the not-yet-filled tail, so the
+        result equals the causal forward over the filled prefix with
+        O(block²) score memory (``decode`` would materialize an
+        ``(n, t_max)`` score buffer — fine for a few rows, not a
+        131K-token prompt). Same knob coverage as ``decode``
+        (GQA/RoPE/window/ALiBi/int8). Returns ``(cache, out)``."""
+        from distributed_dot_product_tpu.models.decode import append_kv
+        keys, queries, values = self._project_for_decode(
+            keys, queries, values, cache)
+        start = cache.length
+        cache = append_kv(cache, queries, values)
+        out = flash_attention(
+            keys, cache.k, cache.v, causal=True, causal_offset=start,
+            scale=1.0 / math.sqrt(self.head_dim), window=self.window,
+            alibi_slopes=self.alibi_slopes, qk_quant=self.qk_quant)
+        return cache, self._merge_decode_heads(out)
 
     def decode(self, keys, queries, values, cache, segment_ids=None,
                seg_cache=None):
@@ -566,34 +621,15 @@ class DistributedDotProductAttn(nn.Module):
         from distributed_dot_product_tpu.models.decode import (
             append_kv, decode_attention,
         )
-        if not self.causal:
-            raise ValueError('decode() is autoregressive and requires '
-                             'causal=True')
-        keys = self.keys_proj(keys)
-        queries = self.queries_proj(queries)
-        values = self.values_proj(values)
-        n = keys.shape[-2]
-
-        def split(x, heads, dh):
-            x = x.reshape(*x.shape[:-1], heads, dh)
-            return jnp.swapaxes(x, -2, -3)
-        keys = split(keys, self.num_heads, self.head_dim)
-        queries = split(queries, self._kv_heads, self.head_dim)
-        values = split(values, self._kv_heads,
-                       self._value_dim // self.num_heads)
-        if self.use_rope:
-            pos = cache.length + jnp.arange(n)
-            keys = rope(keys, pos, base=self.rope_base)
-            queries = rope(queries, pos, base=self.rope_base)
+        keys, queries, values = self._project_for_decode(
+            keys, queries, values, cache)
         cache = append_kv(cache, queries, values)
         out = decode_attention(
             keys, cache, scale=1.0 / math.sqrt(self.head_dim),
             window=self.window, alibi_slopes=self.alibi_slopes,
             qk_quant=self.qk_quant, segment_ids=seg_cache,
             seg_q=segment_ids)
-        out = jnp.swapaxes(out, -3, -2)
-        out = out.reshape(*out.shape[:-2], self._value_dim)
-        return cache, self.composition(out)
+        return cache, self._merge_decode_heads(out)
 
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,

@@ -39,7 +39,7 @@ over the prompt — the training kernels ARE the prefill kernels).
 """
 
 import math
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -50,10 +50,18 @@ __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'decode_attention']
 
 class DecodeCache(NamedTuple):
     """Static-shape KV cache: ``k``/``v`` are ``(B, H_kv, T_max, d·)``
-    buffers, ``length`` the number of valid positions (traced scalar)."""
+    buffers, ``length`` the number of valid positions (traced scalar).
+    ``k_q``/``k_scale``: optional int8 mirror of ``k`` with per-row
+    scales, maintained at append time for ``qk_quant='int8'`` models —
+    rows are append-only and the quantization is per-row, so quantizing
+    once on append is bit-identical to re-quantizing the buffer each
+    step, and the decode step then streams the int8 mirror (half the
+    bf16 K bytes) instead of re-reading + re-reducing the full cache."""
     k: jax.Array
     v: jax.Array
     length: jax.Array
+    k_q: Optional[jax.Array] = None
+    k_scale: Optional[jax.Array] = None
 
     @property
     def t_max(self):
@@ -61,14 +69,23 @@ class DecodeCache(NamedTuple):
 
 
 def init_cache(batch, kv_heads, t_max, head_dim, v_head_dim=None,
-               dtype=jnp.bfloat16):
+               dtype=jnp.bfloat16, qk_quant=None):
     """Zero cache for ``t_max`` positions (the compile-time ceiling; pick
-    the serving context limit)."""
+    the serving context limit). ``qk_quant='int8'`` allocates the
+    quantized K mirror for int8-trained models."""
     v_head_dim = v_head_dim or head_dim
+    if qk_quant not in (None, 'int8'):
+        raise ValueError(f"qk_quant must be None or 'int8', "
+                         f'got {qk_quant!r}')
+    quant = qk_quant == 'int8'
     return DecodeCache(
         k=jnp.zeros((batch, kv_heads, t_max, head_dim), dtype),
         v=jnp.zeros((batch, kv_heads, t_max, v_head_dim), dtype),
-        length=jnp.zeros((), jnp.int32))
+        length=jnp.zeros((), jnp.int32),
+        k_q=(jnp.zeros((batch, kv_heads, t_max, head_dim), jnp.int8)
+             if quant else None),
+        k_scale=(jnp.zeros((batch, kv_heads, t_max, 1), jnp.float32)
+                 if quant else None))
 
 
 def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
@@ -99,12 +116,26 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
             f'generation loop')
     idx = (jnp.zeros((), jnp.int32),) * 2 + (cache.length,
                                              jnp.zeros((), jnp.int32))
+    k_q = k_scale = None
+    if cache.k_q is not None:
+        # Maintain the int8 mirror with the SAME per-row rule as the
+        # training kernels (ops.pallas_attention._quantize_rows) — rows
+        # never change after append, so this is exact.
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        b, h_kv, _, d = cache.k.shape
+        ki, sk = _quantize_rows(k_new, b * h_kv, n, d)
+        k_q = lax.dynamic_update_slice(
+            cache.k_q, ki.reshape(b, h_kv, n, d), idx)
+        k_scale = lax.dynamic_update_slice(
+            cache.k_scale, sk.reshape(b, h_kv, n, 1), idx)
     return DecodeCache(
         k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype),
                                    idx),
         v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype),
                                    idx),
-        length=cache.length + n)
+        length=cache.length + n, k_q=k_q, k_scale=k_scale)
 
 
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
@@ -144,14 +175,20 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
         # fused kernel, so a model trained with int8 QK^T decodes to its
         # training-time logits. The products stay exact in fp32
         # (|int8·int8·d| ≪ 2²⁴) — no int path needed; decode is
-        # bandwidth-bound anyway.
+        # bandwidth-bound anyway. The cached side streams the int8
+        # mirror when the cache carries one (init_cache(qk_quant=) —
+        # rows quantize once at append); a mirror-less cache quantizes
+        # on the fly (exact but re-reads the full K buffer).
         from distributed_dot_product_tpu.ops.pallas_attention import (
             _quantize_rows,
         )
         qi, sq = _quantize_rows(qg, b * h_kv, group * n, d)
-        ki, sk = _quantize_rows(cache.k, b * h_kv, t_max, d)
         q_eff = (qi.astype(jnp.float32) * sq).reshape(qg.shape)
-        k_eff = (ki.astype(jnp.float32) * sk).reshape(cache.k.shape)
+        if cache.k_q is not None:
+            k_eff = cache.k_q.astype(jnp.float32) * cache.k_scale
+        else:
+            ki, sk = _quantize_rows(cache.k, b * h_kv, t_max, d)
+            k_eff = (ki.astype(jnp.float32) * sk).reshape(cache.k.shape)
     elif qk_quant is not None:
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')

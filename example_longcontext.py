@@ -109,15 +109,16 @@ def main():
     print(f'checkpointed -> {final}')
 
     if args.generate:
-        # Inference with the SAME weights and configuration: prefill a
-        # prompt through the module's KV-cache decode surface, then
-        # decode autoregressively (each step feeds the previous output
-        # back in — the attention-only analog of LM generation).
+        # Inference with the SAME weights and configuration: prefill the
+        # prompt with the flash kernel (module.prefill — decode() would
+        # materialize an (prompt, t_max) score buffer), then decode
+        # autoregressively (each step feeds the previous output back in
+        # — the attention-only analog of LM generation).
         local = model.bind(params)
         prompt = 64
         cache = model.make_decode_cache(1, prompt + args.generate)
         xp = jax.device_get(x)[:, :prompt]
-        cache, out = local.decode(xp, xp, xp, cache)
+        cache, out = local.prefill(xp, xp, xp, cache)
         tok = out[:, -1:]
         tic = time.perf_counter()
         for _ in range(args.generate):

@@ -160,6 +160,12 @@ SWEEPS = [
     ('train_benchmark_flash_rope',
      ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
       '--seq-len', '16384', '--no-mask', '--causal', '--use-rope']),
+    # --- KV-cache decode latency (inference; module decode surface) ---
+    *[(f'decode_benchmark_{tag}{suff}',
+       ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', tlen,
+        '--heads', '8', '--head-dim', '96'] + extra)
+      for tag, tlen in (('16k', '16384'), ('128k', '131072'))
+      for suff, extra in (('', []), ('_kv2', ['--kv-heads', '2']))],
     # --- train-step head-dim sweep (dim=768 fixed, so d = 768/heads) ---
     *[(f'train_benchmark_flash_h{h}_{tag}_nomask',
        ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',

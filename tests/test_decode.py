@@ -158,9 +158,10 @@ def test_module_decode_matches_causal_forward(kwargs):
     want = m.apply(params, x, x, x, None)
 
     cache = m.make_decode_cache(B, T)
-    # Prefill the first PREFILL positions in one call, then decode.
+    # Prefill the first PREFILL positions via the flash-kernel prefill
+    # method, then decode token by token.
     cache, out0 = m.apply(params, x[:, :PREFILL], x[:, :PREFILL],
-                          x[:, :PREFILL], cache, method='decode')
+                          x[:, :PREFILL], cache, method='prefill')
     outs = [out0]
     step = jax.jit(lambda p, xt, c: m.apply(p, xt, xt, xt, c,
                                             method='decode'))
@@ -214,3 +215,53 @@ def test_module_decode_segments():
     got = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=3e-5)
+
+
+def test_module_midstream_prefill():
+    """prefill from a NON-empty cache (decode a few tokens, prefill a
+    chunk, decode the rest): pins the causal_offset=start math — row
+    positions start+i vs buffer columns — which the fresh-cache tests
+    never reach."""
+    from distributed_dot_product_tpu.models.attention import (
+        DistributedDotProductAttn,
+    )
+    DIM = 32
+    m = DistributedDotProductAttn(key_dim=DIM, num_heads=4, causal=True,
+                                  use_rope=True, window=20,
+                                  softmax_impl='flash', distributed=False)
+    x = jax.random.normal(jax.random.key(7), (B, T, DIM))
+    params = m.init(jax.random.key(1), x[:, :8], x[:, :8], x[:, :8], None)
+    want = m.apply(params, x, x, x, None)
+
+    cache = m.make_decode_cache(B, T)
+    outs = []
+    for t in range(8):                       # decode 8 single tokens
+        cache, o = m.apply(params, x[:, t:t + 1], x[:, t:t + 1],
+                           x[:, t:t + 1], cache, method='decode')
+        outs.append(o)
+    cache, o = m.apply(params, x[:, 8:PREFILL], x[:, 8:PREFILL],
+                       x[:, 8:PREFILL], cache, method='prefill')
+    outs.append(o)                           # mid-stream prefill chunk
+    for t in range(PREFILL, T):
+        cache, o = m.apply(params, x[:, t:t + 1], x[:, t:t + 1],
+                           x[:, t:t + 1], cache, method='decode')
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5)
+
+
+def test_int8_cache_mirror_matches_onthefly():
+    """The append-time int8 mirror must score exactly like on-the-fly
+    re-quantization of the raw cache (per-row rule, append-only rows)."""
+    q, k, v = _seq(hkv=2, key=8)
+    with_mirror = init_cache(B, 2, T, D, dtype=jnp.float32,
+                             qk_quant='int8')
+    without = init_cache(B, 2, T, D, dtype=jnp.float32)
+    for c0, c1 in ((0, PREFILL), (PREFILL, T)):
+        with_mirror = append_kv(with_mirror, k[:, :, c0:c1],
+                                v[:, :, c0:c1])
+        without = append_kv(without, k[:, :, c0:c1], v[:, :, c0:c1])
+    a = decode_attention(q[:, :, -1:], with_mirror, qk_quant='int8')
+    b2 = decode_attention(q[:, :, -1:], without, qk_quant='int8')
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b2), atol=1e-6)
