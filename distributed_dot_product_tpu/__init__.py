@@ -55,6 +55,9 @@ from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
 from distributed_dot_product_tpu.models.decode import (  # noqa: F401
     DecodeCache, append_kv, decode_attention, init_cache,
 )
+from distributed_dot_product_tpu.models.transformer import (  # noqa: F401
+    TransformerBlock, TransformerStack,
+)
 from distributed_dot_product_tpu.models.ulysses_attention import (  # noqa: F401
     ulysses_attention,
 )

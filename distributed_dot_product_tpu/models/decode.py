@@ -125,7 +125,12 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
             _quantize_rows,
         )
         b, h_kv, _, d = cache.k.shape
-        ki, sk = _quantize_rows(k_new, b * h_kv, n, d)
+        # Quantize the CACHE-dtype value (what the raw buffer stores),
+        # not the caller's dtype — the mirror's exactness contract is
+        # "identical to re-quantizing the buffer", which a higher-
+        # precision k_new would silently break.
+        ki, sk = _quantize_rows(k_new.astype(cache.k.dtype), b * h_kv,
+                                n, d)
         k_q = lax.dynamic_update_slice(
             cache.k_q, ki.reshape(b, h_kv, n, d), idx)
         k_scale = lax.dynamic_update_slice(

@@ -265,3 +265,23 @@ def test_int8_cache_mirror_matches_onthefly():
     a = decode_attention(q[:, :, -1:], with_mirror, qk_quant='int8')
     b2 = decode_attention(q[:, :, -1:], without, qk_quant='int8')
     np.testing.assert_allclose(np.asarray(a), np.asarray(b2), atol=1e-6)
+
+
+def test_int8_mirror_exact_with_mixed_dtypes():
+    """A bf16 cache fed fp32 k_new must quantize the CACHE-dtype value,
+    keeping the mirror bit-identical to on-the-fly re-quantization of
+    the stored buffer (the round-4 review repro: quantizing the fp32
+    input diverged by ~4e-3)."""
+    ks = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(ks[0], (B, 2, 1, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, 2, 16, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, 2, 16, D), jnp.float32)
+    with_mirror = init_cache(B, 2, 16, D, dtype=jnp.bfloat16,
+                             qk_quant='int8')
+    without = init_cache(B, 2, 16, D, dtype=jnp.bfloat16)
+    with_mirror = append_kv(with_mirror, k, v)   # fp32 into bf16 cache
+    without = append_kv(without, k, v)
+    a = decode_attention(q, with_mirror, qk_quant='int8')
+    b2 = decode_attention(q, without, qk_quant='int8')
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b2, np.float32), atol=1e-6)

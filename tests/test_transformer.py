@@ -1,0 +1,126 @@
+# -*- coding: utf-8 -*-
+"""
+Transformer stack (models/transformer.py): the composition layer. The
+contracts tested — sharded == local oracle on every softmax path, the
+train step drives a whole stack, stacked-layer dropout decorrelates
+under one explicit seed, and cached generation (prefill + decode with
+one KV cache per layer) reproduces the stack's causal forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_dot_product_tpu.models.attention import (
+    apply_seq_parallel,
+)
+from distributed_dot_product_tpu.models.transformer import (
+    TransformerStack,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+
+WORLD, LEN, DIM, HEADS = 4, 16, 32, 4
+T = WORLD * LEN
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return seq_mesh(WORLD)
+
+
+def _stack(dist=True, **attn_kw):
+    attn_kw.setdefault('causal', True)
+    attn_kw.setdefault('softmax_impl', 'flash')
+    attn_kw['distributed'] = dist
+    return TransformerStack(dim=DIM, num_heads=HEADS, n_layers=2,
+                            attn_kwargs=attn_kw)
+
+
+def _x(key=0):
+    return jax.random.normal(jax.random.key(key), (2, T, DIM))
+
+
+@pytest.mark.parametrize('impl', ['full', 'online', 'flash', 'ulysses'])
+def test_stack_sharded_matches_local(mesh, impl):
+    x = _x()
+    # ulysses GQA needs kv heads divisible by the mesh width (WORLD=4,
+    # HEADS=4 kv=2 would precisely raise) — standard heads there.
+    kv = 2 if impl != 'ulysses' else None
+    m = _stack(softmax_impl=impl, num_kv_heads=kv, use_rope=True)
+    params = m.init(jax.random.key(1), x[:, :8], x[:, :8], x[:, :8], None)
+    out = apply_seq_parallel(m, params, mesh, x, x, x, None)
+    local = _stack(dist=False, softmax_impl=impl, num_kv_heads=kv,
+                   use_rope=True)
+    ref = local.apply(params, x, x, x, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5)
+
+
+def test_stack_train_step(mesh):
+    x = _x(1)
+    m = _stack(use_rope=True, dropout_rate=0.1)
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    opt = optax.adam(1e-3)
+    step = make_train_step(m, opt, mesh, donate=False)
+    ost = opt.init(params)
+    target = jnp.roll(x, -1, axis=1)
+    losses = []
+    p = params
+    for i in range(3):
+        p, ost, loss = step(p, ost, (x, x, x, None, target),
+                            dropout_seed=i)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_stack_layer_dropout_decorrelates():
+    """Two identical-weight layers under ONE explicit seed must apply
+    different masks (per-layer salt through the stack)."""
+    x = _x(2)
+    m = _stack(dist=False, dropout_rate=0.5)
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    shared = jax.tree.map(lambda v: v, params)
+    shared['params']['block_1'] = shared['params']['block_0']
+    out = m.apply(shared, x, x, x, None, dropout_seed=3)
+    # If both layers applied the SAME mask, block outputs after layer 1
+    # and 2 would be related by the same dropped pattern; instead verify
+    # against a one-layer double application.
+    one = TransformerStack(dim=DIM, num_heads=HEADS, n_layers=1,
+                           attn_kwargs=dict(causal=True,
+                                            softmax_impl='flash',
+                                            distributed=False,
+                                            dropout_rate=0.5))
+    p1 = {'params': {'block_0': shared['params']['block_0']}}
+    y = one.apply(p1, x, x, x, None, dropout_seed=3)
+    z = one.apply(p1, y, y, y, None, dropout_seed=3)
+    assert not np.allclose(np.asarray(out), np.asarray(z), atol=1e-6), (
+        'stacked layers drew identical dropout masks under one seed')
+
+
+def test_stack_cached_generation_matches_forward():
+    """Prefill + token-by-token decode through per-layer caches ==
+    the stack's causal forward (GQA + RoPE + window on)."""
+    x = _x(3)
+    kw = dict(num_kv_heads=2, use_rope=True, window=24)
+    m = _stack(dist=False, **kw)
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    want = m.apply(params, x, x, x, None)
+
+    caches = m.make_decode_caches(2, T)
+    prefill = 40
+    caches, out0 = m.apply(params, x[:, :prefill], caches,
+                           method='prefill')
+    outs = [out0]
+    step = jax.jit(lambda p, xt, c: m.apply(p, xt, c, method='decode'))
+    for t in range(prefill, T):
+        caches, o = step(params, x[:, t:t + 1], caches)
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-5)
