@@ -521,15 +521,44 @@ def run_decode(args):
     cache = append_kv(cache, kf, vf)
 
     tok = jax.random.normal(jax.random.key(2), (b, 1, h * d), dtype)
+    # donate the cache: the append's dynamic_update_slice then writes in
+    # place instead of copying the whole K/V buffer pair per token —
+    # without donation an MHA 131K-cache step pays ~1 ms of pure copy.
     step = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
-                                                method='decode'))
+                                                method='decode'),
+                   donate_argnums=(2,))
+    cache_box = [cache]
 
-    def many(p, xt, c):
-        # The timed unit: one decode step (cache append + masked
-        # attention over the full buffer + 4 projections).
-        c2, out = step(p, xt, c)
+    def timed(p, xt):
+        # The timed unit: one decode step (in-place cache append + masked
+        # attention over the full buffer + 4 projections). The cache
+        # cycles through the step so donation stays legal. The chained
+        # timing steps exhaust the 64-slot headroom and then CLAMP onto
+        # the last slot (append_kv's documented traced-overflow behavior)
+        # — the per-step cost is identical to a real append (same DMA,
+        # same full-buffer attention), only the buffer contents stop
+        # being meaningful, which timing doesn't read. (An attempt to pin
+        # the length on-device made XLA drop the in-place aliasing for
+        # some configs — whole-buffer copies again; recorded here so it
+        # isn't retried.)
+        c2, out = step(p, xt, cache_box[0])
+        cache_box[0] = c2
         return out
-    best, mean = time_fn(many, params, tok, cache, iters=args.iters)
+    # Donated in-place steps are fast enough that the default 512-dispatch
+    # window can fall below the tunnel's ~70 ms sync overhead — let the
+    # auto-scaler chain more steps per sample. One throwaway measurement
+    # pass first: per-token rates keep improving over the first few
+    # thousand steps on the tunneled backend (observed 0.59 → 0.23
+    # ms/token across three back-to-back measurements), so the recorded
+    # number is the WARM steady state.
+    time_fn(timed, params, tok, iters=2, max_inner=16384)
+    best, mean = time_fn(timed, params, tok, iters=args.iters,
+                         max_inner=16384)
+    if best * 1e3 < 1e-3:
+        # A sample window that fell under the measured sync overhead
+        # clamps to ~0 — a 17 ns "token" is not a measurement. Fall back
+        # to the mean, which averages real windows.
+        best = mean
     cache_bytes = 2 * b * h_kv * t_max * d * jnp.dtype(dtype).itemsize
     record = {
         'mode': 'decode', 't_max': t_max, 'fill': fill, 'heads': h,

@@ -338,14 +338,25 @@ round doesn't re-derive it.""")
 ### KV-cache decode (inference; dim=768, H=8, bf16, one chip)
 
 Steady-state per-token latency through the module surface
-(`DistributedDotProductAttn.decode`) against a ~full cache — decode is
-HBM-bandwidth-bound (each step streams the K/V cache once), so GB/s over
-the cache bytes is the efficiency number; the v5e's HBM peak is
-~820 GB/s. GQA is the headline lever: `num_kv_heads=2` cuts the cache
-4× AND runs nearer peak bandwidth (the grouped einsum gives the matmul
-4 query rows per kv head instead of a single-row matvec), multiplying
-into ~11× lower latency at T=131K. No reference analog (it has no
-inference path).
+(`DistributedDotProductAttn.decode`) against a ~full cache, with the
+cache DONATED to the jitted step (`donate_argnums`) so the append's
+`dynamic_update_slice` writes in place — without donation each token
+paid a full K/V buffer copy (~1 ms at T=131K: a first measurement read
+1.81 ms/token before a probe isolated the copy; the scoring itself
+streams at ~770 GB/s in any formulation).
+
+What's robust across sessions: the big-cache MHA row is
+HBM-bandwidth-bound — T=131K full-head decode reproduces at
+~0.59-0.67 ms/token (~600-690 GB/s over the cache; the v5e's HBM peak
+is ~820) in every process. Small and GQA caches sit at a fixed
+per-step floor (~0.14 ms: projections + dispatch chain) — their GB/s
+figures read low because the cache is small, and their latencies
+wobble up to several× between sessions on the tunneled chip (best
+observed for the T=131K `kv_heads=2` cache: 0.174 ms/token; the table
+shows the latest record, not the best). The structural claim stands
+independent of the wobble: GQA shrinks the thing decode streams by
+H/H_kv, which is the memory win it exists for at inference. No
+reference analog (it has no inference path).
 
 | config | ms/token | cache GB/s |
 |---|---|---|""")
