@@ -115,8 +115,10 @@ class DistributedDotProductAttn(nn.Module):
     # softmax_impl='online' + causal only: 'zigzag' balances the causal
     # ring's critical path (shard i holds half-stripes {i, 2W-1-i}; feed
     # inputs permuted by models.ring_attention.zigzag_indices and invert
-    # on the output). Requires attn_mask=None; segment_ids ARE supported
-    # (ids need only equality, so the permuted layout carries them).
+    # on the output). segment_ids ride the permuted layout directly (ids
+    # need only equality); a dense attn_mask needs its ROW axis permuted
+    # like the inputs (columns stay global — the ring folds gather them
+    # per owner, see ring_attention).
     ring_layout: str = 'contiguous'
     # For softmax_impl='flash': 'exact' running-max softmax, or 'bounded'
     # (norm-bound shift — faster at small head dim; see
@@ -134,7 +136,10 @@ class DistributedDotProductAttn(nn.Module):
     # keys, so the bias is over key-vs-query global positions — the same
     # relative-distance bias as standard attention.
     alibi_slopes: Optional[Any] = None
-    # 'int8' = quantized QK^T on the flash path (see flash_attention).
+    # 'int8' = quantized QK^T scoring in the fused kernels
+    # (flash/online/ulysses; see flash_attention — the ring path's folds
+    # quantize per resident block, which the row-local rule makes
+    # identical to one big kernel's quantization).
     qk_quant: Optional[str] = None
     # Rotary position embeddings on the projected score operands (keys
     # AND queries — both sides of the K-first scoring, so logits depend
@@ -468,19 +473,21 @@ class DistributedDotProductAttn(nn.Module):
                     causal=native_causal, layout=self.ring_layout,
                     window=self.window, segment_ids=seg_ring,
                     alibi_slopes=self.alibi_slopes,
+                    qk_quant=self.qk_quant,
                     dropout_rate=drop_rate, dropout_seed=drop_seed)
             elif (seg_ring is not None or self.alibi_slopes is not None
-                    or drop_rate):
+                    or self.qk_quant is not None or drop_rate):
                 # Local oracle with in-kernel features: the fused kernel
-                # IS the local math for segments/ALiBi/dropout (the plain
-                # einsum oracle has none of them); GQA is native there
-                # too.
+                # IS the local math for segments/ALiBi/dropout/int8 (the
+                # plain einsum oracle has none of them); GQA is native
+                # there too.
                 outputs = flash_attention(
                     keys, queries, values, attn_mask, scale=scale,
                     causal=native_causal, window=self.window,
                     segment_ids=(None if seg_ring is None
                                  else (seg_ring, seg_ring)),
                     alibi_slopes=self.alibi_slopes,
+                    qk_quant=self.qk_quant,
                     dropout_rate=drop_rate, dropout_seed=drop_seed)
             else:
                 q_loc, v_loc = queries, values
@@ -572,7 +579,8 @@ class DistributedDotProductAttn(nn.Module):
         out = out.reshape(*out.shape[:-2], self._value_dim)
         return self.composition(out)
 
-    def prefill(self, keys, queries, values, cache):
+    def prefill(self, keys, queries, values, cache, segment_ids=None,
+                seg_cache=None):
         """Prompt ingestion for :meth:`decode`: project the ``n`` new
         positions, append the projected queries/values to the cache, and
         compute their outputs with the FLASH kernel over the whole cache
@@ -583,16 +591,30 @@ class DistributedDotProductAttn(nn.Module):
         O(block²) score memory (``decode`` would materialize an
         ``(n, t_max)`` score buffer — fine for a few rows, not a
         131K-token prompt). Same knob coverage as ``decode``
-        (GQA/RoPE/window/ALiBi/int8). Returns ``(cache, out)``."""
+        (GQA/RoPE/window/ALiBi/int8/segments). Packed multi-turn
+        prompts: ``segment_ids (B, n)`` holds the prompt rows' ids,
+        ``seg_cache (B, t_max)`` the cached positions' — which, as in
+        ``decode``, must already carry the ids of the positions being
+        appended (rows attend their own columns). Returns
+        ``(cache, out)``."""
         from distributed_dot_product_tpu.models.decode import append_kv
         keys, queries, values = self._project_for_decode(
             keys, queries, values, cache)
         start = cache.length
         cache = append_kv(cache, queries, values)
+        seg_pair = None
+        if segment_ids is not None:
+            if seg_cache is None:
+                raise ValueError('segment_ids needs seg_cache (the cached '
+                                 "positions' ids, shape (B, t_max))")
+            sq = segment_ids.astype(jnp.int32)[..., None, :]
+            sk = seg_cache.astype(jnp.int32)[..., None, :]
+            seg_pair = (sq, sk)
         out = flash_attention(
             keys, cache.k, cache.v, causal=True, causal_offset=start,
             scale=1.0 / math.sqrt(self.head_dim), window=self.window,
-            alibi_slopes=self.alibi_slopes, qk_quant=self.qk_quant)
+            alibi_slopes=self.alibi_slopes, qk_quant=self.qk_quant,
+            segment_ids=seg_pair)
         return cache, self._merge_decode_heads(out)
 
     def decode(self, keys, queries, values, cache, segment_ids=None,

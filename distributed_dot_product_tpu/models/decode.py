@@ -103,10 +103,14 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
     The caller owns the ``t_max`` budget: appending past it raises when
     the length is concrete (the usual serving loop, where the cache
     crosses the host between jitted steps). Under ``jit`` the length is
-    traced and cannot be checked — an overflowing write would clamp to
-    the last slot (``dynamic_update_slice`` semantics), silently
-    corrupting the newest entries, so bound your generation loop by
-    ``t_max``."""
+    traced and cannot raise, so the write carries a traced guard
+    instead: an overflowing append leaves the buffers UNCHANGED (the
+    write-back trick below — ``dynamic_update_slice`` alone would clamp
+    onto the last slot and silently corrupt the newest entries) while
+    ``length`` still advances, so after a jitted generation loop
+    ``cache.length > cache.t_max`` detectably flags the overflow. Bound
+    your loop by ``t_max`` regardless; the guard turns a miscounted
+    loop's silent corruption into a checkable condition."""
     n = k_new.shape[-2]
     if n > cache.t_max:
         raise ValueError(f'appending {n} positions to a t_max='
@@ -114,7 +118,7 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
     try:
         length = int(cache.length)
     except (jax.errors.ConcretizationTypeError, TypeError):
-        length = None  # traced (inside jit): not checkable here
+        length = None  # traced (inside jit): the traced guard applies
     if length is not None and length + n > cache.t_max:
         raise ValueError(
             f'KV-cache overflow: length {length} + {n} new positions '
@@ -122,6 +126,16 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
             f'generation loop')
     idx = (jnp.zeros((), jnp.int32),) * 2 + (cache.length,
                                              jnp.zeros((), jnp.int32))
+    overflow = cache.length + n > cache.t_max
+
+    def guarded_write(buf, new):
+        # Overflow → write the slice's CURRENT contents back (a no-op
+        # write at the clamped index: buffers stay intact); in-bounds →
+        # the normal append. One extra n-row read per append — noise
+        # against the full-buffer stream the attention step does anyway.
+        cur = lax.dynamic_slice(buf, idx, new.shape)
+        return lax.dynamic_update_slice(
+            buf, jnp.where(overflow, cur, new), idx)
     k_q = k_scale = None
     if cache.k_q is not None:
         # Maintain the int8 mirror with the SAME per-row rule as the
@@ -137,15 +151,12 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
         # precision k_new would silently break.
         ki, sk = _quantize_rows(k_new.astype(cache.k.dtype), b * h_kv,
                                 n, d)
-        k_q = lax.dynamic_update_slice(
-            cache.k_q, ki.reshape(b, h_kv, n, d), idx)
-        k_scale = lax.dynamic_update_slice(
-            cache.k_scale, sk.reshape(b, h_kv, n, 1), idx)
+        k_q = guarded_write(cache.k_q, ki.reshape(b, h_kv, n, d))
+        k_scale = guarded_write(cache.k_scale,
+                                sk.reshape(b, h_kv, n, 1))
     return DecodeCache(
-        k=lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype),
-                                   idx),
-        v=lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype),
-                                   idx),
+        k=guarded_write(cache.k, k_new.astype(cache.k.dtype)),
+        v=guarded_write(cache.v, v_new.astype(cache.v.dtype)),
         length=cache.length + n, k_q=k_q, k_scale=k_scale)
 
 

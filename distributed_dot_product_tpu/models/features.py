@@ -71,7 +71,7 @@ FEATURE_MATRIX = {
     },
     'qk_quant': {
         'full': False,
-        'online': False,
+        'online': 'int8 MXU scoring (per-fold kernels)',
         'flash': 'int8 MXU scoring',
         'ulysses': 'int8 MXU scoring (local flash kernel)',
     },
@@ -107,8 +107,8 @@ INTERACTION_RULES = (
     ('window', 'requires causal=True (lookback cap)'),
     ('alibi_slopes', 'requires causal=True (relative-position bias)'),
     ('ring_layout=zigzag',
-     'requires causal=True and attn_mask=None (mask columns are '
-     'contiguous-global; segment_ids ARE supported)'),
+     'requires causal=True; a dense attn_mask needs its ROW axis '
+     'zigzag-permuted like the inputs (columns stay global)'),
     ('dropout_rate',
      "needs rngs={'dropout': key} at apply() or an explicit "
      'dropout_seed'),

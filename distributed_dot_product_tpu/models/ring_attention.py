@@ -73,7 +73,8 @@ def _mask_bias(mask, dtype):
 def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                    scale=None, precision=None, block_impl='flash',
                    layout='contiguous', window=None, segment_ids=None,
-                   alibi_slopes=None, dropout_rate=0.0, dropout_seed=None):
+                   alibi_slopes=None, qk_quant=None, dropout_rate=0.0,
+                   dropout_seed=None):
     """Sequence-parallel attention with O((T/N)²) score memory.
 
     ``q, k, v``: local shards ``(..., T/N, d)`` (any leading batch/head
@@ -99,12 +100,15 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     - ``'zigzag'``: shard i holds the two half-stripes ``i`` and
       ``2W−1−i`` of length T/2N — every shard then attends W+1
       half-blocks, balancing the causal critical path (~2× faster steps
-      at large W). Requires ``causal=True``, ``block_impl='flash'``, an
-      even per-shard length and ``mask=None`` (a (T/N, T) mask's columns
-      are contiguous-global; re-indexing it per layout is not
-      implemented — ``segment_ids`` ARE supported, ids need only
-      equality). Use :func:`zigzag_indices` to permute global arrays
-      into (and out of) this layout.
+      at large W). Requires ``causal=True``, ``block_impl='flash'`` and
+      an even per-shard length. Use :func:`zigzag_indices` to permute
+      global arrays into (and out of) this layout. ``mask`` IS
+      supported: its rows follow THIS shard's (zigzag) rows — permute
+      the global mask's ROW axis with the same indices as q — while its
+      columns stay contiguous-global; each fold gathers the owner's
+      column block by the owner's position vector (an O(T·T/N) gather
+      per shard per fold, so a dense mask costs more here than on the
+      contiguous layout — segments stay the O(T/N) form).
 
     ``window``: sliding-window lookback cap over global positions (see
     :func:`~distributed_dot_product_tpu.ops.pallas_attention.flash_attention`).
@@ -139,8 +143,16 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
     the same elements — folds never repeat each other's patterns, and
     the backward ring regenerates the forward's mask exactly.
 
-    Segments/ALiBi/dropout require ``block_impl='flash'`` (they live in
-    the fused kernels; the xla fold is the plain-einsum oracle path).
+    ``qk_quant='int8'``: per-row symmetric int8 QK^T scoring in the
+    per-fold kernels (see ``flash_attention``). The quantization rule is
+    row-local — q rows quantize identically in every fold, and each
+    fold's resident K block quantizes exactly as its rows would inside
+    one big kernel — so the ring result matches the single-device int8
+    flash path (the backward's straight-through recompute included).
+
+    Segments/ALiBi/dropout/int8 require ``block_impl='flash'`` (they
+    live in the fused kernels; the xla fold is the plain-einsum oracle
+    path).
     """
     if block_impl not in ('flash', 'xla'):
         raise ValueError(
@@ -154,12 +166,10 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         raise ValueError(
             f"layout must be 'contiguous' or 'zigzag', got {layout!r}")
     if layout == 'zigzag':
-        if not causal or block_impl != 'flash' or mask is not None:
+        if not causal or block_impl != 'flash':
             raise ValueError(
                 "layout='zigzag' balances the CAUSAL critical path and "
-                "needs block_impl='flash' with mask=None (mask columns "
-                'are contiguous-global; per-layout re-indexing is not '
-                'implemented)')
+                "needs block_impl='flash'")
         if q.shape[-2] % 2:
             raise ValueError('zigzag needs an even per-shard length '
                              f'(got T/N = {q.shape[-2]})')
@@ -176,13 +186,16 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
                 'backend for mask+window')
     scale = 1.0 / math.sqrt(q.shape[-1]) if scale is None else scale
     dropout_rate = float(dropout_rate)
+    if qk_quant not in (None, 'int8'):
+        raise ValueError(f"qk_quant must be None or 'int8', "
+                         f'got {qk_quant!r}')
     if block_impl == 'xla' and (segment_ids is not None
                                 or alibi_slopes is not None
-                                or dropout_rate):
+                                or dropout_rate or qk_quant is not None):
         raise ValueError(
-            "segment_ids/alibi_slopes/dropout need block_impl='flash' "
-            '(they live in the fused per-fold kernels; the xla fold is '
-            'the plain-einsum oracle path)')
+            "segment_ids/alibi_slopes/dropout/qk_quant need "
+            "block_impl='flash' (they live in the fused per-fold "
+            'kernels; the xla fold is the plain-einsum oracle path)')
     if alibi_slopes is not None and not causal:
         raise ValueError('alibi_slopes bias by relative global position '
                          'and require causal=True')
@@ -206,7 +219,8 @@ def ring_attention(q, k, v, mask=None, *, axis_name=SEQ_AXIS, causal=False,
         return _ring_flash(q, k, v, mask, seg, alibi,
                            None if not dropout_rate else dropout_seed,
                            axis_name, bool(causal), float(scale),
-                           bool(interpret), layout, window, dropout_rate)
+                           bool(interpret), layout, window, dropout_rate,
+                           qk_quant)
     return _ring_xla(q, k, v, mask, axis_name=axis_name, causal=causal,
                      scale=scale, precision=precision, window=window)
 
@@ -240,6 +254,16 @@ def _blk_mask(mask, owner, tn):
     if mask is None:
         return None
     return lax.dynamic_slice_in_dim(mask, owner * tn, tn, axis=-1)
+
+
+def _blk_mask_positions(mask, pos_k):
+    """Zigzag analog of :func:`_blk_mask`: the owner's columns are the
+    two half-stripes of its position vector, not one contiguous run —
+    gather them from the global-column mask (rows already follow this
+    shard's layout, the caller's contract)."""
+    if mask is None:
+        return None
+    return jnp.take(mask, pos_k, axis=-1)
 
 
 def _layout_positions(layout, shard, world, tn):
@@ -284,7 +308,8 @@ def _fold_skip(idx, owner, tn, window):
 
 def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
                          layout='contiguous', window=None, seg=None,
-                         alibi=None, dropout_rate=0.0, dropout_seed=None):
+                         alibi=None, dropout_rate=0.0, dropout_seed=None,
+                         qk_quant=None):
     """Forward ring: per block, the flash kernel returns the block-local
     normalized output ``out_b`` and row logsumexp ``lse_b``; blocks merge by
     the shift-invariant identity ``num += e^{lse_b − m}·out_b,
@@ -332,16 +357,17 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
                     q, k_buf, v_buf, _blk_mask(mask, owner, tn),
                     idx * tn, scale, causal, interpret,
                     save_lse=True, window=window, kv_offset=owner * tn,
-                    segment_ids=seg_pair, alibi=alibi,
+                    segment_ids=seg_pair, alibi=alibi, qk_quant=qk_quant,
                     dropout_rate=dropout_rate, dropout_seed=dropout_seed)
             else:
+                pos_k = _layout_positions(layout, owner, W, tn)
                 out_b, lse_b = _flash_fwd_impl(
-                    q, k_buf, v_buf, None, 0, scale, False, interpret,
-                    save_lse=True,
-                    positions=(my_pos,
-                               _layout_positions(layout, owner, W, tn)),
+                    q, k_buf, v_buf, _blk_mask_positions(mask, pos_k),
+                    0, scale, False, interpret, save_lse=True,
+                    positions=(my_pos, pos_k),
                     window=window, segment_ids=seg_pair, alibi=alibi,
-                    dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+                    qk_quant=qk_quant, dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed)
             # A block-empty row (all its columns masked / causal-future)
             # has lse_b ≈ log-of-large-finite-negative ⇒ combine weight 0:
             # garbage block outputs never enter the merge.
@@ -384,7 +410,7 @@ def _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale, interpret,
 def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
                          scale, interpret, layout='contiguous', window=None,
                          seg=None, alibi=None, dropout_rate=0.0,
-                         dropout_seed=None):
+                         dropout_seed=None, qk_quant=None):
     """Backward ring: the flash backward decomposes over K/V blocks given
     the GLOBAL ``lse`` (and ``Δ = rowsum(g·out)``), so a second ring pass
     rotates ``(k, v, dk, dv)`` together — each rank folds its dq
@@ -417,16 +443,19 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
                     idx * tn, out, lse, g, scale, causal,
                     interpret, grad_dtype=jnp.float32, window=window,
                     kv_offset=owner * tn, segment_ids=seg_pair,
-                    alibi=alibi, dropout_rate=dropout_rate,
+                    alibi=alibi, qk_quant=qk_quant,
+                    dropout_rate=dropout_rate,
                     dropout_seed=dropout_seed)
             else:
+                pos_k = _layout_positions(layout, owner, W, tn)
                 dq_b, dk_b, dv_b = _flash_bwd_impl(
-                    q, k_buf, v_buf, None, 0, out, lse, g, scale, False,
+                    q, k_buf, v_buf, _blk_mask_positions(mask, pos_k),
+                    0, out, lse, g, scale, False,
                     interpret, grad_dtype=jnp.float32,
-                    positions=(my_pos,
-                               _layout_positions(layout, owner, W, tn)),
+                    positions=(my_pos, pos_k),
                     window=window, segment_ids=seg_pair, alibi=alibi,
-                    dropout_rate=dropout_rate, dropout_seed=dropout_seed)
+                    qk_quant=qk_quant, dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed)
             return dq + dq_b, dk_buf + dk_b, dv_buf + dv_b
 
         if causal and my_pos is None:
@@ -453,31 +482,31 @@ def _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
 def _ring_flash(q, k, v, mask, seg, alibi, dropout_seed, axis_name, causal,
-                scale, interpret, layout, window, dropout_rate):
+                scale, interpret, layout, window, dropout_rate, qk_quant):
     out, _ = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
                                   interpret, layout, window, seg, alibi,
-                                  dropout_rate, dropout_seed)
+                                  dropout_rate, dropout_seed, qk_quant)
     return out
 
 
 def _ring_flash_vjp_fwd(q, k, v, mask, seg, alibi, dropout_seed, axis_name,
                         causal, scale, interpret, layout, window,
-                        dropout_rate):
+                        dropout_rate, qk_quant):
     out, lse = _ring_flash_fwd_impl(q, k, v, mask, axis_name, causal, scale,
                                     interpret, layout, window, seg, alibi,
-                                    dropout_rate, dropout_seed)
+                                    dropout_rate, dropout_seed, qk_quant)
     return out, (q, k, v, mask, seg, alibi, dropout_seed, out, lse)
 
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, layout, window,
-                        dropout_rate, res, g):
+                        dropout_rate, qk_quant, res, g):
     q, k, v, mask, seg, alibi, dropout_seed, out, lse = res
     dq, dk, dv = _ring_flash_bwd_impl(q, k, v, mask, out, lse, g, axis_name,
                                       causal, scale, interpret, layout,
                                       window, seg, alibi, dropout_rate,
-                                      dropout_seed)
+                                      dropout_seed, qk_quant)
     return dq, dk, dv, None, None, None, None
 
 

@@ -919,6 +919,17 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     *batch, tq, d = q.shape
     tk = k.shape[-2]
     d_v = v.shape[-1]
+    # Canonicalize the softmax mode BEFORE any grid/chunk eligibility
+    # check: dropout rides the exact kernel only, quantization's running
+    # max is already correct on the dequantized scores, and the
+    # Cauchy-Schwarz bound does not cover the additive ALiBi term (≤ 0
+    # only for non-negative slopes, and slopes may be traced) — in each
+    # case 'bounded' is an optimization hint that resolves to the exact
+    # kernel, which must then still be eligible for the trapezoid pair
+    # grid (both the beyond-cap chunking below and the in-cap selection).
+    if mode == 'bounded' and (dropout_rate or qk_quant == 'int8'
+                              or alibi is not None):
+        mode = 'exact'
     if _trap_eligible(causal, window, mask, positions, causal_offset,
                       kv_offset, mode, interpret):
         # Beyond-cap pair tables: split the Q rows into chunks that each
@@ -972,16 +983,6 @@ def _flash_fwd_impl(q, k, v, mask, causal_offset, scale, causal, interpret,
     # lowering is exp2(x·log2e) anyway). One extra rounding of q, same
     # class of error as the bf16 inputs themselves.
     quantized = qk_quant == 'int8'
-    # Canonicalize the softmax mode BEFORE grid selection: dropout rides
-    # the exact kernel only, quantization's running max is already
-    # correct on the dequantized scores, and the Cauchy-Schwarz bound
-    # does not cover the additive ALiBi term (≤ 0 only for non-negative
-    # slopes, and slopes may be traced) — in each case 'bounded' is an
-    # optimization hint that resolves to the exact kernel, which must
-    # then still be eligible for the trapezoid pair grid below.
-    if mode == 'bounded' and (dropout_rate or quantized
-                              or alibi is not None):
-        mode = 'exact'
     sqf = skr = None
     if quantized:
         # int8 QK^T: the fwd score matmul runs on the int8 MXU path

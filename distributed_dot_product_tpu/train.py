@@ -51,7 +51,7 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     (:func:`~distributed_dot_product_tpu.parallel.mesh.data_seq_mesh`).
     ``data_axis``: name of the batch mesh axis, or None for pure SP.
 
-    Returns ``step(params, opt_state, batch, dropout_seed=0) ->
+    Returns ``step(params, opt_state, batch, dropout_seed=None) ->
     (params, opt_state, loss)`` where
     ``batch = (keys, queries, values, attn_mask, target)`` — or
     ``(..., target, segment_ids)`` with a global ``(B, T)`` packed-sequence
@@ -59,10 +59,14 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     ``(batch→data, time→seq)``, parameters and optimizer state stay
     replicated (the reference's weight-replication convention, reference
     test_gradient.py:48). ``dropout_seed`` (a traced int32 scalar — pass
-    the step counter) feeds modules with ``dropout_rate > 0``; modules
-    without dropout ignore it, so the default costs nothing.
+    the step counter) feeds modules with ``dropout_rate > 0``; for those
+    modules it is REQUIRED — omitting it raises, because a constant
+    fallback seed would silently draw the identical dropout mask every
+    step (correlated dropout degrades training with no error signal).
+    Modules without dropout ignore it.
     """
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
+    needs_seed = _module_has_dropout(module)
 
     def local_step(params, opt_state, keys, queries, values, mask, target,
                    seg, drop_seed):
@@ -99,7 +103,15 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
         out_specs=(P(), P(), P()),
         check_vma=False)
 
-    def step(params, opt_state, batch, dropout_seed=0):
+    def step(params, opt_state, batch, dropout_seed=None):
+        if dropout_seed is None:
+            if needs_seed:
+                raise ValueError(
+                    'this module has dropout_rate > 0: pass '
+                    'dropout_seed=<step counter> to every step() call — '
+                    'a constant fallback would reuse ONE dropout mask '
+                    'for the whole run (silently correlated dropout)')
+            dropout_seed = 0
         keys, queries, values, mask, target, *rest = batch
         seg = rest[0] if rest else None
         return sharded(params, opt_state, keys, queries, values, mask,
@@ -107,3 +119,16 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def _module_has_dropout(module):
+    """Does this module (or a stack over the attention module) apply
+    attention dropout? Reads constructor fields only — the attention
+    module exposes ``dropout_rate``; the transformer stack carries it in
+    ``attn_kwargs``."""
+    if getattr(module, 'dropout_rate', 0.0):
+        return True
+    # attn_kwargs is typed Any — normalize like the stack itself does
+    # (transformer.py accepts any pair-iterable via dict(...)).
+    kw = dict(getattr(module, 'attn_kwargs', None) or {})
+    return bool(kw.get('dropout_rate', 0.0))

@@ -140,3 +140,74 @@ def test_quant_validation():
     q, k, v = _qkv(16)
     with pytest.raises(ValueError, match='qk_quant'):
         flash_attention(q, k, v, qk_quant='int4')
+
+
+# ---------------------------------------------------------------------------
+# Ring-path int8: the per-fold quantization is row-local, so the ring
+# result must match the single-device int8 flash kernel (fwd AND grads).
+# ---------------------------------------------------------------------------
+
+def _ring_int8(mesh, layout='contiguous'):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+    spec = P(None, None, 'seq', None)
+    return jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, causal=True,
+                                       qk_quant='int8', layout=layout),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+
+
+def test_ring_int8_matches_flash_int8():
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    q, k, v = _qkv(64, key=8)
+    want = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    ring = _ring_int8(seq_mesh(4))
+    got = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_ring_int8_gradients_match_flash_int8():
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    q, k, v = _qkv(64, key=9)
+    cot = jax.random.normal(jax.random.key(10), v.shape, jnp.float32)
+    ring = _ring_int8(seq_mesh(4))
+
+    g_ring = jax.grad(lambda *a: jnp.sum(ring(*a) * cot),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, causal=True,
+                                           qk_quant='int8') * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_ring, g_flash):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_int8_zigzag_round_trip():
+    from distributed_dot_product_tpu.models.ring_attention import (
+        zigzag_indices,
+    )
+    from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+    world, t = 4, 64
+    q, k, v = _qkv(t, key=11)
+    idx = zigzag_indices(t, world)
+    inv = jnp.argsort(idx)
+    ring = _ring_int8(seq_mesh(world), layout='zigzag')
+    got = ring(q[..., idx, :], k[..., idx, :], v[..., idx, :])[..., inv, :]
+    want = flash_attention(q, k, v, causal=True, qk_quant='int8')
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_ring_int8_xla_fold_rejected():
+    from distributed_dot_product_tpu.models.ring_attention import (
+        ring_attention,
+    )
+    q, k, v = _qkv(16, key=12)
+    with pytest.raises(ValueError, match='qk_quant'):
+        ring_attention(q[..., :4, :], k[..., :4, :], v[..., :4, :],
+                       block_impl='xla', qk_quant='int8')
