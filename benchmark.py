@@ -45,8 +45,25 @@ def parse_args():
     # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
-                                           'train', 'decode'],
+                                           'train', 'decode', 'lm'],
                         default='nt')
+    parser.add_argument('--layers', type=int, default=8,
+                        help='lm mode: transformer depth')
+    parser.add_argument('--vocab', type=int, default=32768,
+                        help='lm mode: vocabulary size')
+    parser.add_argument('--remat', action='store_true',
+                        help='lm mode: per-layer rematerialization '
+                             '(scanned stack)')
+    parser.add_argument('--no-scan', action='store_true',
+                        help='lm mode: unrolled layers instead of '
+                             'nn.scan')
+    parser.add_argument('--batch', type=int, default=1,
+                        help='decode mode: sequences decoded per step')
+    parser.add_argument('--decode-chain', type=int, default=1,
+                        help='decode mode: tokens decoded per dispatch '
+                             '(a lax.scan of steps inside ONE jit — '
+                             'amortizes the per-dispatch floor that '
+                             'otherwise hides small-cache/GQA wins)')
     parser.add_argument('--seq-len', type=int, default=None,
                         help='global sequence length (train mode default '
                              '16384; attn mode default 75000//scale)')
@@ -417,6 +434,105 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     }
 
 
+def measure_lm_step(*, seq_len, n_layers=8, vocab=32768, dtype='bf16',
+                    heads=8, kv_heads=None, iters=3, devices=None,
+                    causal=True, window=None, scan_layers=True,
+                    remat=False, attn_impl='flash'):
+    """One full LM training step — embed → scanned transformer stack →
+    tied head → packed-segment cross-entropy → grad psum → adam — as one
+    compiled SPMD program (``train.make_lm_train_step``). The capstone
+    measurement: the framework training the thing it is architected for.
+
+    FLOPs (per fwd, ×3 for the step): per layer the 4 attention
+    projections ``4·T·D²·(1+kv/H)``, the two attention matmuls
+    ``4·pairs·D``, and the MLP ``16·T·D²``; plus the tied head
+    ``2·T·D·V``. Tokens/s is the honest end-to-end headline (it charges
+    the head and loss too); GFLOP/s shows kernel efficiency.
+    """
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_dot_product_tpu import TransformerLM, lm_targets
+    from distributed_dot_product_tpu.parallel.mesh import globalize
+    from distributed_dot_product_tpu.train import make_lm_train_step
+
+    mesh = seq_mesh(devices)
+    world = mesh.devices.size
+    t = seq_len - seq_len % world
+    jdtype = jnp.float32 if dtype == 'f32' else jnp.bfloat16
+
+    model = TransformerLM(
+        vocab_size=vocab, dim=DIM, num_heads=heads, n_layers=n_layers,
+        scan_layers=scan_layers, remat=remat, dtype=jdtype,
+        attn_kwargs=dict(softmax_impl=attn_impl, num_kv_heads=kv_heads,
+                         causal=causal, window=window))
+
+    toks_host = jax.random.randint(jax.random.key(111), (1, t), 0, vocab,
+                                   dtype=jnp.int32)
+    spec = NamedSharding(mesh, P(None, SEQ_AXIS))
+    tokens = globalize(toks_host, spec)
+    targets = globalize(lm_targets(toks_host), spec)
+
+    params = model.init(jax.random.key(0),
+                        toks_host[:, :max(world * 2, 16)])
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step = make_lm_train_step(model, optimizer, mesh, donate=False)
+
+    batch = (tokens, targets)
+    compiled = step.lower(params, opt_state, batch).compile()
+    best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
+    if causal and window is not None:
+        w = min(window, t)
+        pairs = w * (w + 1) / 2.0 + (t - w) * float(w)
+    elif causal:
+        pairs = t * t / 2.0
+    else:
+        pairs = float(t) * t
+    kvfrac = (kv_heads / heads) if kv_heads else 1.0
+    fwd = (n_layers * (4.0 * t * DIM * DIM * (1.0 + kvfrac)
+                       + 16.0 * t * DIM * DIM + 4.0 * pairs * DIM)
+           + 2.0 * t * DIM * vocab)
+    return {
+        'mode': 'lm', 'attn_impl': attn_impl, 'T': t, 'dim': DIM,
+        'heads': heads, 'kv_heads': kv_heads or heads,
+        'n_layers': n_layers, 'vocab': vocab, 'n_params': n_params,
+        'scan_layers': scan_layers, 'remat': remat, 'world': world,
+        'dtype': dtype, 'causal': causal, 'window': window,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'step_time': best, 'step_time_mean': mean,
+        'tokens_per_s': t / best,
+        'step_gflops_per_chip': 3.0 * fwd / world / best / 1e9,
+        'memory_analysis': _memory_analysis(compiled),
+    }
+
+
+def run_lm(args):
+    """``--mode lm``: the capstone workload — no reference analog (the
+    reference has no model layer at all; anchor: its single-attention
+    example, reference example.py:16-33)."""
+    record = measure_lm_step(
+        seq_len=args.seq_len or 16384, n_layers=args.layers,
+        vocab=args.vocab, dtype=args.dtype, heads=args.heads,
+        kv_heads=args.kv_heads, iters=args.iters, devices=args.devices,
+        causal=True, window=args.window,
+        scan_layers=not args.no_scan, remat=args.remat,
+        attn_impl=args.attn_impl)
+    ma = record['memory_analysis'] or {}
+    print(f"lm[{record['attn_impl']}] T={record['T']} "
+          f"{record['n_layers']}L dim={DIM} vocab={record['vocab']} "
+          f"({record['n_params'] / 1e6:.1f}M params"
+          f"{', remat' if record['remat'] else ''}): "
+          f"{record['step_time']:.4f}s/step "
+          f"{record['tokens_per_s']:,.0f} tok/s "
+          f"({record['step_gflops_per_chip']:.0f} GFLOP/s/chip, "
+          f"temp {ma.get('temp_bytes', 0) / 2**30:.2f} GiB)")
+    _append_record(args.file, record)
+    return record
+
+
 def run_train(args):
     """``--mode train``: the reference example workload scaled up
     (reference example.py runs T=4096, dim 768, heads 2 with no optimizer;
@@ -506,7 +622,7 @@ def run_decode(args):
         key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
         causal=True, use_rope=args.use_rope, softmax_impl='flash',
         dtype=dtype)
-    b = 1
+    b = args.batch
     x0 = jnp.zeros((b, 16, h * d), dtype)
     params = model.init(jax.random.key(0), x0, x0, x0, None)
     fill = t_max - 64  # leave headroom for the timed decode steps
@@ -524,23 +640,42 @@ def run_decode(args):
     # donate the cache: the append's dynamic_update_slice then writes in
     # place instead of copying the whole K/V buffer pair per token —
     # without donation an MHA 131K-cache step pays ~1 ms of pure copy.
-    step = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
-                                                method='decode'),
-                   donate_argnums=(2,))
+    chain = max(1, args.decode_chain)
+    if chain == 1:
+        step = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
+                                                    method='decode'),
+                       donate_argnums=(2,))
+    else:
+        # Chained decode: `chain` tokens per dispatch via lax.scan — the
+        # per-dispatch overhead (~0.14 ms on the tunneled chip) divides
+        # by `chain`, exposing the true per-token HBM cost that the
+        # floor otherwise masks for small/GQA caches. The same token
+        # feeds every step (its value doesn't change the cost); the
+        # cache rides the scan carry in place.
+        def chained(p, xt, c):
+            def body(carry, _):
+                c, out = model.apply(p, xt, xt, xt, carry,
+                                     method='decode')
+                return c, out[:, 0, :1]   # tiny per-step residue
+            c, outs = jax.lax.scan(body, c, None, length=chain)
+            return c, outs
+
+        step = jax.jit(chained, donate_argnums=(2,))
     cache_box = [cache]
 
     def timed(p, xt):
         # The timed unit: one decode step (in-place cache append + masked
         # attention over the full buffer + 4 projections). The cache
         # cycles through the step so donation stays legal. The chained
-        # timing steps exhaust the 64-slot headroom and then CLAMP onto
-        # the last slot (append_kv's documented traced-overflow behavior)
-        # — the per-step cost is identical to a real append (same DMA,
-        # same full-buffer attention), only the buffer contents stop
-        # being meaningful, which timing doesn't read. (An attempt to pin
-        # the length on-device made XLA drop the in-place aliasing for
-        # some configs — whole-buffer copies again; recorded here so it
-        # isn't retried.)
+        # timing steps exhaust the 64-slot headroom and then hit
+        # append_kv's traced-overflow guard (the write-back no-op:
+        # buffers unchanged, length keeps advancing) — the per-step cost
+        # matches a real append (same row read+write, same full-buffer
+        # attention), only the buffer contents stop being meaningful,
+        # which timing doesn't read. (An attempt to pin the length
+        # on-device made XLA drop the in-place aliasing for some configs
+        # — whole-buffer copies again; recorded here so it isn't
+        # retried.)
         c2, out = step(p, xt, cache_box[0])
         cache_box[0] = c2
         return out
@@ -559,19 +694,30 @@ def run_decode(args):
         # clamps to ~0 — a 17 ns "token" is not a measurement. Fall back
         # to the mean, which averages real windows.
         best = mean
+    # One timed call decodes `chain` steps of `b` sequences: a STEP
+    # emits b tokens, so ms_per_token = step_time / b (keeps the key's
+    # round-4 semantics, where b was always 1) and ms_per_step carries
+    # the per-step latency the batched table reads.
+    step_time = best / chain
     cache_bytes = 2 * b * h_kv * t_max * d * jnp.dtype(dtype).itemsize
     record = {
         'mode': 'decode', 't_max': t_max, 'fill': fill, 'heads': h,
         'kv_heads': h_kv, 'head_dim': d, 'dtype': args.dtype,
         'use_rope': args.use_rope, 'world': 1,
+        'batch': b, 'chain': chain,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
-        'ms_per_token': best * 1e3, 'ms_per_token_mean': mean * 1e3,
-        'cache_gb_per_s': cache_bytes / best / 1e9,
+        'ms_per_step': step_time * 1e3,
+        'ms_per_token': step_time / b * 1e3,
+        'ms_per_token_mean': mean / chain / b * 1e3,
+        'tokens_per_s': b * chain / best,
+        'cache_gb_per_s': cache_bytes / step_time / 1e9,
     }
     gq = '' if h_kv == h else f'/kv{h_kv}'
-    print(f"decode t_max={t_max} fill={fill} H={h}{gq} d={d}: "
-          f"{record['ms_per_token']:.3f} ms/token "
+    bc = '' if (b == 1 and chain == 1) else f' B={b} chain={chain}'
+    print(f"decode t_max={t_max} fill={fill} H={h}{gq} d={d}{bc}: "
+          f"{record['ms_per_step']:.3f} ms/step "
+          f"{record['tokens_per_s']:,.0f} tok/s "
           f"({record['cache_gb_per_s']:.0f} GB/s over the cache)")
     _append_record(args.file, record)
     return record
@@ -584,6 +730,8 @@ def run(args):
         return run_train(args)
     if args.mode == 'decode':
         return run_decode(args)
+    if args.mode == 'lm':
+        return run_lm(args)
     mesh = seq_mesh(args.devices)
     world = mesh.devices.size
     t = FULL_T // args.scale

@@ -47,16 +47,20 @@ from distributed_dot_product_tpu.ops.ops import (  # noqa: F401
     LeftTransposeMultiplication,
 )
 from distributed_dot_product_tpu.models.attention import (  # noqa: F401
-    DistributedDotProductAttn, apply_seq_parallel,
+    DistributedDotProductAttn, apply_seq_parallel, decode_seq_parallel,
 )
 from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,
 )
 from distributed_dot_product_tpu.models.decode import (  # noqa: F401
-    DecodeCache, append_kv, decode_attention, init_cache,
+    DecodeCache, append_kv, append_kv_sharded, decode_attention,
+    init_cache,
 )
 from distributed_dot_product_tpu.models.transformer import (  # noqa: F401
     TransformerBlock, TransformerStack,
+)
+from distributed_dot_product_tpu.models.lm import (  # noqa: F401
+    TransformerLM, greedy_generate, lm_targets,
 )
 from distributed_dot_product_tpu.models.ulysses_attention import (  # noqa: F401
     ulysses_attention,

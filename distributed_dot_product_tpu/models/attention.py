@@ -58,7 +58,8 @@ from distributed_dot_product_tpu.ops.pallas_attention import flash_attention
 from distributed_dot_product_tpu.ops.ops import matmul_all, matmul_nt
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
-__all__ = ['DistributedDotProductAttn', 'apply_seq_parallel']
+__all__ = ['DistributedDotProductAttn', 'apply_seq_parallel',
+           'decode_seq_parallel']
 
 
 class DistributedDotProductAttn(nn.Module):
@@ -653,6 +654,33 @@ class DistributedDotProductAttn(nn.Module):
             seg_q=segment_ids)
         return cache, self._merge_decode_heads(out)
 
+    def decode_sharded(self, keys, queries, values, cache,
+                       segment_ids=None, seg_cache=None, axis_name=None):
+        """Sequence-sharded :meth:`decode` (run inside a ``shard_map``;
+        :func:`decode_seq_parallel` wraps global arrays): the KV cache
+        is slab-sharded on its ``t_max`` axis across the mesh — serving
+        context scales past one chip's HBM — with the new token's write
+        landing on the owning shard and the softmax merged by the
+        flash-decoding pmax/psum rule (see
+        :func:`~distributed_dot_product_tpu.models.decode.decode_attention`).
+        Inputs/projections are replicated; ``seg_cache`` (if used) is
+        the slab's LOCAL ``(B, t_max/N)`` shard. Same knob coverage as
+        ``decode``; bit-for-tolerance parity with it is pinned by
+        tests/test_decode_sharded.py."""
+        from distributed_dot_product_tpu.models.decode import (
+            append_kv_sharded, decode_attention,
+        )
+        ax = axis_name or self.axis_name
+        keys, queries, values = self._project_for_decode(
+            keys, queries, values, cache)
+        cache = append_kv_sharded(cache, queries, values, axis_name=ax)
+        out = decode_attention(
+            keys, cache, scale=1.0 / math.sqrt(self.head_dim),
+            window=self.window, alibi_slopes=self.alibi_slopes,
+            qk_quant=self.qk_quant, segment_ids=seg_cache,
+            seg_q=segment_ids, axis_name=ax)
+        return cache, self._merge_decode_heads(out)
+
 
 def apply_seq_parallel(module, params, mesh, keys, queries, values,
                        attn_mask=None, mesh_axis=None, segment_ids=None,
@@ -690,3 +718,30 @@ def apply_seq_parallel(module, params, mesh, keys, queries, values,
         out_specs=act_spec, check_vma=False,
     )(params, keys, queries, values, attn_mask, segment_ids,
       dropout_seed, drop_key)
+
+
+def decode_seq_parallel(module, params, mesh, keys, queries, values,
+                        cache, mesh_axis=None):
+    """One sequence-sharded decode step on **global** arrays: the KV
+    cache is slab-sharded on its ``t_max`` axis over the mesh (build it
+    with ``module.make_decode_cache(batch, t_max_global)`` and let this
+    wrapper shard it), the new token's operands and the output are
+    replicated. Returns ``(cache, out)`` with the cache still sharded —
+    feed it straight back in for the next token. Serving memory then
+    scales linearly with mesh size (the slab per chip is ``t_max/N``),
+    which is the whole point: one chip's HBM stops bounding the serving
+    context."""
+    mesh_axis = mesh_axis or module.axis_name
+    cache_spec = jax.tree.map(
+        lambda x: (P(None, None, mesh_axis, None) if x.ndim == 4
+                   else P()), cache)
+
+    def fn(p, k, q, v, c):
+        return module.apply(p, k, q, v, c, method='decode_sharded',
+                            axis_name=mesh_axis)
+
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), cache_spec),
+        out_specs=(cache_spec, P()), check_vma=False,
+    )(params, keys, queries, values, cache)

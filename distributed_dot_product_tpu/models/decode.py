@@ -51,7 +51,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ['DecodeCache', 'init_cache', 'append_kv', 'decode_attention']
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+__all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
+           'decode_attention']
 
 
 class DecodeCache(NamedTuple):
@@ -160,9 +163,74 @@ def append_kv(cache: DecodeCache, k_new, v_new) -> DecodeCache:
         length=cache.length + n, k_q=k_q, k_scale=k_scale)
 
 
+def append_kv_sharded(cache: DecodeCache, k_new, v_new, *,
+                      axis_name=SEQ_AXIS):
+    """Sequence-sharded :func:`append_kv` (inside a ``shard_map``): the
+    cache buffers hold this shard's ``(B, H_kv, t_max/N, d·)`` slab of
+    a global ``N·t_local`` buffer — serving memory scales PAST one
+    chip's HBM — while ``cache.length`` stays the GLOBAL length
+    (replicated; RoPE positions and the causal mask read it).
+
+    Decode (``n == 1``): the write is an in-place single-row
+    ``dynamic_update_slice`` on the OWNING shard and the write-back
+    no-op everywhere else — per-token cost is unchanged from the local
+    path. Prefill (``n > 1``): the chunk may straddle shard boundaries,
+    so each shard rebuilds its slab through a masked gather — O(t_local)
+    traffic, the same order as the prefill attention that follows.
+    Appends past the global capacity write nowhere while ``length``
+    still advances (the :func:`append_kv` overflow contract)."""
+    n = k_new.shape[-2]
+    tl = cache.t_max                       # local slab length
+    lo = lax.axis_index(axis_name) * tl
+    p = cache.length
+    b, h_kv, _, d = cache.k.shape
+
+    k_q_new = k_scale_new = None
+    if cache.k_q is not None:
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        ki, sk = _quantize_rows(k_new.astype(cache.k.dtype), b * h_kv,
+                                n, d)
+        k_q_new = ki.reshape(b, h_kv, n, d)
+        k_scale_new = sk.reshape(b, h_kv, n, 1)
+
+    # Whole-append overflow drop, matching append_kv's contract exactly:
+    # an append that would cross the GLOBAL capacity writes NOTHING
+    # anywhere (not even its in-capacity prefix — the local path drops
+    # the whole chunk, and sharded parity means doing the same).
+    ok = p + n <= lax.psum(1, axis_name) * tl
+    if n == 1:
+        local = jnp.clip(p - lo, 0, tl - 1)
+        owns = jnp.logical_and(jnp.logical_and(p >= lo, p < lo + tl), ok)
+        idx = (jnp.zeros((), jnp.int32),) * 2 + (local,
+                                                 jnp.zeros((), jnp.int32))
+
+        def write(buf, new):
+            cur = lax.dynamic_slice(buf, idx, new.shape)
+            return lax.dynamic_update_slice(
+                buf, jnp.where(owns, new.astype(buf.dtype), cur), idx)
+    else:
+        g = lo + jnp.arange(tl)                       # global slab rows
+        src = jnp.clip(g - p, 0, n - 1)
+        hit = jnp.logical_and(jnp.logical_and(g >= p, g < p + n),
+                              ok)[:, None]
+
+        def write(buf, new):
+            vals = jnp.take(new.astype(buf.dtype), src, axis=-2)
+            return jnp.where(hit, vals, buf)
+
+    k_q = k_scale = None
+    if cache.k_q is not None:
+        k_q = write(cache.k_q, k_q_new)
+        k_scale = write(cache.k_scale, k_scale_new)
+    return DecodeCache(k=write(cache.k, k_new), v=write(cache.v, v_new),
+                       length=cache.length + n, k_q=k_q, k_scale=k_scale)
+
+
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
                      alibi_slopes=None, segment_ids=None, seg_q=None,
-                     qk_quant=None):
+                     qk_quant=None, axis_name=None):
     """One masked-softmax attention step of ``q (B, H, n, d)`` against the
     cache prefix; returns ``(B, H, n, d_v)``.
 
@@ -180,6 +248,16 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     attend. ``qk_quant='int8'`` reproduces the training kernels'
     quantized scoring exactly (see the inline comment). Fully-masked
     rows return 0, matching the training kernels.
+
+    ``axis_name``: sequence-sharded serving (inside a ``shard_map``
+    with the cache slab-sharded on the ``t_max`` axis — see
+    :func:`append_kv_sharded`): each shard scores q against ITS slab,
+    and the softmax merges across shards by the flash-decoding rule
+    (global row max via ``pmax``, then one ``psum`` each for the
+    numerator and denominator — exactly the training kernels' LSE
+    combine, so the merged result equals the unsharded one). ``q`` is
+    replicated; ``segment_ids`` (when used) is the slab's local shard;
+    ``cache.length`` is global.
     """
     b, h, n, d = q.shape
     h_kv = cache.k.shape[1]
@@ -221,9 +299,12 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     s = s.reshape(b, h_kv, group, n, t_max)
 
     # Query row i (0-based within the n new rows) sits at absolute
-    # position length - n + i; it attends positions <= its own.
+    # position length - n + i; it attends positions <= its own. Sharded,
+    # this slab's columns sit at global offset shard·t_local.
+    col_off = (0 if axis_name is None
+               else lax.axis_index(axis_name) * t_max)
     pos_q = cache.length - n + jnp.arange(n)                # (n,)
-    pos_k = jnp.arange(t_max)                               # (t_max,)
+    pos_k = col_off + jnp.arange(t_max)                     # (t_local,)
     allowed = pos_k[None, :] <= pos_q[:, None]              # (n, t_max)
     if window is not None:
         allowed = jnp.logical_and(
@@ -243,9 +324,23 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
             jnp.float32)
     s = jnp.where(allowed, s, -jnp.inf)
     m = jnp.max(s, axis=-1, keepdims=True)
+    if axis_name is not None:
+        # Flash-decoding merge: shift every shard's weights by the
+        # GLOBAL row max, then the numerator/denominator sums are plain
+        # psums (a shard whose slab is entirely masked/unfilled
+        # contributes exp(-inf − m) = 0).
+        m = lax.pmax(m, axis_name)
     m_safe = jnp.maximum(m, jnp.float32(-1e30))             # empty rows
     p = jnp.exp(s - m_safe)
     denom = jnp.sum(p, axis=-1, keepdims=True)
-    p = p / jnp.where(denom == 0.0, 1.0, denom)
-    out = jnp.einsum('bhgqt,bhtd->bhgqd', p.astype(cache.v.dtype), cache.v)
-    return out.reshape(b, h, n, cache.v.shape[-1])
+    if axis_name is None:
+        p = p / jnp.where(denom == 0.0, 1.0, denom)
+        out = jnp.einsum('bhgqt,bhtd->bhgqd', p.astype(cache.v.dtype),
+                         cache.v)
+        return out.reshape(b, h, n, cache.v.shape[-1])
+    num = jnp.einsum('bhgqt,bhtd->bhgqd', p,
+                     cache.v.astype(jnp.float32))
+    num = lax.psum(num, axis_name)
+    denom = lax.psum(denom, axis_name)        # (…, n, 1): broadcasts
+    out = num / jnp.where(denom == 0.0, 1.0, denom)
+    return out.reshape(b, h, n, cache.v.shape[-1]).astype(cache.v.dtype)

@@ -1,0 +1,264 @@
+# -*- coding: utf-8 -*-
+"""
+A causal language model over the sequence-parallel transformer stack —
+the framework's capstone composition.
+
+The reference stops at one attention layer (reference module.py:22-76);
+a framework claiming its capabilities must prove the composition trains
+something real. This module is that proof: token embedding →
+:class:`~distributed_dot_product_tpu.models.transformer.TransformerStack`
+(scanned, remat-able, every attention knob available) → final LayerNorm
+→ tied LM head, trained with next-token cross-entropy over packed
+segments and decoded through the stack's KV caches.
+
+TPU-first notes:
+
+- Everything outside attention is position-wise, so the whole model runs
+  under the same time-axis ``shard_map`` as one attention layer; the
+  embedding table and LM head are replicated parameters whose gradients
+  ride the same cross-shard ``psum`` as every other weight.
+- The LM head is the transposed embedding (``embed.attend``) by default
+  — one (dim, vocab) matmul on the MXU, half the parameter bytes, the
+  standard weight-tying win.
+- Cross-entropy masks ``target < 0`` (ignore positions): the natural
+  encoding for packed segments, where each segment's LAST token must not
+  predict the next segment's first. Target construction is a GLOBAL
+  (pre-shard) concern — see :func:`lm_targets` — because the shift
+  crosses shard boundaries.
+- Generation: ``prefill`` ingests the prompt through the stack's flash
+  kernels; ``decode`` is the one-token cached step. Both return logits,
+  so sampling loops (greedy here; any sampler outside) stay trivial.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.models.transformer import (
+    TransformerStack,
+)
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+__all__ = ['TransformerLM', 'greedy_generate', 'lm_targets']
+
+
+def lm_targets(tokens, segment_ids=None, pad_id=None):
+    """Next-token targets for ``tokens (B, T)``: ``targets[t] =
+    tokens[t+1]``, with ignore (−1) at the final position, at segment
+    boundaries (a segment's last token must not predict the next
+    segment's first — packed-sequence training's correctness subtlety),
+    and at padding. GLOBAL arrays in, global out: the shift crosses
+    shard boundaries, so build targets before sharding (the train step
+    shards them like any activation)."""
+    t = tokens.shape[-1]
+    nxt = jnp.roll(tokens, -1, axis=-1)
+    ignore = jnp.zeros(tokens.shape, bool).at[..., t - 1].set(True)
+    if segment_ids is not None:
+        boundary = segment_ids != jnp.roll(segment_ids, -1, axis=-1)
+        ignore = jnp.logical_or(ignore, boundary)
+    if pad_id is not None:
+        ignore = jnp.logical_or(ignore, nxt == pad_id)
+        ignore = jnp.logical_or(ignore, tokens == pad_id)
+    return jnp.where(ignore, -1, nxt)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: embed → stack → LayerNorm → (tied) head.
+
+    ``attn_kwargs`` passes to the stack's attention modules;
+    ``causal=True``, ``softmax_impl='flash'`` and ``use_rope=True`` are
+    defaulted in (a language model without causality is an error — pass
+    them explicitly to override the other two). ``scan_layers``/
+    ``remat``/``remat_policy`` forward to the stack (deep models compile
+    O(1) in depth and fit backward memory per layer).
+
+    Call: ``apply(params, tokens (B, T/N int32), segment_ids=None,
+    deterministic=False, dropout_seed=None) -> logits (B, T/N, vocab)``
+    — local shards under ``shard_map`` like every module here; use
+    :func:`~distributed_dot_product_tpu.train.make_lm_train_step` for
+    global arrays on a mesh.
+    """
+    vocab_size: int
+    dim: int
+    num_heads: int
+    n_layers: int = 2
+    mlp_ratio: int = 4
+    axis_name: str = SEQ_AXIS
+    dtype: Optional[jnp.dtype] = None
+    attn_kwargs: Any = None
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: Optional[str] = None
+    tie_embeddings: bool = True
+
+    def _attn_kw(self):
+        """The stack's attention kwargs with the LM defaults applied —
+        plain field arithmetic (shared by ``setup`` and the
+        outside-apply cache constructor)."""
+        kw = dict(self.attn_kwargs or {})
+        if not kw.setdefault('causal', True):
+            raise ValueError('TransformerLM is autoregressive: '
+                             'causal=False makes no sense here')
+        kw.setdefault('softmax_impl', 'flash')
+        kw.setdefault('use_rope', True)
+        return kw
+
+    def _stack_fields(self):
+        return dict(dim=self.dim, num_heads=self.num_heads,
+                    n_layers=self.n_layers, mlp_ratio=self.mlp_ratio,
+                    axis_name=self.axis_name, dtype=self.dtype,
+                    attn_kwargs=self._attn_kw(),
+                    scan_layers=self.scan_layers, remat=self.remat,
+                    remat_policy=self.remat_policy)
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.dim,
+                              dtype=self.dtype, name='embed')
+        self.stack = TransformerStack(**self._stack_fields(),
+                                      name='stack')
+        self.ln_f = nn.LayerNorm(dtype=self.dtype, name='ln_f')
+        if not self.tie_embeddings:
+            # An explicit (dim, vocab) kernel rather than nn.Dense: the
+            # chunked loss below reads the table directly (a bound
+            # Dense doesn't expose its kernel), and a bias on an LM
+            # head is non-standard anyway.
+            self.lm_head_kernel = self.param(
+                'lm_head_kernel', nn.initializers.lecun_normal(),
+                (self.dim, self.vocab_size), jnp.float32)
+
+    def _head_table(self):
+        """(vocab, dim) logit table — the tied embedding or the
+        transposed explicit head kernel."""
+        if self.tie_embeddings:
+            return self.embed.embedding
+        return self.lm_head_kernel.T
+
+    def _head(self, x):
+        x = self.ln_f(x)
+        # logits = x · Eᵀ on the MXU, fp32 accumulation.
+        return jnp.einsum('...d,vd->...v', x,
+                          self._head_table().astype(x.dtype))
+
+    def __call__(self, tokens, segment_ids=None, deterministic=False,
+                 dropout_seed=None):
+        x = self.embed(tokens.astype(jnp.int32))
+        x = self.stack(x, x, x, None, segment_ids=segment_ids,
+                       deterministic=deterministic,
+                       dropout_seed=dropout_seed)
+        return self._head(x)
+
+    def nll_sum(self, tokens, targets, segment_ids=None,
+                deterministic=False, dropout_seed=None, chunk=None):
+        """Summed next-token negative log-likelihood + valid-token
+        count for this shard — the training loss primitive
+        (:func:`~distributed_dot_product_tpu.train.make_lm_train_step`
+        psums both and divides).
+
+        ``chunk``: CHUNKED cross-entropy — the loss scans row chunks of
+        the final hidden states, computing each chunk's ``(C, vocab)``
+        logits + logsumexp inside a ``jax.checkpoint`` so neither pass
+        ever materializes the full ``(T, vocab)`` logits (fp32 logits
+        at T=131K × 32K vocab are 17 GiB — measured OOM on a 16 GiB
+        chip; chunked, the live score memory is O(chunk·vocab)).
+        ``None`` = unchunked (fine at short T)."""
+        x = self.embed(tokens.astype(jnp.int32))
+        x = self.stack(x, x, x, None, segment_ids=segment_ids,
+                       deterministic=deterministic,
+                       dropout_seed=dropout_seed)
+        x = self.ln_f(x)
+        table = self._head_table().astype(jnp.float32)
+        tn = x.shape[-2]
+        targets = targets.astype(jnp.int32)
+
+        def chunk_nll(x_c, t_c):
+            logits = jnp.einsum('...cd,vd->...cv',
+                                x_c.astype(jnp.float32), table)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            valid = t_c >= 0
+            ll = jnp.take_along_axis(
+                logits, jnp.where(valid, t_c, 0)[..., None],
+                -1)[..., 0]
+            s = jnp.sum(jnp.where(valid, lse - ll, 0.0))
+            return s, jnp.sum(valid.astype(jnp.float32))
+
+        if chunk is None or chunk >= tn:
+            return chunk_nll(x, targets)
+        pad = (-tn) % chunk
+        if pad:
+            x = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)])
+            targets = jnp.pad(targets, [(0, 0)] * (targets.ndim - 1)
+                              + [(0, pad)], constant_values=-1)
+        n = (tn + pad) // chunk
+        xr = jnp.moveaxis(x.reshape(*x.shape[:-2], n, chunk,
+                                    x.shape[-1]), -3, 0)
+        tr = jnp.moveaxis(targets.reshape(*targets.shape[:-1], n, chunk),
+                          -2, 0)
+
+        @jax.checkpoint
+        def body(carry, xs):
+            s, c = chunk_nll(*xs)
+            return (carry[0] + s, carry[1] + c), None
+
+        (s, c), _ = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0)), (xr, tr))
+        return s, c
+
+    # -- cached generation --------------------------------------------
+
+    def make_decode_caches(self, batch, t_max, dtype=None):
+        """KV caches for generation (stacked pytree when
+        ``scan_layers``, else a list) — plain field arithmetic, no
+        ``apply`` needed (a throwaway stack instance reads the same
+        fields; ``self.stack`` only exists inside apply, and
+        ``parent=None`` keeps flax from adopting the throwaway as a
+        child of this module)."""
+        stack = TransformerStack(**self._stack_fields(), parent=None)
+        return stack.make_decode_caches(batch, t_max, dtype=dtype)
+
+    def prefill(self, tokens, caches):
+        """Ingest a prompt chunk: returns ``(caches, logits (B, n,
+        vocab))`` — the last position's logits seed generation."""
+        x = self.embed(tokens.astype(jnp.int32))
+        caches, x = self.stack.prefill(x, caches)
+        return caches, self._head(x)
+
+    def decode(self, tokens, caches):
+        """One cached generation step for ``tokens (B, 1)``."""
+        x = self.embed(tokens.astype(jnp.int32))
+        caches, x = self.stack.decode(x, caches)
+        return caches, self._head(x)
+
+
+def greedy_generate(model, params, prompt, steps, t_max, donate=True):
+    """Greedy sampling through the KV caches: prefill the prompt, then
+    ``steps`` jitted decode steps (cache donated so appends write in
+    place — see models/decode.py). Returns ``(B, steps) int32``.
+
+    A deliberately simple reference sampler (argmax); the
+    ``prefill``/``decode`` surface returns full logits, so temperature /
+    top-k samplers are a drop-in replacement outside the model."""
+    b, n = prompt.shape
+    if steps < 1:
+        raise ValueError(f'steps must be >= 1, got {steps} (the prefill '
+                         'logits already commit the first token)')
+    if n + steps > t_max:
+        raise ValueError(f'prompt {n} + steps {steps} exceeds t_max '
+                         f'{t_max}')
+    caches = model.make_decode_caches(b, t_max)
+    caches, logits = jax.jit(
+        lambda p, tok, c: model.apply(p, tok, c, method='prefill')
+    )(params, prompt, caches)
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+
+    def step(p, tok, c):
+        c, logits = model.apply(p, tok, c, method='decode')
+        return c, jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+
+    step = jax.jit(step, donate_argnums=(2,) if donate else ())
+    out = [tok]
+    for _ in range(steps - 1):
+        caches, tok = step(params, tok, caches)
+        out.append(tok)
+    return jnp.concatenate(out, axis=1)

@@ -20,14 +20,19 @@ cache per layer through the module's ``prefill``/``decode`` surface.
 TPU-first notes: the MLP/LayerNorm are plain flax (XLA fuses them; the
 attention kernels are where hand-written Pallas pays), activations stay
 in the module ``dtype`` (bf16 on chip) with fp32 LayerNorm statistics
-(flax's default), and the block is scan-free — layers unroll at trace
-time, which XLA handles fine at demo depths (wrap in ``nn.scan`` for
-hundred-layer stacks).
+(flax's default). Layers either unroll at trace time (fine at demo
+depths) or — ``scan_layers=True`` — run as ONE ``nn.scan`` over a
+single block with layer-stacked parameters: trace/compile time is
+O(1) in depth, and the ``remat`` knob wraps the block in
+``jax.checkpoint`` so backward score memory is one layer's, not the
+stack's (``remat_policy`` names a ``jax.checkpoint_policies`` entry,
+e.g. ``'dots_saveable'``, for partial rematerialization).
 """
 
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_dot_product_tpu.models.attention import (
@@ -92,6 +97,51 @@ class TransformerBlock(nn.Module):
         return cache, x + self._mlp(self.ln2(x))
 
 
+class _ScanStackCore(nn.Module):
+    """The scanned layer body: ONE :class:`TransformerBlock` whose three
+    entry points (train forward, prefill, decode) are each lifted by
+    ``nn.scan`` with their own axes — all binding the same ``block``
+    child, so one layer-stacked parameter tree serves training and
+    cached generation.
+
+    ``layer``'s layer index arrives as the SCANNED input and salts the
+    explicit dropout seed: a scanned stack's layers all share one flax
+    module path, so the attention module's path-hash salt (attention.py,
+    per-layer decorrelation) cannot tell them apart — the index fold
+    does the same job."""
+    dim: int
+    num_heads: int
+    mlp_ratio: int
+    axis_name: str
+    dtype: Any
+    attn_kwargs: Any
+
+    def setup(self):
+        self.block = TransformerBlock(
+            dim=self.dim, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, axis_name=self.axis_name,
+            dtype=self.dtype, attn_kwargs=self.attn_kwargs, name='block')
+
+    def layer(self, x, layer_idx, attn_mask, segment_ids, deterministic,
+              dropout_seed):
+        seed = None
+        if dropout_seed is not None:
+            seed = jnp.bitwise_xor(
+                jnp.asarray(dropout_seed, jnp.int32),
+                layer_idx * jnp.int32(0x61C88647))
+        return self.block(x, attn_mask, segment_ids=segment_ids,
+                          deterministic=deterministic,
+                          dropout_seed=seed), None
+
+    def prefill(self, x, cache):
+        cache, x = self.block.prefill(x, cache)
+        return x, cache
+
+    def decode(self, x, cache):
+        cache, x = self.block.decode(x, cache)
+        return x, cache
+
+
 class TransformerStack(nn.Module):
     """``n_layers`` blocks. Call signature mirrors the train-step
     contract — ``(keys, queries, values, attn_mask, ...)`` with the
@@ -100,7 +150,18 @@ class TransformerStack(nn.Module):
     attention module. ``make_decode_caches``/``prefill``/``decode``
     carry one KV cache per layer (a model trained with this stack
     generates through them; stacked layers sharing an explicit
-    ``dropout_seed`` draw distinct masks via the per-layer salt)."""
+    ``dropout_seed`` draw distinct masks via the per-layer salt).
+
+    ``scan_layers=True`` compiles the stack as one ``nn.scan`` over a
+    single block with layer-stacked parameters
+    (``params['layers']['block']`` with a leading ``n_layers`` axis vs
+    the unrolled ``block_i`` subtrees) — same math, O(1) trace/compile
+    in depth; generation scans the stacked KV caches the same way.
+    ``remat=True`` (scan only) wraps the block in ``jax.checkpoint`` so
+    the backward rematerializes one layer at a time — activation memory
+    for the stack drops from O(n_layers) to O(1) layers plus the scan
+    carry; ``remat_policy`` selects a ``jax.checkpoint_policies`` name
+    (e.g. ``'dots_saveable'``) for partial remat."""
     dim: int
     num_heads: int
     n_layers: int = 2
@@ -108,15 +169,52 @@ class TransformerStack(nn.Module):
     axis_name: str = SEQ_AXIS
     dtype: Optional[jnp.dtype] = None
     attn_kwargs: Any = None
+    scan_layers: bool = False
+    remat: bool = False
+    remat_policy: Optional[str] = None
 
     def setup(self):
-        self.blocks = [
-            TransformerBlock(dim=self.dim, num_heads=self.num_heads,
-                             mlp_ratio=self.mlp_ratio,
-                             axis_name=self.axis_name, dtype=self.dtype,
-                             attn_kwargs=self.attn_kwargs,
-                             name=f'block_{i}')
-            for i in range(self.n_layers)]
+        if self.remat and not self.scan_layers:
+            raise ValueError('remat=True requires scan_layers=True (the '
+                             'unrolled stack has no scan body to wrap)')
+        if self.remat_policy is not None and not hasattr(
+                jax.checkpoint_policies, self.remat_policy):
+            raise ValueError(
+                f'remat_policy {self.remat_policy!r} is not a '
+                f'jax.checkpoint_policies name')
+        if not self.scan_layers:
+            self.blocks = [
+                TransformerBlock(dim=self.dim, num_heads=self.num_heads,
+                                 mlp_ratio=self.mlp_ratio,
+                                 axis_name=self.axis_name,
+                                 dtype=self.dtype,
+                                 attn_kwargs=self.attn_kwargs,
+                                 name=f'block_{i}')
+                for i in range(self.n_layers)]
+            return
+        core = _ScanStackCore
+        if self.remat:
+            policy = (getattr(jax.checkpoint_policies, self.remat_policy)
+                      if self.remat_policy else None)
+            # static_argnums indexes layer()'s args after self:
+            # deterministic (a Python bool) is arg 4.
+            core = nn.remat(core, policy=policy, prevent_cse=False,
+                            static_argnums=(4,), methods=['layer'])
+        bcast = nn.broadcast
+        common = dict(variable_axes={'params': 0},
+                      split_rngs={'params': True, 'dropout': True},
+                      length=self.n_layers)
+        self.layers = nn.scan(
+            core,
+            methods={
+                'layer': dict(in_axes=(0, bcast, bcast, bcast, bcast),
+                              **common),
+                'prefill': dict(in_axes=0, out_axes=0, **common),
+                'decode': dict(in_axes=0, out_axes=0, **common),
+            })(dim=self.dim, num_heads=self.num_heads,
+               mlp_ratio=self.mlp_ratio, axis_name=self.axis_name,
+               dtype=self.dtype, attn_kwargs=self.attn_kwargs,
+               name='layers')
 
     def __call__(self, keys, queries, values, attn_mask=None,
                  segment_ids=None, deterministic=False,
@@ -124,6 +222,11 @@ class TransformerStack(nn.Module):
         # keys/queries/values are accepted for train-step signature
         # parity; a transformer block is self-attention on one stream.
         x = keys
+        if self.scan_layers:
+            x, _ = self.layers.layer(
+                x, jnp.arange(self.n_layers, dtype=jnp.int32),
+                attn_mask, segment_ids, deterministic, dropout_seed)
+            return x
         for block in self.blocks:
             x = block(x, attn_mask, segment_ids=segment_ids,
                       deterministic=deterministic,
@@ -133,18 +236,26 @@ class TransformerStack(nn.Module):
     def make_decode_caches(self, batch, t_max, dtype=None):
         # Plain field arithmetic (no proto Module: flax would try to
         # register it as a child of this one) — same layout rule as
-        # DistributedDotProductAttn.make_decode_cache.
+        # DistributedDotProductAttn.make_decode_cache. Scanned stacks
+        # get ONE cache pytree with a leading layer axis (the scanned
+        # input of the generation scan); unrolled stacks a list.
         from distributed_dot_product_tpu.models.decode import init_cache
         kw = dict(self.attn_kwargs or {})
         kv_heads = kw.get('num_kv_heads') or self.num_heads
         head_dim = self.dim // self.num_heads
-        return [init_cache(batch, kv_heads, t_max, head_dim,
-                           dtype=(dtype or kw.get('dtype') or self.dtype
-                                  or jnp.float32),
-                           qk_quant=kw.get('qk_quant'))
-                for _ in range(self.n_layers)]
+        caches = [init_cache(batch, kv_heads, t_max, head_dim,
+                             dtype=(dtype or kw.get('dtype') or self.dtype
+                                    or jnp.float32),
+                             qk_quant=kw.get('qk_quant'))
+                  for _ in range(self.n_layers)]
+        if self.scan_layers:
+            return jax.tree.map(lambda *xs: jnp.stack(xs), *caches)
+        return caches
 
     def prefill(self, x, caches):
+        if self.scan_layers:
+            x, caches = self.layers.prefill(x, caches)
+            return caches, x
         out = []
         for block, cache in zip(self.blocks, caches):
             cache, x = block.prefill(x, cache)
@@ -152,6 +263,9 @@ class TransformerStack(nn.Module):
         return out, x
 
     def decode(self, x, caches):
+        if self.scan_layers:
+            x, caches = self.layers.decode(x, caches)
+            return caches, x
         out = []
         for block, cache in zip(self.blocks, caches):
             cache, x = block.decode(x, cache)

@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
-__all__ = ['make_train_step', 'mse_loss']
+__all__ = ['make_train_step', 'make_lm_train_step', 'mse_loss']
 
 
 def mse_loss(pred, target):
@@ -104,21 +104,101 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
         check_vma=False)
 
     def step(params, opt_state, batch, dropout_seed=None):
-        if dropout_seed is None:
-            if needs_seed:
-                raise ValueError(
-                    'this module has dropout_rate > 0: pass '
-                    'dropout_seed=<step counter> to every step() call — '
-                    'a constant fallback would reuse ONE dropout mask '
-                    'for the whole run (silently correlated dropout)')
-            dropout_seed = 0
+        dropout_seed = _resolve_dropout_seed(needs_seed, dropout_seed)
         keys, queries, values, mask, target, *rest = batch
         seg = rest[0] if rest else None
         return sharded(params, opt_state, keys, queries, values, mask,
-                       target, seg, jnp.asarray(dropout_seed, jnp.int32))
+                       target, seg, dropout_seed)
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_lm_train_step(model, optimizer, mesh, seq_axis=SEQ_AXIS,
+                       data_axis=None, donate=True, loss_chunk=4096):
+    """Sharded next-token training step for a
+    :class:`~distributed_dot_product_tpu.models.lm.TransformerLM`.
+
+    Returns ``step(params, opt_state, batch, dropout_seed=None) ->
+    (params, opt_state, loss)`` with ``batch = (tokens, targets)`` or
+    ``(tokens, targets, segment_ids)`` — GLOBAL ``(B, T)`` int arrays
+    (build ``targets`` with
+    :func:`~distributed_dot_product_tpu.models.lm.lm_targets` BEFORE
+    sharding: the next-token shift crosses shard boundaries). Tokens
+    shard ``(batch→data, time→seq)``; parameters/optimizer state stay
+    replicated and their gradients cross-shard ``psum`` exactly as in
+    :func:`make_train_step`.
+
+    The loss is token-mean cross-entropy over valid targets
+    (``target >= 0``): per-shard sums of (-log p, count) are each
+    ``psum``'d so the mean weights every valid token equally however
+    the valid positions distribute across shards — a plain pmean of
+    per-shard means would over-weight shards with few valid tokens.
+    ``loss_chunk`` bounds the live logit memory: the model's
+    ``nll_sum`` scans row chunks of that size with per-chunk remat, so
+    neither pass materializes the (T, vocab) logits (None = unchunked).
+    """
+    axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
+    needs_seed = _module_has_dropout(model)
+
+    def local_step(params, opt_state, tokens, targets, seg, drop_seed):
+        def local_obj(p):
+            loss_sum, count = model.apply(
+                p, tokens, targets, segment_ids=seg,
+                dropout_seed=drop_seed, chunk=loss_chunk,
+                method='nll_sum')
+            # Only the (param-independent) count is psum'd INSIDE the
+            # differentiated objective. A psum of the param-dependent
+            # loss_sum here would inflate every gradient by the axis
+            # size: shard_map transposes psum to psum, so the scalar
+            # cotangent 1/C comes back as W/C (make_train_step's pmean
+            # cancels the same factor with its /W; here the weighting
+            # is by global token count, so the shape is explicit).
+            return loss_sum / jnp.maximum(lax.psum(count, axes), 1.0)
+
+        local_val, grads = jax.value_and_grad(local_obj)(params)
+        # Shard-sum OUTSIDE the grad: the global token-mean loss value…
+        loss = lax.psum(local_val, axes)
+        # …and the true gradient of it (sum of per-shard partials).
+        grads = lax.psum(grads, axes)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    tok_spec = (P(None, seq_axis) if data_axis is None
+                else P(data_axis, seq_axis))
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), tok_spec, tok_spec, tok_spec, P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    def step(params, opt_state, batch, dropout_seed=None):
+        dropout_seed = _resolve_dropout_seed(needs_seed, dropout_seed)
+        tokens, targets, *rest = batch
+        seg = rest[0] if rest else None
+        return sharded(params, opt_state, tokens, targets, seg,
+                       dropout_seed)
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def _resolve_dropout_seed(needs_seed, dropout_seed):
+    """Shared missing-seed policy for every train-step builder: a
+    dropout-enabled module without an explicit per-step seed is an
+    error (a constant fallback would reuse ONE dropout mask for the
+    whole run — silently correlated dropout); modules without dropout
+    get the free constant."""
+    if dropout_seed is None:
+        if needs_seed:
+            raise ValueError(
+                'this module has dropout_rate > 0: pass '
+                'dropout_seed=<step counter> to every step() call — '
+                'a constant fallback would reuse ONE dropout mask '
+                'for the whole run (silently correlated dropout)')
+        dropout_seed = 0
+    return jnp.asarray(dropout_seed, jnp.int32)
 
 
 def _module_has_dropout(module):

@@ -172,6 +172,33 @@ SWEEPS = [
         '--heads', str(h), '--no-mask', '--seq-len', tlen])
       for h in (12, 6, 3)
       for tag, tlen in (('16k', '16384'), ('75k', '75000'))],
+    # --- round-5: chained decode (tokens per dispatch amortize the
+    # per-dispatch floor) + batched serving — the GQA-wins records ---
+    *[(f'decode_benchmark_128k{suff}_chain{kv}',
+       ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
+        '--heads', '8', '--head-dim', '96', '--decode-chain', '32']
+       + extra + kvx)
+      for suff, extra in (('', []), ('_b8', ['--batch', '8']))
+      for kv, kvx in (('', []), ('_kv2', ['--kv-heads', '2']))],
+    # --- round-5: LM capstone training (embed → scanned+remat stack →
+    # tied head → chunked cross-entropy, one SPMD program) ---
+    ('lm_32k',
+     ['--mode', 'lm', '--dtype', 'bf16', '--seq-len', '32768',
+      '--layers', '8', '--remat']),
+    ('lm_128k_16l',
+     ['--mode', 'lm', '--dtype', 'bf16', '--seq-len', '131072',
+      '--layers', '16', '--remat', '--iters', '2']),
+    # --- round-5: the dense-mask cost pairs (masked vs no-mask at three
+    # lengths, measured back-to-back — the mask-share analysis data) ---
+    ('train_benchmark_flash_32k_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '32768', '--no-mask']),
+    ('train_benchmark_flash_65k',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '65536']),
+    ('train_benchmark_flash_65k_nomask',
+     ['--mode', 'train', '--attn-impl', 'flash', '--dtype', 'bf16',
+      '--seq-len', '65536', '--no-mask']),
 ]
 
 

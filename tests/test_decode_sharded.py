@@ -1,0 +1,187 @@
+# -*- coding: utf-8 -*-
+"""
+Sequence-sharded decode (round 5): the KV cache slab-sharded on its
+t_max axis over the mesh, appends landing on the owning shard, softmax
+merged by the flash-decoding pmax/psum rule. Contract: bit-for-tolerance
+parity with the LOCAL decode path for every knob, through both the op
+layer and the module surface, including prefill chunks that straddle
+shard boundaries.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_dot_product_tpu import DistributedDotProductAttn
+from distributed_dot_product_tpu.models.attention import (
+    decode_seq_parallel,
+)
+from distributed_dot_product_tpu.models.decode import (
+    append_kv, append_kv_sharded, decode_attention, init_cache,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+
+WORLD, B, H, D = 4, 2, 4, 16
+T_MAX = 32                       # global capacity; 8 per shard
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return seq_mesh(WORLD)
+
+
+def _cache_spec(cache):
+    return jax.tree.map(
+        lambda x: P(None, None, 'seq', None) if x.ndim == 4 else P(),
+        cache)
+
+
+def _sharded_append_then_attend(mesh, cache, ks, vs, q, **kw):
+    """Append each (k, v) chunk through append_kv_sharded, then one
+    merged decode_attention — all inside a single shard_map."""
+    spec = _cache_spec(cache)
+
+    def fn(c, q, *chunks):
+        for k_new, v_new in zip(chunks[::2], chunks[1::2]):
+            c = append_kv_sharded(c, k_new, v_new, axis_name='seq')
+        return c, decode_attention(q, c, axis_name='seq', **kw)
+
+    flat = [x for pair in zip(ks, vs) for x in pair]
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec,) + (P(),) * (1 + len(flat)),
+        out_specs=(spec, P()), check_vma=False)(cache, q, *flat)
+
+
+def _local_append_then_attend(cache, ks, vs, q, **kw):
+    for k_new, v_new in zip(ks, vs):
+        cache = append_kv(cache, k_new, v_new)
+    return cache, decode_attention(q, cache, **kw)
+
+
+@pytest.mark.parametrize('hkv', [H, 1])
+def test_sharded_decode_matches_local(mesh, hkv):
+    keys = jax.random.split(jax.random.key(0), 4)
+    # Prefill chunk of 13 (straddles the 8-wide shard slabs), then two
+    # single-token appends; q attends the 15-deep prefix.
+    k1 = jax.random.normal(keys[0], (B, hkv, 13, D), jnp.float32)
+    v1 = jax.random.normal(keys[1], (B, hkv, 13, D), jnp.float32)
+    k2, v2 = k1[:, :, :1] + 1.0, v1[:, :, :1] - 1.0
+    k3, v3 = k1[:, :, 1:2] * 2.0, v1[:, :, 1:2] * 0.5
+    q = jax.random.normal(keys[2], (B, H, 1, D), jnp.float32)
+
+    local = init_cache(B, hkv, T_MAX, D, dtype=jnp.float32)
+    # The sharded cache is built at GLOBAL capacity; shard_map splits it
+    # into per-shard t_local slabs through the cache spec.
+    shard_global = init_cache(B, hkv, T_MAX, D, dtype=jnp.float32)
+
+    lc, lout = _local_append_then_attend(
+        local, [k1, k2, k3], [v1, v2, v3], q)
+    sc, sout = _sharded_append_then_attend(
+        mesh, shard_global, [k1, k2, k3], [v1, v2, v3], q)
+    assert int(lc.length) == int(sc.length) == 15
+    np.testing.assert_allclose(np.asarray(sout), np.asarray(lout),
+                               atol=2e-5, rtol=1e-5)
+    # The sharded buffers, concatenated, hold exactly the local buffers.
+    np.testing.assert_allclose(np.asarray(sc.k), np.asarray(lc.k),
+                               atol=0)
+    np.testing.assert_allclose(np.asarray(sc.v), np.asarray(lc.v),
+                               atol=0)
+
+
+def test_sharded_decode_knobs_match_local(mesh):
+    """window + ALiBi + int8 through the merged softmax."""
+    keys = jax.random.split(jax.random.key(1), 3)
+    fill = 14
+    k1 = jax.random.normal(keys[0], (B, H, fill, D), jnp.float32)
+    v1 = jax.random.normal(keys[1], (B, H, fill, D), jnp.float32)
+    q = jax.random.normal(keys[2], (B, H, 1, D), jnp.float32)
+    slopes = jnp.asarray([2.0 ** -(i + 1) for i in range(H)])
+    for kw in (dict(window=6), dict(alibi_slopes=slopes),
+               dict(qk_quant='int8')):
+        local = init_cache(B, H, T_MAX, D, dtype=jnp.float32,
+                           qk_quant=kw.get('qk_quant'))
+        shard_global = init_cache(B, H, T_MAX, D, dtype=jnp.float32,
+                                  qk_quant=kw.get('qk_quant'))
+        lc, lout = _local_append_then_attend(local, [k1], [v1], q, **kw)
+        sc, sout = _sharded_append_then_attend(mesh, shard_global,
+                                               [k1], [v1], q, **kw)
+        np.testing.assert_allclose(np.asarray(sout), np.asarray(lout),
+                                   atol=2e-5, rtol=1e-5, err_msg=str(kw))
+
+
+def test_module_decode_sharded_matches_local(mesh):
+    """Module surface: decode_seq_parallel (projections, GQA, RoPE,
+    append, merged attention) == the local module decode, token by
+    token, with the cache staying sharded between steps."""
+    dim = 32
+    model = DistributedDotProductAttn(
+        key_dim=dim, num_heads=4, num_kv_heads=2, causal=True,
+        use_rope=True)
+    x = jax.random.normal(jax.random.key(0), (B, 10, dim), jnp.float32)
+    params = model.init(jax.random.key(1), x, x, x, None)
+
+    local_cache = model.make_decode_cache(B, T_MAX)
+    shard_cache = model.make_decode_cache(B, T_MAX)
+    for t in range(6):
+        xt = x[:, t:t + 1]
+        local_cache, lout = model.apply(params, xt, xt, xt, local_cache,
+                                        method='decode')
+        shard_cache, sout = decode_seq_parallel(
+            model, params, mesh, xt, xt, xt, shard_cache)
+        np.testing.assert_allclose(np.asarray(sout), np.asarray(lout),
+                                   atol=2e-5, rtol=1e-5, err_msg=f't={t}')
+    assert int(shard_cache.length) == 6
+
+
+def test_sharded_straddling_overflow_drops_whole_append(mesh):
+    """A prefill chunk that would CROSS the global capacity writes
+    nothing — not even its in-capacity prefix — exactly like
+    append_kv (the parity the sharded path is pinned to)."""
+    cap = WORLD * 2                      # 8 global slots
+    local = init_cache(1, 1, cap, D, dtype=jnp.float32)
+    shard_global = init_cache(1, 1, cap, D, dtype=jnp.float32)
+    k1 = jnp.ones((1, 1, 6, D), jnp.float32)
+    k2 = jnp.full((1, 1, 4, D), 7.0, jnp.float32)   # 6 + 4 > 8
+    q = jnp.ones((1, 1, 1, D), jnp.float32)
+
+    with pytest.raises(ValueError, match='overflow'):
+        _local_append_then_attend(local, [k1, k2], [k1, k2], q)
+    local2 = init_cache(1, 1, cap, D, dtype=jnp.float32)
+    local2 = append_kv(local2, k1, k1)
+
+    sc, _ = _sharded_append_then_attend(mesh, shard_global,
+                                        [k1, k2], [k1, k2], q)
+    assert int(sc.length) == 10          # length still flags it
+    # Buffers hold ONLY the first append — the straddling chunk wrote
+    # neither its in-capacity rows (6, 7) nor anything else.
+    np.testing.assert_array_equal(np.asarray(sc.k),
+                                  np.asarray(local2.k))
+    np.testing.assert_array_equal(np.asarray(sc.v),
+                                  np.asarray(local2.v))
+
+
+def test_sharded_overflow_advances_length_without_write(mesh):
+    """Appending past the GLOBAL capacity writes nowhere; length still
+    flags it (the append_kv overflow contract, sharded)."""
+    cap = WORLD * 2
+    cache = init_cache(1, 1, cap, D, dtype=jnp.float32)
+    spec = _cache_spec(cache)
+
+    def fn(c, chunk):
+        for i in range(cap + 2):
+            c = append_kv_sharded(c, chunk + i, chunk + i,
+                                  axis_name='seq')
+        return c
+
+    chunk = jnp.ones((1, 1, 1, D), jnp.float32)
+    out = jax.shard_map(fn, mesh=mesh, in_specs=(spec, P()),
+                        out_specs=spec, check_vma=False)(cache, chunk)
+    assert int(out.length) == cap + 2
+    # Slots hold appends 0..cap-1 (values 1..cap); the two overflowing
+    # appends wrote nowhere.
+    np.testing.assert_array_equal(
+        np.asarray(out.k[0, 0, :, 0]),
+        np.arange(1.0, cap + 1, dtype=np.float32))

@@ -1,0 +1,181 @@
+# -*- coding: utf-8 -*-
+"""
+TransformerLM (models/lm.py) — the capstone composition. Contracts:
+target construction respects packed-segment boundaries; the sharded LM
+train step computes EXACTLY the unsharded cross-entropy loss and
+gradient (SGD(1.0) makes the updated params a direct gradient probe);
+the copy task trains below threshold on the 8-device mesh and greedy
+generation through the KV caches reproduces the prefix; checkpoint /
+resume mid-run continues the same trajectory.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_dot_product_tpu import TransformerLM, lm_targets
+from distributed_dot_product_tpu.parallel.mesh import (
+    data_seq_mesh, seq_mesh,
+)
+from distributed_dot_product_tpu.train import make_lm_train_step
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, 'examples'))
+from train_lm import make_copy_batch  # noqa: E402
+
+VOCAB, DIM, HEADS, LAYERS = 32, 32, 4, 2
+
+
+def _model(**kw):
+    kw.setdefault('vocab_size', VOCAB)
+    kw.setdefault('dim', DIM)
+    kw.setdefault('num_heads', HEADS)
+    kw.setdefault('n_layers', LAYERS)
+    return TransformerLM(**kw)
+
+
+def test_lm_targets_shift_boundaries_and_padding():
+    tokens = jnp.asarray([[5, 6, 7, 8, 9, 10]], jnp.int32)
+    seg = jnp.asarray([[0, 0, 0, 1, 1, 1]], jnp.int32)
+    got = lm_targets(tokens, seg)
+    # position 2 is segment 0's last token: must not predict token 8;
+    # the final position has no next token.
+    np.testing.assert_array_equal(np.asarray(got),
+                                  [[6, 7, -1, 9, 10, -1]])
+    got_pad = lm_targets(jnp.asarray([[5, 6, 0, 0]], jnp.int32),
+                         pad_id=0)
+    np.testing.assert_array_equal(np.asarray(got_pad),
+                                  [[6, -1, -1, -1]])
+
+
+def test_lm_forward_shape_and_finite():
+    m = _model(attn_kwargs=dict(distributed=False))
+    toks = jnp.arange(16, dtype=jnp.int32).reshape(1, 16) % VOCAB
+    params = m.init(jax.random.key(0), toks)
+    out = m.apply(params, toks)
+    assert out.shape == (1, 16, VOCAB)
+    assert bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.parametrize('mesh_kind', ['seq', 'data_seq'])
+def test_lm_step_matches_unsharded_loss_and_grad(mesh_kind):
+    """SGD(1.0) probe: sharded step's loss AND updated params must equal
+    the unsharded cross-entropy's (params - grad) — the loss psum /
+    grad psum wiring is exactly the invariant under test."""
+    if mesh_kind == 'seq':
+        mesh, data_axis = seq_mesh(8), None
+    else:
+        mesh, data_axis = data_seq_mesh(2, 4), 'data'
+    b, t = 2, 64
+    tokens, targets, seg = make_copy_batch(jax.random.key(3), b, t,
+                                           VOCAB, 16)
+    m = _model()
+    m_local = _model(attn_kwargs=dict(distributed=False))
+    params = m.init(jax.random.key(1), tokens[:, :16])
+    opt = optax.sgd(1.0)
+    step = make_lm_train_step(m, opt, mesh, data_axis=data_axis,
+                              donate=False)
+    new_params, _, loss = step(params, opt.init(params),
+                               (tokens, targets, seg))
+
+    def local_loss(p):
+        logits = m_local.apply(p, tokens, segment_ids=seg)
+        valid = targets >= 0
+        tgt = jnp.where(valid, targets, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+        return (jnp.sum(jnp.where(valid, nll, 0.0))
+                / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0))
+
+    want_loss, g = jax.value_and_grad(local_loss)(params)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    want = jax.tree.map(lambda p, gg: p - gg, params, g)
+    for got_l, want_l in zip(jax.tree.leaves(new_params),
+                             jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_lm_chunked_nll_matches_unchunked():
+    """Chunked cross-entropy (scan + per-chunk remat) is the same math
+    — values and gradients — including a chunk that doesn't divide T."""
+    m = _model(attn_kwargs=dict(distributed=False))
+    tokens, targets, seg = make_copy_batch(jax.random.key(5), 2, 64,
+                                           VOCAB, 16)
+    params = m.init(jax.random.key(1), tokens[:, :16])
+
+    def loss(p, chunk):
+        s, c = m.apply(p, tokens, targets, segment_ids=seg, chunk=chunk,
+                       method='nll_sum')
+        return s / c
+
+    for chunk in (16, 24, 64, None):
+        np.testing.assert_allclose(float(loss(params, chunk)),
+                                   float(loss(params, None)), rtol=1e-6)
+    g_c = jax.grad(lambda p: loss(p, 24))(params)
+    g_u = jax.grad(lambda p: loss(p, None))(params)
+    for a, b in zip(jax.tree.leaves(g_c), jax.tree.leaves(g_u)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_lm_dropout_requires_seed():
+    mesh = seq_mesh(8)
+    m = _model(attn_kwargs=dict(dropout_rate=0.1))
+    tokens, targets, seg = make_copy_batch(jax.random.key(3), 2, 64,
+                                           VOCAB, 16)
+    params = m.init(jax.random.key(1), tokens[:, :16])
+    opt = optax.adam(1e-3)
+    step = make_lm_train_step(m, opt, mesh, donate=False)
+    with pytest.raises(ValueError, match='dropout_seed'):
+        step(params, opt.init(params), (tokens, targets, seg))
+
+
+@pytest.mark.slow
+def test_lm_copy_task_trains_and_generates_on_mesh():
+    """The capstone criterion: copy-region loss below threshold on the
+    8-device mesh AND greedy generation through the stacked KV caches
+    reproduces the prefix."""
+    from train_lm import main
+    res = main(['--steps', '250', '--seq-len', '128', '--seg-len', '32',
+                '--dim', '64', '--vocab', '32', '--lr', '3e-3',
+                '--log-every', '100', '--remat', '--generate'])
+    assert res['loss'] < 0.5, f'copy loss stayed high: {res}'
+    assert res['acc'] > 0.9, f'generation failed the copy: {res}'
+
+
+@pytest.mark.slow
+def test_lm_checkpoint_resume_continues(tmp_path):
+    """Mid-run save → restore must resume the exact trajectory (same
+    step counter, same params, loss keeps improving)."""
+    from distributed_dot_product_tpu import TrainState, restore, save
+    mesh = seq_mesh(8)
+    b, t = 2, 64
+    m = _model()
+    tokens, targets, seg = make_copy_batch(jax.random.key(7), b, t,
+                                           VOCAB, 16)
+    params = m.init(jax.random.key(1), tokens[:, :16])
+    opt = optax.adam(1e-3)
+    step = make_lm_train_step(m, opt, mesh, donate=False)
+    ost = opt.init(params)
+    for i in range(3):
+        params, ost, loss0 = step(params, ost, (tokens, targets, seg))
+    save(str(tmp_path), TrainState(3, params, ost))
+
+    restored = restore(str(tmp_path), TrainState(0, params, ost))
+    assert restored.step == 3
+    for a, b_ in zip(jax.tree.leaves(restored.params),
+                     jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    p2, o2 = restored.params, restored.opt_state
+    losses = []
+    for i in range(3, 6):
+        p2, o2, loss = step(p2, o2, (tokens, targets, seg))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < float(loss0)

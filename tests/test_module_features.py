@@ -112,10 +112,15 @@ def test_module_feature_validation():
             key_dim=DIM, softmax_impl='flash',
             alibi_slopes=(0.5,), num_heads=1).init(
                 jax.random.key(0), *([jnp.zeros((1, 8, DIM))] * 3), None)
-    with pytest.raises(ValueError, match='flash'):
+    # Round 5: int8 QK^T runs on the ring path too — only the 'full'
+    # parity path still rejects it.
+    with pytest.raises(ValueError, match='online'):
         DistributedDotProductAttn(
-            key_dim=DIM, softmax_impl='online', qk_quant='int8').init(
+            key_dim=DIM, softmax_impl='full', qk_quant='int8').init(
                 jax.random.key(0), *([jnp.zeros((1, 8, DIM))] * 3), None)
+    DistributedDotProductAttn(
+        key_dim=DIM, softmax_impl='online', qk_quant='int8').init(
+            jax.random.key(0), *([jnp.zeros((1, 8, DIM))] * 3), None)
 
 
 def test_module_ulysses_dropout_and_alibi(mesh):

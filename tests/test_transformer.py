@@ -103,6 +103,156 @@ def test_stack_layer_dropout_decorrelates():
         'stacked layers drew identical dropout masks under one seed')
 
 
+# ---------------------------------------------------------------------------
+# scan_layers: one nn.scan over layer-stacked params (round-5)
+# ---------------------------------------------------------------------------
+
+def _scan_params_from_unrolled(params, n_layers):
+    """Stack the unrolled ``block_i`` subtrees into the scanned layout
+    (``layers/block`` with a leading layer axis)."""
+    blocks = [params['params'][f'block_{i}'] for i in range(n_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return {'params': {'layers': {'block': stacked}}}
+
+
+def _scan_stack(dist=True, n_layers=2, scan=True, **kw):
+    attn_kw = dict(causal=True, softmax_impl='flash', distributed=dist,
+                   use_rope=True)
+    return TransformerStack(dim=DIM, num_heads=HEADS, n_layers=n_layers,
+                            attn_kwargs=attn_kw, scan_layers=scan, **kw)
+
+
+def test_scanned_matches_unrolled():
+    """Identical weights through the scanned and unrolled stacks must
+    produce identical outputs (same math, same order)."""
+    x = _x(4)
+    unrolled = _scan_stack(dist=False, scan=False)
+    params = unrolled.init(jax.random.key(0), x[:, :8], x[:, :8],
+                           x[:, :8], None)
+    want = unrolled.apply(params, x, x, x, None)
+    scanned = _scan_stack(dist=False)
+    sp = _scan_params_from_unrolled(params, 2)
+    got = scanned.apply(sp, x, x, x, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize('policy', [None, 'dots_saveable'])
+def test_scanned_remat_matches_unrolled_grads(policy):
+    """remat (full or policy-guided) must not change outputs OR
+    gradients — only the backward's memory schedule."""
+    x = _x(5)
+    unrolled = _scan_stack(dist=False, scan=False)
+    params = unrolled.init(jax.random.key(0), x[:, :8], x[:, :8],
+                           x[:, :8], None)
+    sp = _scan_params_from_unrolled(params, 2)
+    rem = _scan_stack(dist=False, remat=True, remat_policy=policy)
+    got = rem.apply(sp, x, x, x, None)
+    want = unrolled.apply(params, x, x, x, None)
+    # fp32 reassociation in the remat recompute: ~1e-6 drift is expected.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-6)
+
+    def loss_scan(p):
+        return jnp.sum(rem.apply(p, x, x, x, None) ** 2)
+
+    def loss_unroll(p):
+        return jnp.sum(unrolled.apply(p, x, x, x, None) ** 2)
+
+    g_scan = jax.grad(loss_scan)(sp)['params']['layers']['block']
+    g_un = jax.grad(loss_unroll)(params)
+    for i in range(2):
+        for got_l, want_l in zip(
+                jax.tree.leaves(jax.tree.map(lambda a, i=i: a[i], g_scan)),
+                jax.tree.leaves(g_un['params'][f'block_{i}'])):
+            np.testing.assert_allclose(np.asarray(got_l),
+                                       np.asarray(want_l),
+                                       atol=2e-5, rtol=1e-4)
+
+
+def test_scanned_train_step_loss_decreases(mesh):
+    x = _x(6)
+    m = _scan_stack(n_layers=3, remat=True)
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    opt = optax.adam(1e-3)
+    step = make_train_step(m, opt, mesh, donate=False)
+    ost = opt.init(params)
+    target = jnp.roll(x, -1, axis=1)
+    losses = []
+    p = params
+    for _ in range(3):
+        p, ost, loss = step(p, ost, (x, x, x, None, target))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_scanned_cached_generation_matches_forward():
+    """Scanned prefill + decode (KV caches stacked on the layer axis)
+    == the scanned causal forward."""
+    x = _x(7)
+    m = _scan_stack(dist=False)
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    want = m.apply(params, x, x, x, None)
+    caches = m.make_decode_caches(2, T)
+    assert caches.k.shape[0] == 2 and caches.k.ndim == 5  # (L, B, H, T, d)
+    prefill = 40
+    caches, out0 = m.apply(params, x[:, :prefill], caches,
+                           method='prefill')
+    outs = [out0]
+    step = jax.jit(lambda p, xt, c: m.apply(p, xt, c, method='decode'))
+    for t in range(prefill, T):
+        caches, o = step(params, x[:, t:t + 1], caches)
+        outs.append(o)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=5e-5)
+
+
+def test_scanned_dropout_decorrelates_layers():
+    """The scanned stack's layer-index seed fold must decorrelate layers
+    sharing one explicit seed (they share a module path, so the flax
+    path salt cannot)."""
+    x = _x(8)
+    m = TransformerStack(dim=DIM, num_heads=HEADS, n_layers=2,
+                         scan_layers=True,
+                         attn_kwargs=dict(causal=True,
+                                          softmax_impl='flash',
+                                          distributed=False,
+                                          dropout_rate=0.5))
+    params = m.init(jax.random.key(0), x[:, :8], x[:, :8], x[:, :8], None)
+    # Same weights in both layers.
+    shared = jax.tree.map(
+        lambda a: jnp.stack([a[0], a[0]]),
+        params['params']['layers']['block'])
+    sp = {'params': {'layers': {'block': shared}}}
+    out = m.apply(sp, x, x, x, None, dropout_seed=3)
+    one = TransformerStack(dim=DIM, num_heads=HEADS, n_layers=1,
+                           scan_layers=True,
+                           attn_kwargs=dict(causal=True,
+                                            softmax_impl='flash',
+                                            distributed=False,
+                                            dropout_rate=0.5))
+    p1 = {'params': {'layers': {'block': jax.tree.map(
+        lambda a: a[:1], shared)}}}
+    y = one.apply(p1, x, x, x, None, dropout_seed=3)
+    z = one.apply(p1, y, y, y, None, dropout_seed=3)
+    assert not np.allclose(np.asarray(out), np.asarray(z), atol=1e-6), (
+        'scanned layers drew identical dropout masks under one seed')
+
+
+def test_scan_remat_validation():
+    with pytest.raises(ValueError, match='scan_layers'):
+        TransformerStack(dim=DIM, num_heads=HEADS, remat=True).init(
+            jax.random.key(0), jnp.ones((1, 8, DIM)), jnp.ones((1, 8, DIM)),
+            jnp.ones((1, 8, DIM)), None)
+    with pytest.raises(ValueError, match='remat_policy'):
+        TransformerStack(dim=DIM, num_heads=HEADS, scan_layers=True,
+                         remat=True, remat_policy='nope').init(
+            jax.random.key(0), jnp.ones((1, 8, DIM)), jnp.ones((1, 8, DIM)),
+            jnp.ones((1, 8, DIM)), None)
+
+
 def test_stack_cached_generation_matches_forward():
     """Prefill + token-by-token decode through per-layer caches ==
     the stack's causal forward (GQA + RoPE + window on)."""
