@@ -48,6 +48,7 @@ from distributed_dot_product_tpu.ops.ops import (  # noqa: F401
 )
 from distributed_dot_product_tpu.models.attention import (  # noqa: F401
     DistributedDotProductAttn, apply_seq_parallel, decode_seq_parallel,
+    make_decode_step,
 )
 from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,

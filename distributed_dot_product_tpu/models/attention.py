@@ -59,7 +59,7 @@ from distributed_dot_product_tpu.ops.ops import matmul_all, matmul_nt
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['DistributedDotProductAttn', 'apply_seq_parallel',
-           'decode_seq_parallel']
+           'decode_seq_parallel', 'make_decode_step']
 
 
 class DistributedDotProductAttn(nn.Module):
@@ -720,6 +720,38 @@ def apply_seq_parallel(module, params, mesh, keys, queries, values,
       dropout_seed, drop_key)
 
 
+def make_decode_step(module, mesh, mesh_axis=None, donate=True):
+    """Build the sequence-sharded decode step ONCE for a serving loop:
+    ``step(params, keys, queries, values, cache) -> (cache, out)`` with
+    the KV cache slab-sharded on its ``t_max`` axis over the mesh and —
+    ``donate=True`` — DONATED to the jitted step, so the append's
+    ``dynamic_update_slice`` writes the slab in place (without
+    donation each token copies the full K/V slabs first — the same ~1
+    ms/token copy `benchmark.py`'s local decode isolates). Reuse the
+    returned step across tokens; rebuilding it per token would re-trace
+    the whole module apply each time."""
+    mesh_axis = mesh_axis or module.axis_name
+    from distributed_dot_product_tpu.models.decode import DecodeCache
+    spec4 = P(None, None, mesh_axis, None)
+    quant = module.qk_quant == 'int8'
+    cache_spec = DecodeCache(k=spec4, v=spec4, length=P(),
+                             k_q=spec4 if quant else None,
+                             k_scale=spec4 if quant else None)
+
+    def fn(p, k, q, v, c):
+        return module.apply(p, k, q, v, c, method='decode_sharded',
+                            axis_name=mesh_axis)
+
+    step = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), cache_spec),
+        out_specs=(cache_spec, P()), check_vma=False)
+    return jax.jit(step, donate_argnums=(4,) if donate else ())
+
+
+_DECODE_STEPS = {}
+
+
 def decode_seq_parallel(module, params, mesh, keys, queries, values,
                         cache, mesh_axis=None):
     """One sequence-sharded decode step on **global** arrays: the KV
@@ -727,21 +759,21 @@ def decode_seq_parallel(module, params, mesh, keys, queries, values,
     with ``module.make_decode_cache(batch, t_max_global)`` and let this
     wrapper shard it), the new token's operands and the output are
     replicated. Returns ``(cache, out)`` with the cache still sharded —
-    feed it straight back in for the next token. Serving memory then
+    feed it straight back in for the next token (the input cache is
+    DONATED: the slab append writes in place). Serving memory then
     scales linearly with mesh size (the slab per chip is ``t_max/N``),
     which is the whole point: one chip's HBM stops bounding the serving
-    context."""
-    mesh_axis = mesh_axis or module.axis_name
-    cache_spec = jax.tree.map(
-        lambda x: (P(None, None, mesh_axis, None) if x.ndim == 4
-                   else P()), cache)
+    context.
 
-    def fn(p, k, q, v, c):
-        return module.apply(p, k, q, v, c, method='decode_sharded',
-                            axis_name=mesh_axis)
-
-    return jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), cache_spec),
-        out_specs=(cache_spec, P()), check_vma=False,
-    )(params, keys, queries, values, cache)
+    The compiled step is cached per ``(module, mesh, axis)`` so a
+    per-token loop traces once; serving loops that want explicit
+    control use :func:`make_decode_step` directly."""
+    key = (module, mesh, mesh_axis)
+    try:
+        step = _DECODE_STEPS.get(key)
+        if step is None:
+            step = _DECODE_STEPS[key] = make_decode_step(
+                module, mesh, mesh_axis)
+    except TypeError:   # unhashable module field (e.g. array slopes)
+        step = make_decode_step(module, mesh, mesh_axis)
+    return step(params, keys, queries, values, cache)
