@@ -284,7 +284,13 @@ cannot cost less than touching T² bytes once. Steering: the segment-id
 form is O(T) and *faster* than no-mask (cross-segment tiles never
 execute) — any mask expressible as packed segments should use it;
 dense masks are for genuinely irregular patterns and cost ~10%
-flat.
+flat. The natural single-chip boundary is the mask's own footprint:
+at T=131K a dense mask is 16 GiB of bool input before the int8 copy —
+it does not fit 16 GiB of HBM regardless of kernel strategy, so past
+~65K the dense form is not merely slower, it is infeasible on one
+chip; segments / causal / no-mask are the long-context forms (sharded,
+the per-device mask slab is T²/N and the same analysis applies per
+chip).
 
 Dropping the mask still matters at long context — it
 leaves training memory linear in T — ONE 16 GiB chip trains
@@ -453,6 +459,15 @@ example.py:16-33).
 |---|---|---|---|---|""")
         for lm_row in lm_rows:
             print(lm_row)
+        print("""
+The counted rate is the full-remat ceiling, not overhead: with every
+layer rematerialized the step executes ~4 attention passes (fwd,
+recompute, bwd≈2×) while the GFLOP column counts 3, so the expected
+counted rate is ~75% of the causal kernel's ~82 TF/s ≈ 61 TF/s — the
+measured 60-62. Saving all layers' attention residuals instead would
+need ~810 MB/layer at T=131K (13 GiB at depth 16, on top of the
+9.8 GiB step) — full remat is the right trade at this memory, and the
+knob (`remat_policy`) exists for chips where it isn't.""")
 
     print("""
 ### Communication model (multi-chip, analytic + HLO-validated)
