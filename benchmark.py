@@ -97,7 +97,9 @@ def parse_args():
                         help='per-head feature dim (attn mode)')
     parser.add_argument('--qk-quant', choices=['int8'], default=None,
                         help='attn mode (flash impls): int8-quantized '
-                             'QK^T on the MXU int8 path')
+                             'QK^T on the MXU int8 path; decode mode: '
+                             'an int8-trained model decoding through '
+                             'its append-time int8 K mirror')
     parser.add_argument('--kv-heads', type=int, default=None,
                         help='attn/train modes: grouped-query K/V head '
                              'count (< --heads, must divide it); default '
@@ -618,10 +620,13 @@ def run_decode(args):
     h, d = args.heads, args.head_dim
     h_kv = args.kv_heads or h
     dtype = jnp.float32 if args.dtype == 'f32' else jnp.bfloat16
+    # qk_quant='int8': the cache carries an append-time int8 K mirror —
+    # the decode step streams it instead of the bf16 K (half the K
+    # bytes on a bandwidth-bound step).
     model = DistributedDotProductAttn(
         key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
         causal=True, use_rope=args.use_rope, softmax_impl='flash',
-        dtype=dtype)
+        qk_quant=args.qk_quant, dtype=dtype)
     b = args.batch
     x0 = jnp.zeros((b, 16, h * d), dtype)
     params = model.init(jax.random.key(0), x0, x0, x0, None)
@@ -699,12 +704,19 @@ def run_decode(args):
     # round-4 semantics, where b was always 1) and ms_per_step carries
     # the per-step latency the batched table reads.
     step_time = best / chain
-    cache_bytes = 2 * b * h_kv * t_max * d * jnp.dtype(dtype).itemsize
+    # Bytes the attention actually streams per step: V at the cache
+    # dtype plus K at the cache dtype — or the 1-byte int8 mirror (and
+    # its small per-row scales) when qk_quant carries one, so the GB/s
+    # column stays an achieved-bandwidth figure for int8 rows too.
+    elem = jnp.dtype(dtype).itemsize
+    k_bytes = (t_max * d * 1 + t_max * 4 if args.qk_quant == 'int8'
+               else t_max * d * elem)
+    cache_bytes = b * h_kv * (t_max * d * elem + k_bytes)
     record = {
         'mode': 'decode', 't_max': t_max, 'fill': fill, 'heads': h,
         'kv_heads': h_kv, 'head_dim': d, 'dtype': args.dtype,
         'use_rope': args.use_rope, 'world': 1,
-        'batch': b, 'chain': chain,
+        'batch': b, 'chain': chain, 'qk_quant': args.qk_quant,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'ms_per_step': step_time * 1e3,

@@ -273,29 +273,43 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
         # Reproduce the training kernels' quantized scoring: both sides
         # per-row symmetrically quantized with the SAME rule as the
         # fused kernel, so a model trained with int8 QK^T decodes to its
-        # training-time logits. The products stay exact in fp32
-        # (|int8·int8·d| ≪ 2²⁴) — no int path needed; decode is
-        # bandwidth-bound anyway. The cached side streams the int8
-        # mirror when the cache carries one (init_cache(qk_quant=) —
-        # rows quantize once at append); a mirror-less cache quantizes
-        # on the fly (exact but re-reads the full K buffer).
+        # training-time logits. The dot runs s8×s8→s32 (exact) with the
+        # per-row scales applied to the s32 scores, so the cached side
+        # streams int8 — half the bf16 K bytes. Measured honesty
+        # (RESULTS "decode", chained, kv2/131K): 0.32 ms/step vs a
+        # bf16-trained model's 0.21 — XLA's s8 dot lowering doesn't
+        # cash the byte saving in at 4-row operands (an earlier
+        # formulation that dequantized to fp32 BEFORE the dot was 0.49:
+        # never widen the streamed operand). For int8-trained models
+        # this is still the best available path — strictly less work
+        # than re-quantizing the bf16 buffer each step. The mirror
+        # comes from the cache when it carries one (init_cache
+        # (qk_quant=) — rows quantize once at append); a mirror-less
+        # cache quantizes on the fly (exact but re-reads the full K
+        # buffer).
         from distributed_dot_product_tpu.ops.pallas_attention import (
             _quantize_rows,
         )
         qi, sq = _quantize_rows(qg, b * h_kv, group * n, d)
-        q_eff = (qi.astype(jnp.float32) * sq).reshape(qg.shape)
+        qi = qi.reshape(qg.shape)
+        sq = sq.reshape(b, h_kv, group * n, 1)
         if cache.k_q is not None:
-            k_eff = cache.k_q.astype(jnp.float32) * cache.k_scale
+            ki, sk = cache.k_q, cache.k_scale
         else:
             ki, sk = _quantize_rows(cache.k, b * h_kv, t_max, d)
-            k_eff = (ki.astype(jnp.float32) * sk).reshape(cache.k.shape)
+            ki = ki.reshape(cache.k.shape)
+            sk = sk.reshape(b, h_kv, t_max, 1)
+        s = jnp.einsum('bhqd,bhtd->bhqt', qi, ki,
+                       preferred_element_type=jnp.int32
+                       ).astype(jnp.float32)
+        s = s * (sq * scale) * jnp.swapaxes(sk, -1, -2)
     elif qk_quant is not None:
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')
     else:
-        q_eff, k_eff = qg, cache.k
-    s = jnp.einsum('bhqd,bhtd->bhqt', q_eff.astype(jnp.float32) * scale,
-                   k_eff.astype(jnp.float32))
+        s = jnp.einsum('bhqd,bhtd->bhqt',
+                       qg.astype(jnp.float32) * scale,
+                       cache.k.astype(jnp.float32))
     s = s.reshape(b, h_kv, group, n, t_max)
 
     # Query row i (0-based within the n new rows) sits at absolute

@@ -382,6 +382,8 @@ round doesn't re-derive it.""")
                 'decode_benchmark_128k_b8_chain'),
         dec_row('t_max=131072, chained, batched, GQA kv_heads=2',
                 'decode_benchmark_128k_b8_chain_kv2'),
+        dec_row('t_max=131072, chained, GQA kv2, int8-trained (K mirror)',
+                'decode_benchmark_128k_chain_kv2_int8'),
     ] if r is not None]
     if dec_rows:
         print("""
@@ -413,6 +415,19 @@ the ~820 GB/s HBM peak (the re-measured full-head row does), which no
 real per-step latency can. The chained rows serialize on the cache
 carry and are the honest steady-state numbers. No reference analog
 (it has no inference path).
+
+Measured negative result (int8 K mirror): an int8-TRAINED model's
+decode streams the append-time int8 mirror and scores with an
+s8×s8→s32 dot — exact, and strictly better than re-quantizing the
+bf16 buffer on the fly — yet measures 0.32 ms/step vs the bf16
+model's 0.21 at the same kv2/131K shape, despite reading HALF the K
+bytes (a first formulation that dequantized the mirror to fp32 before
+the dot was worse still, 0.49: the conversion doubled the traffic the
+mirror saves). XLA's s8 dot lowering at 4-row operands doesn't cash
+the bandwidth saving in; a Pallas decode kernel consuming the mirror
+natively is the known next step if int8 serving latency ever matters.
+The mirror's real job is exactness: int8-trained models decode to
+their training-time logits.
 
 | config | batch | chain | ms/step | tok/s | cache GB/s |
 |---|---|---|---|---|---|""")

@@ -180,6 +180,10 @@ SWEEPS = [
        + extra + kvx)
       for suff, extra in (('', []), ('_b8', ['--batch', '8']))
       for kv, kvx in (('', []), ('_kv2', ['--kv-heads', '2']))],
+    ('decode_benchmark_128k_chain_kv2_int8',
+     ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
+      '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
+      '--kv-heads', '2', '--qk-quant', 'int8']),
     # --- round-5: LM capstone training (embed → scanned+remat stack →
     # tied head → chunked cross-entropy, one SPMD program) ---
     ('lm_32k',
