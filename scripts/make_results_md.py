@@ -429,6 +429,16 @@ natively is the known next step if int8 serving latency ever matters.
 The mirror's real job is exactness: int8-trained models decode to
 their training-time logits.
 
+A second measured negative closes the formulation question: routing
+the decode step through the FLASH kernel (one fused pass, the prefill
+path's kernel with `causal_offset=length`) is 4-20× slower than the
+einsum step at every (batch, kv_heads) combination tried — at Tq=1
+the kernel's grid costs dominate (a 131K cache is 128+ programs of
+sequencing + DMA setup for 8 query rows of work each), while XLA's
+einsum chain streams K and V once with no kernel overhead. Decode on
+TPU wants the einsum; the kernels earn their keep from prefill
+upward, which is exactly how the module routes.
+
 | config | batch | chain | ms/step | tok/s | cache GB/s |
 |---|---|---|---|---|---|""")
         for r in dec_rows:
