@@ -116,14 +116,24 @@ def main():
         # — the attention-only analog of LM generation).
         local = model.bind(params)
         prompt = 64
-        cache = model.make_decode_cache(1, prompt + args.generate)
+        cache = model.make_decode_cache(1, prompt + args.generate + 1)
         xp = jax.device_get(x)[:, :prompt]
         cache, out = local.prefill(xp, xp, xp, cache)
         tok = out[:, -1:]
+        # ONE jitted step reused across tokens (an eager bound-module
+        # loop re-traces every token — ~5 s/token on the tunneled
+        # backend); the cache is donated so appends write in place.
+        decode_step = jax.jit(
+            lambda p, t_, c: model.apply(p, t_, t_, t_, c,
+                                         method='decode'),
+            donate_argnums=(2,))
+        cache, out = decode_step(params, tok, cache)   # warm the compile
+        tok = jax.block_until_ready(out[:, -1:])
         tic = time.perf_counter()
         for _ in range(args.generate):
-            cache, out = local.decode(tok, tok, tok, cache)
+            cache, out = decode_step(params, tok, cache)
             tok = out[:, -1:]
+        jax.block_until_ready(tok)
         dt = (time.perf_counter() - tic) * 1000 / args.generate
         print(f'decoded {args.generate} tokens with the KV cache '
               f'({dt:.2f} ms/token; cache length '
