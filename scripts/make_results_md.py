@@ -431,13 +431,18 @@ their training-time logits.
 
 A second measured negative closes the formulation question: routing
 the decode step through the FLASH kernel (one fused pass, the prefill
-path's kernel with `causal_offset=length`) is 4-20× slower than the
-einsum step at every (batch, kv_heads) combination tried — at Tq=1
-the kernel's grid costs dominate (a 131K cache is 128+ programs of
-sequencing + DMA setup for 8 query rows of work each), while XLA's
-einsum chain streams K and V once with no kernel overhead. Decode on
-TPU wants the einsum; the kernels earn their keep from prefill
-upward, which is exactly how the module routes.
+path's kernel with `causal_offset=length`) measures ~0.9 ms/step at
+full heads and ~0.7 ms at kv2 on the 131K cache — parity with the
+serialized einsum step at full heads (0.89) and 3× WORSE at kv2
+(0.21). The asymmetry is structural: the kernel's cost floor is its
+grid (128+ K-block programs of sequencing + DMA setup for ≤8 query
+rows of work each), which does not shrink with `kv_heads`, while the
+einsum's cost is the streamed bytes, which do. (Methodology note: a
+naive unrolled microbench of the einsum side reports impossible rates
+— XLA batches the independent repeats into one K-streaming matmul;
+the serialized chained rows above are the honest einsum numbers.)
+Decode on TPU wants the einsum; the kernels earn their keep from
+prefill upward, which is exactly how the module routes.
 
 | config | batch | chain | ms/step | tok/s | cache GB/s |
 |---|---|---|---|---|---|""")
