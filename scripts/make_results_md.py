@@ -444,6 +444,18 @@ the serialized chained rows above are the honest einsum numbers.)
 Decode on TPU wants the einsum; the kernels earn their keep from
 prefill upward, which is exactly how the module routes.
 
+Where the chained numbers sit vs physics: component isolation puts the
+ATTENTION of the B=8 full-head step at 4.25 ms (759 GB/s — near the
+~820 peak) and the appends at ~0.9 ms, yet the full chained step
+measures 10.3 — the in-scan body (append, then read the whole buffer)
+makes XLA copy the cache through the loop carry (~4 ms at B=8's
+3.2 GB; the kv2 step carries the same proportional tax). So the
+chained rows are CONSERVATIVE upper bounds on per-step latency: true
+steady-state sits between the attention-only floor and the chained
+figure, single-dispatch donated steps avoid the copy but measure
+pipelined, and the GQA ratio — the structural claim — holds in every
+formulation because both configurations pay proportionally.
+
 | config | batch | chain | ms/step | tok/s | cache GB/s |
 |---|---|---|---|---|---|""")
         for r in dec_rows:
