@@ -454,7 +454,13 @@ chained rows are CONSERVATIVE upper bounds on per-step latency: true
 steady-state sits between the attention-only floor and the chained
 figure, single-dispatch donated steps avoid the copy but measure
 pipelined, and the GQA ratio — the structural claim — holds in every
-formulation because both configurations pay proportionally.
+formulation because both configurations pay proportionally. Two fixes
+were tried and rejected with data: reordering the body to
+attend-then-append (write-after-read) makes XLA hold MORE buffer
+versions live and OOMs the compile at B=8, with the copy visible in
+the failed allocation ("output of copy", a full cache-shaped temp) —
+the loop-carry aliasing limit lives in XLA's scan machinery, below
+anything an operand-level restructure can reach.
 
 | config | batch | chain | ms/step | tok/s | cache GB/s |
 |---|---|---|---|---|---|""")
