@@ -124,6 +124,17 @@ def test_lm_chunked_nll_matches_unchunked():
                                    atol=1e-5, rtol=1e-4)
 
 
+def test_greedy_generate_validates_steps():
+    from distributed_dot_product_tpu import greedy_generate
+    m = _model(attn_kwargs=dict(distributed=False))
+    toks = jnp.zeros((1, 4), jnp.int32)
+    params = m.init(jax.random.key(0), toks)
+    with pytest.raises(ValueError, match='steps'):
+        greedy_generate(m, params, toks, steps=0, t_max=8)
+    with pytest.raises(ValueError, match='t_max'):
+        greedy_generate(m, params, toks, steps=8, t_max=8)
+
+
 def test_lm_dropout_requires_seed():
     mesh = seq_mesh(8)
     m = _model(attn_kwargs=dict(dropout_rate=0.1))
