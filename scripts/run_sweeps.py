@@ -192,6 +192,9 @@ SWEEPS = [
     ('lm_128k_16l',
      ['--mode', 'lm', '--dtype', 'bf16', '--seq-len', '131072',
       '--layers', '16', '--remat', '--iters', '2']),
+    ('lm_256k',
+     ['--mode', 'lm', '--dtype', 'bf16', '--seq-len', '262144',
+      '--layers', '8', '--remat', '--iters', '2']),
     # --- round-5: the dense-mask cost pairs (masked vs no-mask at three
     # lengths, measured back-to-back — the mask-share analysis data) ---
     ('train_benchmark_flash_32k_nomask',
