@@ -513,10 +513,11 @@ The counted rate is the full-remat ceiling, not overhead: with every
 layer rematerialized the step executes ~4 attention passes (fwd,
 recompute, bwd≈2×) while the GFLOP column counts 3, so the expected
 counted rate is ~75% of the causal kernel's ~82 TF/s ≈ 61 TF/s — the
-measured 60-62, FLAT from 32K through 262K (the whole-model analog of
+measured 60-62, FLAT from 32K through 262K — the whole-model analog of
 the attention-layer quadratic-scaling rows above: 8× the context costs
-~8²/... exactly the T² attention growth at constant rate, with
-temporaries linear in T). Saving all layers' attention residuals
+49× the step time (between linear and the T² attention term's 64×,
+because the projections/MLP/head grow only linearly), at constant rate
+and with temporaries linear in T. Saving all layers' attention residuals
 instead would need ~810 MB/layer at T=131K (13 GiB at depth 16, on
 top of the 9.8 GiB step) — full remat is the right trade at this
 memory, and the knob (`remat_policy`) exists for chips where it
