@@ -28,6 +28,7 @@ Version parity note: the reference exposes ``VERSION_INFO`` in its
 ``__init__.py`` (reference __init__.py:9-10); we keep the same convention.
 """
 
+from distributed_dot_product_tpu import _compat  # noqa: F401  (shims first)
 from distributed_dot_product_tpu._version import (  # noqa: F401
     VERSION_INFO, __version__,
 )
@@ -73,5 +74,9 @@ from distributed_dot_product_tpu.ops.rope import (  # noqa: F401
     rope, rope_seq_parallel,
 )
 from distributed_dot_product_tpu.utils.checkpoint import (  # noqa: F401
-    TrainState, latest_step, restore, save, wait,
+    CheckpointMismatchError, TrainState, gc_old_steps, latest_step,
+    recover_interrupted, restore, save, wait,
+)
+from distributed_dot_product_tpu.train_loop import (  # noqa: F401
+    TrainLoopConfig, TrainLoopResult, run_training,
 )

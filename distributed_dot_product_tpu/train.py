@@ -32,6 +32,58 @@ from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['make_train_step', 'make_lm_train_step', 'mse_loss']
 
+# Record pytree returned by guarded steps (guard=True): replicated scalars.
+# bad_step is a bool: the update was SKIPPED because loss or gradients
+# contained NaN/Inf. grad_norm is the global L2 norm of the (psum'd)
+# gradient — NaN/Inf exactly when any gradient leaf is.
+_RECORD_SPECS = {'loss': None, 'bad_step': None, 'grad_norm': None}
+
+
+def _resolve_donate(donate, guard):
+    """``donate=None`` picks the compatible default (True unguarded,
+    False guarded); an EXPLICIT donate=True with guard=True is an error
+    — the driver's rollback-to-initial-state path reuses the first
+    call's input buffers, which donation would have deleted."""
+    if donate is None:
+        return not guard
+    if donate and guard:
+        raise ValueError(
+            'guard=True requires donate=False: the resilient driver may '
+            'roll back to earlier params/opt_state buffers, which '
+            'donation would delete')
+    return donate
+
+
+def _global_grad_norm(grads):
+    import optax
+    # f32 upcast first: bf16 leaves can overflow the squared sum.
+    return optax.global_norm(
+        jax.tree.map(lambda g: g.astype(jnp.float32), grads))
+
+
+def _guarded_update(optimizer, params, opt_state, grads, loss):
+    """All-finite predicate + ``lax.cond``-selected update, INSIDE the
+    compiled step: a NaN/Inf loss or gradient skips the optax update
+    (params/opt_state pass through untouched) at zero extra host
+    round-trips. The predicate is computed from already-reduced values
+    (loss is pmean'd, grads psum'd), so every shard takes the same
+    branch."""
+    grad_norm = _global_grad_norm(grads)
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+    def apply(_):
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return new_params, new_opt_state
+
+    def skip(_):
+        return params, opt_state
+
+    params, opt_state = lax.cond(finite, apply, skip, None)
+    record = {'loss': loss, 'bad_step': jnp.logical_not(finite),
+              'grad_norm': grad_norm}
+    return params, opt_state, record
+
 
 def mse_loss(pred, target):
     """Per-shard mean-squared error (reference example.py:23 uses
@@ -40,7 +92,8 @@ def mse_loss(pred, target):
 
 
 def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
-                    data_axis=None, loss_fn=mse_loss, donate=True):
+                    data_axis=None, loss_fn=mse_loss, donate=None,
+                    guard=False):
     """Build a jitted SPMD train step for a sequence-parallel attention
     module.
 
@@ -64,7 +117,17 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     fallback seed would silently draw the identical dropout mask every
     step (correlated dropout degrades training with no error signal).
     Modules without dropout ignore it.
+
+    ``guard=True`` builds the NaN/Inf-guarded variant for the resilient
+    driver (:func:`~distributed_dot_product_tpu.train_loop.run_training`):
+    the update is applied through an all-finite ``lax.cond`` (a bad step
+    leaves params/opt_state untouched) and the third return value becomes
+    a ``{'loss', 'bad_step', 'grad_norm'}`` record instead of the bare
+    loss. Guarded steps refuse donation (``donate`` defaults to the
+    compatible value): the driver's rollback paths must keep old
+    buffers alive across steps.
     """
+    donate = _resolve_donate(donate, guard)
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
     needs_seed = _module_has_dropout(module)
 
@@ -82,6 +145,9 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
         # Partials -> global gradient of the replicated params (see module
         # docstring; reference test_gradient.py:116-121).
         grads = lax.psum(grads, axes)
+        if guard:
+            return _guarded_update(optimizer, params, opt_state, grads,
+                                   loss)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
@@ -97,10 +163,11 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
     # segment_ids (B, T): time on the LAST axis (not -2 like activations).
     seg_spec = (P(None, seq_axis) if data_axis is None
                 else P(data_axis, seq_axis))
+    rec_spec = ({k: P() for k in _RECORD_SPECS} if guard else P())
     sharded = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), a3, a3, a3, a3, a3, seg_spec, P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), rec_spec),
         check_vma=False)
 
     def step(params, opt_state, batch, dropout_seed=None):
@@ -110,12 +177,12 @@ def make_train_step(module, optimizer, mesh, seq_axis=SEQ_AXIS,
         return sharded(params, opt_state, keys, queries, values, mask,
                        target, seg, dropout_seed)
 
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _jit_step(step, donate)
 
 
 def make_lm_train_step(model, optimizer, mesh, seq_axis=SEQ_AXIS,
-                       data_axis=None, donate=True, loss_chunk=4096):
+                       data_axis=None, donate=None, loss_chunk=4096,
+                       guard=False):
     """Sharded next-token training step for a
     :class:`~distributed_dot_product_tpu.models.lm.TransformerLM`.
 
@@ -137,7 +204,11 @@ def make_lm_train_step(model, optimizer, mesh, seq_axis=SEQ_AXIS,
     ``loss_chunk`` bounds the live logit memory: the model's
     ``nll_sum`` scans row chunks of that size with per-chunk remat, so
     neither pass materializes the (T, vocab) logits (None = unchunked).
+    ``guard=True``: NaN/Inf-guarded update + ``{'loss', 'bad_step',
+    'grad_norm'}`` record, exactly as in :func:`make_train_step`
+    (donation refused for the same rollback reason).
     """
+    donate = _resolve_donate(donate, guard)
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
     needs_seed = _module_has_dropout(model)
 
@@ -161,16 +232,20 @@ def make_lm_train_step(model, optimizer, mesh, seq_axis=SEQ_AXIS,
         loss = lax.psum(local_val, axes)
         # …and the true gradient of it (sum of per-shard partials).
         grads = lax.psum(grads, axes)
+        if guard:
+            return _guarded_update(optimizer, params, opt_state, grads,
+                                   loss)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
     tok_spec = (P(None, seq_axis) if data_axis is None
                 else P(data_axis, seq_axis))
+    rec_spec = ({k: P() for k in _RECORD_SPECS} if guard else P())
     sharded = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), tok_spec, tok_spec, tok_spec, P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), rec_spec),
         check_vma=False)
 
     def step(params, opt_state, batch, dropout_seed=None):
@@ -180,8 +255,19 @@ def make_lm_train_step(model, optimizer, mesh, seq_axis=SEQ_AXIS,
         return sharded(params, opt_state, tokens, targets, seg,
                        dropout_seed)
 
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return _jit_step(step, donate)
+
+
+def _jit_step(step, donate):
+    """Jit a step fn with the donation policy, tagging the wrapper so
+    the resilient driver can refuse donating steps up front (it saves
+    and rolls back through buffers a donating step would delete)."""
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    try:
+        jitted._ddp_donates = donate
+    except AttributeError:      # jit wrapper without attribute support
+        pass
+    return jitted
 
 
 def _resolve_dropout_seed(needs_seed, dropout_seed):

@@ -26,6 +26,14 @@ Durability: orbax finalizes a checkpoint only after all shards land
 orbax whether a step directory is finalized, so a crash mid-save is never
 selected for restore. Overwriting an existing step keeps the old
 checkpoint as ``step_N.replaced`` until the new one is finalized.
+:func:`recover_interrupted` cleans up after a crash mid-save (removes
+partial writes, restores an orphaned ``.replaced`` backup whose original
+vanished) and :func:`gc_old_steps` implements ``keep_last=N`` retention.
+
+Pending async-save bookkeeping is scoped PER CHECKPOINT ROOT: two runs
+(or two ``tmp_path`` tests) sharing one process never interleave each
+other's deferred-backup cleanup — ``wait(path)`` finalizes and cleans one
+root, ``wait()`` all of them.
 
 Usage::
 
@@ -42,7 +50,8 @@ import jax
 
 from distributed_dot_product_tpu.utils.comm import synchronize
 
-__all__ = ['TrainState', 'save', 'restore', 'latest_step', 'wait']
+__all__ = ['TrainState', 'save', 'restore', 'latest_step', 'wait',
+           'gc_old_steps', 'recover_interrupted', 'CheckpointMismatchError']
 
 
 class TrainState(NamedTuple):
@@ -56,7 +65,18 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
+class CheckpointMismatchError(ValueError):
+    """A checkpoint exists but its on-disk tree does not match the restore
+    template (typically: ``TrainState`` fields, the model architecture, or
+    the optimizer changed since the checkpoint was written)."""
+
+
 _CKPTR = None
+
+# Fault-injection seam (see utils/faults.py): when set, called as
+# ``hook(target_dir)`` at the top of ``save`` and may raise to simulate
+# transient I/O failure or a crash mid-save. Never set in production.
+_SAVE_FAULT_HOOK = None
 
 
 def _checkpointer():
@@ -103,29 +123,90 @@ def _is_finalized(path):
         return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
 
 
-# Backups whose removal is deferred until their (async) save finalizes,
-# and whether ANY async save is outstanding (a non-overwrite async save
-# leaves no backup but must still be waited on before the next save's
-# filesystem inspection — its target directory may not exist yet).
-_PENDING_BACKUPS = []
-_ASYNC_PENDING = False
+class _RootPending:
+    """Deferred async-save state for ONE checkpoint root: overwrite
+    backups whose removal waits for their save to finalize, and whether
+    any async save against this root is outstanding (a non-overwrite
+    async save leaves no backup but must still be waited on before the
+    next save's filesystem inspection — its target directory may not
+    exist yet)."""
+
+    __slots__ = ('backups', 'async_pending')
+
+    def __init__(self):
+        self.backups = []
+        self.async_pending = False
 
 
-def wait():
-    """Block until every outstanding ``save(..., blocking=False)`` has
-    finalized, then remove the overwrite backups it deferred. Collective
-    on multi-host (same contract as ``save``). A no-op when nothing is
-    pending."""
-    global _ASYNC_PENDING
+# Keyed by absolutized root path so e.g. two tmp_path test runs in one
+# process never touch each other's deferred cleanup.
+_PENDING_ROOTS = {}
+
+
+def _pending(path) -> _RootPending:
+    return _PENDING_ROOTS.setdefault(str(_root(path)), _RootPending())
+
+
+def wait(path=None):
+    """Block until outstanding ``save(..., blocking=False)`` writes have
+    finalized, then remove the overwrite backups they deferred.
+
+    ``path=None`` (the default) finalizes every root this process has
+    saved to; passing a checkpoint root restricts the deferred-backup
+    cleanup to that root (other roots' bookkeeping stays pending, to be
+    cleaned by their own ``wait``/next ``save``). Collective on
+    multi-host (same contract as ``save``). A no-op when nothing is
+    pending.
+    """
+    states = ([_pending(path)] if path is not None
+              else list(_PENDING_ROOTS.values()))
+    if not any(st.async_pending or st.backups for st in states):
+        return
     if _CKPTR is not None:
+        # One shared checkpointer: this fences EVERY in-flight async save,
+        # which is conservative but safe — only the selected roots'
+        # bookkeeping is cleaned below.
         _CKPTR.wait_until_finished()
     synchronize()
-    if jax.process_index() == 0:
-        for backup in _PENDING_BACKUPS:
-            if backup.is_dir():
-                backup.rmtree()
-    _PENDING_BACKUPS.clear()
-    _ASYNC_PENDING = False
+    for st in states:
+        if jax.process_index() == 0:
+            for backup in st.backups:
+                if backup.is_dir():
+                    _resolve_backup(backup)
+        st.backups.clear()
+        st.async_pending = False
+
+
+def _resolve_backup(backup):
+    """Decide the fate of one ``step_N.replaced`` overwrite backup: if
+    the replacement finalized, the backup is stale — remove it; if not
+    (crash/failed flush mid-overwrite), the backup is the ONLY surviving
+    copy of the step — remove the partial replacement and restore the
+    backup. Shared by :func:`wait` and :func:`recover_interrupted`.
+    Returns ``(action, name)`` pairs describing what was done."""
+    orig = backup.parent / backup.name[:-len('.replaced')]
+    if orig.is_dir() and _is_finalized(orig):
+        backup.rmtree()
+        return [('removed-stale-backup', backup.name)]
+    actions = []
+    if orig.is_dir():
+        orig.rmtree()
+        actions.append(('removed-partial', orig.name))
+    backup.rename(orig)
+    actions.append(('restored-backup', orig.name))
+    return actions
+
+
+def discard_pending(path):
+    """Abandon the deferred bookkeeping for ``path`` WITHOUT touching
+    disk. For use after a failed async flush: the write never finalized,
+    so its overwrite backups must stay on disk (``recover_interrupted``
+    restores them on the next run start); only the in-memory pending
+    state is dropped so the caller can proceed to a fresh blocking save.
+    """
+    st = _pending(path)
+    st.async_pending = False
+    st.backups.clear()
 
 
 def save(path, state: TrainState, *, force: bool = True,
@@ -151,9 +232,11 @@ def save(path, state: TrainState, *, force: bool = True,
     process 0's filesystem view decides the overwrite branch for
     everyone).
     """
-    global _ASYNC_PENDING
-    if _ASYNC_PENDING:
-        wait()
+    if _SAVE_FAULT_HOOK is not None:
+        _SAVE_FAULT_HOOK(_step_dir(path, int(state.step)))
+    st = _pending(path)
+    if st.async_pending:
+        wait(path)
     target = _step_dir(path, int(state.step))
     backup = target.parent / (target.name + '.replaced')
     exists = target.is_dir()
@@ -176,9 +259,9 @@ def save(path, state: TrainState, *, force: bool = True,
     ckptr = _checkpointer()
     ckptr.save(target, state)
     if not blocking:
-        _ASYNC_PENDING = True
+        st.async_pending = True
         if exists:
-            _PENDING_BACKUPS.append(backup)
+            st.backups.append(backup)
         return os.fspath(target)
     ckptr.wait_until_finished()
     synchronize()
@@ -187,12 +270,11 @@ def save(path, state: TrainState, *, force: bool = True,
     return os.fspath(target)
 
 
-def latest_step(path) -> Optional[int]:
-    """Highest step with a FINALIZED checkpoint under ``path``, or None —
-    a crash mid-save leaves an unfinalized directory, which is skipped."""
+def _finalized_steps(path):
+    """Sorted list of steps with a finalized checkpoint under ``path``."""
     root = _root(path)
     if not root.is_dir():
-        return None
+        return []
     steps = []
     for child in root.iterdir():
         name = child.name
@@ -204,7 +286,70 @@ def latest_step(path) -> Optional[int]:
             continue
         if _is_finalized(child):
             steps.append(step)
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def latest_step(path) -> Optional[int]:
+    """Highest step with a FINALIZED checkpoint under ``path``, or None —
+    a crash mid-save leaves an unfinalized directory, which is skipped."""
+    steps = _finalized_steps(path)
+    return steps[-1] if steps else None
+
+
+def gc_old_steps(path, keep_last: int):
+    """Retention policy: delete all but the ``keep_last`` NEWEST finalized
+    step directories (and their stale ``.replaced`` backups). Unfinalized
+    (in-flight or crash-partial) directories are never touched — an async
+    save still flushing must not lose its predecessor count. Returns the
+    list of deleted step numbers. Collective on multi-host."""
+    if keep_last is None or keep_last < 1:
+        return []
+    doomed = _finalized_steps(path)[:-keep_last]
+    if doomed and jax.process_index() == 0:
+        root = _root(path)
+        for step in doomed:
+            for suffix in ('', '.replaced'):
+                victim = root / f'step_{step:09d}{suffix}'
+                if victim.is_dir():
+                    victim.rmtree()
+    # Unconditional barrier: filesystem views can diverge across hosts
+    # (a process listing AFTER process 0's deletions sees doomed=[]), so
+    # gating the collective on the local listing would deadlock.
+    synchronize()
+    return doomed
+
+
+def recover_interrupted(path):
+    """Clean up after a crash mid-save, before resuming a run:
+
+    - remove ``*.orbax-checkpoint-tmp*`` partial writes (a crash between
+      orbax's temp write and its finalizing rename);
+    - for each ``step_N.replaced`` backup: if ``step_N`` is missing or
+      unfinalized (a crash mid-overwrite destroyed/never-finished the
+      replacement), the backup is the only surviving copy — restore it
+      to ``step_N``; otherwise the overwrite finalized and the stale
+      backup is removed.
+
+    Returns a list of ``(action, name)`` pairs describing what was done.
+    Call only when no async save is in flight (run start, not mid-loop).
+    Collective on multi-host (process 0 mutates, all synchronize).
+    """
+    root = _root(path)
+    if not root.is_dir():
+        return []
+    actions = []
+    if jax.process_index() == 0:
+        for child in list(root.iterdir()):
+            if '.orbax-checkpoint-tmp' in child.name:
+                child.rmtree()
+                actions.append(('removed-partial', child.name))
+        for child in list(root.iterdir()):
+            name = child.name
+            if not (name.startswith('step_') and name.endswith('.replaced')):
+                continue
+            actions.extend(_resolve_backup(child))
+    synchronize()
+    return actions
 
 
 def restore(path, template: TrainState, *, step: Optional[int] = None
@@ -213,12 +358,61 @@ def restore(path, template: TrainState, *, step: Optional[int] = None
     using ``template`` for structure/shardings: every restored array
     adopts the sharding of the corresponding template leaf, so resuming
     on a different mesh layout re-shards transparently.
+
+    Raises :class:`CheckpointMismatchError` (with the step directory, the
+    expected vs. on-disk tree structure, and a hint) instead of an opaque
+    orbax error when the template does not match what is on disk.
     """
     if step is None:
         step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f'no checkpoint under {path!r}')
-    restored = _checkpointer().restore(_step_dir(path, step), template)
+    step_dir = _step_dir(path, step)
+    try:
+        restored = _checkpointer().restore(step_dir, template)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except OSError:
+        # Transient I/O (permissions, network, missing files) is NOT a
+        # structure mismatch: keep the original type so callers can
+        # classify/retry it.
+        raise
+    except Exception as e:
+        raise CheckpointMismatchError(
+            _mismatch_message(step_dir, template, e)) from e
     # orbax returns the same pytree type; ensure the step is a python int
     # (templates often carry traced/array steps).
     return restored._replace(step=int(jax.device_get(restored.step)))
+
+
+def _tree_summary(tree):
+    """Compact, order-stable description of a pytree's structure: the
+    key paths of its leaves (shapes elided — structure is what mismatches
+    on a TrainState/model change)."""
+    try:
+        paths = [jax.tree_util.keystr(p)
+                 for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+        return f'{len(paths)} leaves: ' + ', '.join(paths[:20]) + (
+            ', ...' if len(paths) > 20 else '')
+    except Exception:
+        return str(jax.tree.structure(tree))
+
+
+def _mismatch_message(step_dir, template, err):
+    found = 'unreadable'
+    try:
+        meta = _checkpointer().metadata(step_dir)
+        if meta is not None:
+            found = _tree_summary(meta)
+    except Exception:
+        pass
+    return (
+        f'failed to restore checkpoint {step_dir}: the on-disk tree does '
+        f'not match the restore template.\n'
+        f'  expected (template): {_tree_summary(template)}\n'
+        f'  found (on disk):     {found}\n'
+        f'  hint: if TrainState fields, the model architecture, or the '
+        f'optimizer changed since this checkpoint was written, restore '
+        f'with a template built from the OLD structure (then migrate), '
+        f'or start a fresh run directory.\n'
+        f'  original error: {err}')

@@ -1,0 +1,225 @@
+# -*- coding: utf-8 -*-
+"""
+Deterministic fault injection for exercising every recovery path of the
+resilient training driver (:mod:`distributed_dot_product_tpu.train_loop`)
+in plain tier-1 CPU tests — no real preemption, flaky disk, or diverging
+optimizer required.
+
+Injectable faults (compose freely in one :class:`FaultPlan`):
+
+- **NaN gradients at step S** (``nan_at_steps``): the batch produced by
+  the wrapped batch function has every float leaf poisoned with NaN, so
+  the compiled step's loss AND gradients come out NaN and the in-step
+  all-finite guard must skip the update. One-shot by default: after a
+  rollback the replayed step gets the clean batch (recovery provable).
+- **Transient checkpoint I/O errors** (``io_error_saves``): the first N
+  ``checkpoint.save`` attempts raise ``OSError`` (disk full / flaky
+  store), exercising the driver's retry + exponential backoff.
+- **Crash mid-save** (``crash_in_save_at_step``): when the save for step
+  S starts, an unfinalized ``*.orbax-checkpoint-tmp`` partial write is
+  left on disk and :class:`SimulatedCrash` (a ``BaseException``, so no
+  retry/except-Exception handler swallows it) propagates — the process
+  "died". Recovery: ``latest_step`` must skip the partial write and a
+  restarted driver resumes from the newest finalized step.
+- **Synthetic SIGTERM** (``sigterm_at_step``): a real ``SIGTERM`` is
+  delivered to this process when the batch for step S is requested —
+  exactly how a TPU preemption notice lands mid-loop — exercising the
+  driver's catch → final blocking save → clean exit path.
+
+Env knobs (picked up by :func:`plan_from_env`; the driver reads them when
+no explicit injector is passed, so a shell can fault a real run):
+
+- ``DDP_TPU_FAULT_NAN_STEPS=5,7``      inject NaN at steps 5 and 7
+- ``DDP_TPU_FAULT_IO_ERRORS=2``        first 2 save attempts raise OSError
+- ``DDP_TPU_FAULT_CRASH_SAVE_STEP=10`` crash mid-save of step 10
+- ``DDP_TPU_FAULT_SIGTERM_STEP=20``    deliver SIGTERM at step 20
+"""
+
+import dataclasses
+import os
+import signal
+from typing import FrozenSet, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.utils import checkpoint as _ckpt
+
+__all__ = ['FaultPlan', 'FaultInjector', 'SimulatedCrash', 'plan_from_env',
+           'poison_batch']
+
+
+class SimulatedCrash(BaseException):
+    """Raised to simulate the process dying mid-save. Derives from
+    ``BaseException`` so no retry loop or ``except Exception`` recovery
+    path can accidentally swallow a "dead" process."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to inject, and when. Immutable; runtime countdown state lives
+    in the :class:`FaultInjector`."""
+    nan_at_steps: FrozenSet[int] = frozenset()
+    io_error_saves: int = 0
+    crash_in_save_at_step: Optional[int] = None
+    sigterm_at_step: Optional[int] = None
+    fire_once: bool = True
+
+    def any(self):
+        return bool(self.nan_at_steps or self.io_error_saves
+                    or self.crash_in_save_at_step is not None
+                    or self.sigterm_at_step is not None)
+
+
+def plan_from_env(environ=None) -> FaultPlan:
+    """Build a :class:`FaultPlan` from the ``DDP_TPU_FAULT_*`` env knobs
+    (an empty plan when none are set)."""
+    env = os.environ if environ is None else environ
+
+    def _int(name):
+        v = env.get(name)
+        return int(v) if v not in (None, '') else None
+
+    nan_steps = frozenset(
+        int(s) for s in env.get('DDP_TPU_FAULT_NAN_STEPS', '').split(',')
+        if s.strip())
+    return FaultPlan(
+        nan_at_steps=nan_steps,
+        io_error_saves=_int('DDP_TPU_FAULT_IO_ERRORS') or 0,
+        crash_in_save_at_step=_int('DDP_TPU_FAULT_CRASH_SAVE_STEP'),
+        sigterm_at_step=_int('DDP_TPU_FAULT_SIGTERM_STEP'),
+    )
+
+
+def poison_batch(batch):
+    """Every floating leaf of ``batch`` becomes all-NaN (ints/bools/None
+    pass through): the step's loss and every gradient leaf come out NaN,
+    which is exactly the "diverged step" the guard must catch.
+
+    Raises ``ValueError`` when the batch has NO floating leaf (e.g. an
+    integer-token LM batch): NaN cannot be injected through such inputs,
+    and silently not injecting would let an operator believe the guard
+    path was exercised when it never ran.
+    """
+    hit = []
+
+    def _poison(x):
+        if x is None or not hasattr(x, 'dtype'):
+            return x
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            hit.append(True)
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    out = jax.tree.map(_poison, batch, is_leaf=lambda x: x is None)
+    if not hit:
+        raise ValueError(
+            'cannot inject NaN: the batch has no floating-point leaves '
+            '(integer-token batches reach the loss through an embedding '
+            '— poison a float input or test the guard with a float-batch '
+            'model instead)')
+    return out
+
+
+def _step_of(target_dir):
+    name = getattr(target_dir, 'name', str(target_dir))
+    try:
+        return int(str(name).rsplit('step_', 1)[-1])
+    except ValueError:
+        return None
+
+
+class FaultInjector:
+    """Runtime for a :class:`FaultPlan`.
+
+    Use as a context manager (installs/uninstalls the checkpoint save
+    hook) and wrap the driver's batch function::
+
+        plan = FaultPlan(nan_at_steps=frozenset({3}), io_error_saves=1)
+        with FaultInjector(plan) as inj:
+            run_training(step_fn, state, inj.wrap_batch_fn(batch_fn), cfg)
+
+    The driver also accepts ``fault_injector=inj`` and wires both seams
+    itself.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._io_errors_left = plan.io_error_saves
+        self._nan_fired = set()
+        self._crash_fired = False
+        self._sigterm_fired = False
+        # ONE bound-method object, captured here: `self._save_hook` would
+        # mint a fresh object per attribute access, breaking the identity
+        # checks below (install exclusivity / uninstall ownership).
+        self._hook = self._save_hook
+
+    # -- install / uninstall the checkpoint-backend seam ---------------
+    def install(self):
+        if _ckpt._SAVE_FAULT_HOOK is not None \
+                and _ckpt._SAVE_FAULT_HOOK is not self._hook:
+            raise RuntimeError('another FaultInjector is already installed')
+        _ckpt._SAVE_FAULT_HOOK = self._hook
+        return self
+
+    def uninstall(self):
+        if _ckpt._SAVE_FAULT_HOOK is self._hook:
+            _ckpt._SAVE_FAULT_HOOK = None
+
+    __enter__ = install
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- batch-function seam (NaN injection + synthetic SIGTERM) -------
+    def wrap_batch_fn(self, batch_fn):
+        def wrapped(step):
+            self.on_step(step)
+            batch = batch_fn(step)
+            if self._should_nan(step):
+                batch = poison_batch(batch)
+            return batch
+        return wrapped
+
+    def on_step(self, step):
+        """Per-step trigger point (the driver calls this even when it owns
+        batch construction): delivers the synthetic SIGTERM."""
+        p = self.plan
+        if p.sigterm_at_step is not None and step == p.sigterm_at_step \
+                and not self._sigterm_fired:
+            self._sigterm_fired = True
+            # A REAL signal through the OS, not a direct handler call —
+            # the driver's installed handler (and only it) must catch it.
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def _should_nan(self, step):
+        if step not in self.plan.nan_at_steps:
+            return False
+        if self.plan.fire_once:
+            if step in self._nan_fired:
+                return False
+            self._nan_fired.add(step)
+        return True
+
+    # -- checkpoint save seam ------------------------------------------
+    def _save_hook(self, target_dir):
+        p = self.plan
+        if p.crash_in_save_at_step is not None and not self._crash_fired \
+                and _step_of(target_dir) == p.crash_in_save_at_step:
+            if p.fire_once:
+                self._crash_fired = True
+            # Leave the partial write a real crash mid-save leaves: an
+            # unfinalized orbax temp directory (plus a marker file so the
+            # dir is non-empty on every backend).
+            partial = target_dir.parent / (
+                target_dir.name + '.orbax-checkpoint-tmp-0')
+            partial.mkdir(parents=True, exist_ok=True)
+            (partial / 'partial_write').write_text('simulated crash')
+            raise SimulatedCrash(
+                f'simulated crash mid-save of {target_dir}')
+        if self._io_errors_left > 0:
+            self._io_errors_left -= 1
+            raise OSError(
+                f'injected transient checkpoint I/O failure '
+                f'({self._io_errors_left} more to come)')

@@ -85,6 +85,27 @@ def measure(fn):
     return wrapper
 
 
+def log_step(step, loss, grad_norm=None, bad=False, seconds=None,
+             extra='', force=False):
+    """One-line per-step training log, gated by the same
+    ``DISTRIBUTED_DOT_DEBUG`` switch as :func:`measure` (``force=True``
+    prints unconditionally — the driver uses it for its periodic log
+    cadence). The resilient train loop feeds its per-step
+    ``{loss, bad_step, grad_norm}`` records through here."""
+    if not (force or _debug_enabled()):
+        return
+    parts = [f'step {step}: loss={loss:.6f}']
+    if grad_norm is not None:
+        parts.append(f'grad_norm={grad_norm:.4g}')
+    if bad:
+        parts.append('BAD (non-finite; update skipped)')
+    if seconds is not None:
+        parts.append(f'({seconds * 1000:.1f} ms)')
+    if extra:
+        parts.append(extra)
+    print(' '.join(parts), flush=True)
+
+
 class timed:
     """Context manager for honest block timing:
 

@@ -18,6 +18,7 @@ On real TPU hardware, raise --seq-len (e.g. 131072) and use bf16.
 """
 
 import argparse
+import sys
 import tempfile
 import time
 
@@ -48,6 +49,10 @@ def main():
                          'the KV cache (0 to skip)')
     ap.add_argument('--ckpt-dir', default=None,
                     help='checkpoint directory (default: a temp dir)')
+    ap.add_argument('--ckpt-every', type=int, default=0,
+                    help='checkpoint every N steps (0: only at the end)')
+    ap.add_argument('--keep-last', type=int, default=3,
+                    help='checkpoint retention (old step dirs GCed)')
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == 'tpu'
@@ -77,36 +82,45 @@ def main():
     params = model.init(jax.random.key(0), x0, x0, x0, None)
     optimizer = optax.adam(1e-3)
     opt_state = optimizer.init(params)
-    step = make_train_step(model, optimizer, mesh, donate=False)
+    # guard=True: the compiled step skips the update on a NaN/Inf step
+    # and returns the {loss, bad_step, grad_norm} record the driver
+    # consumes. donate=False: the driver's rollback path keeps old
+    # buffers alive across steps.
+    step = make_train_step(model, optimizer, mesh, donate=False,
+                           guard=True)
 
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix='ddp_tpu_ckpt_')
-    start = 0
-    if ddp.latest_step(ckpt_dir) is not None:
-        # Restored arrays adopt the template's shardings — commit the
-        # template to the mesh (params/opt state replicated) so training
-        # can resume on it directly.
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        rep = NamedSharding(mesh, P())
-        template = ddp.TrainState(
-            0, jax.tree.map(lambda p: jax.device_put(p, rep), params),
-            jax.tree.map(lambda p: jax.device_put(p, rep), opt_state))
-        state = ddp.restore(ckpt_dir, template)
-        start, params, opt_state = state.step, state.params, state.opt_state
-        print(f'resumed from step {start} ({ckpt_dir})')
+    # Restored arrays adopt the template's shardings — commit the
+    # template to the mesh (params/opt state replicated) so training
+    # can resume on it directly.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    template = ddp.TrainState(
+        0, jax.tree.map(lambda p: jax.device_put(p, rep), params),
+        jax.tree.map(lambda p: jax.device_put(p, rep), opt_state))
 
+    # The resilient driver owns the loop: auto-resume from the latest
+    # finalized checkpoint, periodic async saves with retry/backoff,
+    # SIGTERM/SIGINT -> final save + clean exit, NaN-guarded stepping
+    # with rollback, keep_last retention (see README "Fault tolerance
+    # and resume"; fault-injection knobs: DDP_TPU_FAULT_*).
+    # Recover crash leftovers BEFORE deriving the resume point, so the
+    # step count agrees with what run_training (which recovers again,
+    # idempotently) will actually resume from.
+    ddp.recover_interrupted(ckpt_dir)
+    start = ddp.latest_step(ckpt_dir) or 0
     batch = (x, x, x, None, target)          # attn_mask=None: no O(T^2) input
-    for i in range(start, start + args.steps):
-        tic = time.perf_counter()
-        # The step counter seeds the in-kernel dropout mask (a fresh,
-        # reproducible mask per step; ignored when --dropout is 0).
-        params, opt_state, loss = step(params, opt_state, batch,
-                                       dropout_seed=i)
-        loss = float(jax.block_until_ready(loss))
-        print(f'step {i}: loss={loss:.6f} '
-              f'({(time.perf_counter() - tic) * 1000:.1f} ms)')
-    final = ddp.save(ckpt_dir, ddp.TrainState(start + args.steps, params,
-                                              opt_state))
-    print(f'checkpointed -> {final}')
+    cfg = ddp.TrainLoopConfig(
+        num_steps=start + args.steps, ckpt_dir=ckpt_dir,
+        ckpt_every=args.ckpt_every, keep_last=args.keep_last,
+        log_every=1)
+    result = ddp.run_training(step, template, lambda i: batch, cfg)
+    params, opt_state = result.state.params, result.state.opt_state
+    if result.resumed_from is not None:
+        print(f'(resumed from step {result.resumed_from})')
+    print(f'checkpointed -> {ckpt_dir} (step {result.state.step})')
+    if result.preempted:
+        sys.exit(result.exit_code)
 
     if args.generate:
         # Inference with the SAME weights and configuration: prefill the

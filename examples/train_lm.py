@@ -43,8 +43,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from distributed_dot_product_tpu import (  # noqa: E402
-    TrainState, TransformerLM, greedy_generate, latest_step, lm_targets,
-    restore, save,
+    TrainLoopConfig, TrainState, TransformerLM, greedy_generate,
+    lm_targets, run_training,
 )
 from distributed_dot_product_tpu.parallel.mesh import (  # noqa: E402
     data_seq_mesh, seq_mesh,
@@ -117,6 +117,8 @@ def main(argv=None):
     p.add_argument('--bf16', action='store_true')
     p.add_argument('--ckpt-dir', default=None)
     p.add_argument('--ckpt-every', type=int, default=100)
+    p.add_argument('--keep-last', type=int, default=3,
+                   help='checkpoint retention (old step dirs GCed)')
     p.add_argument('--generate', action='store_true',
                    help='after training, greedy-generate a copy and '
                         'report token accuracy')
@@ -144,40 +146,46 @@ def main(argv=None):
 
     optimizer = optax.adam(args.lr)
     opt_state = optimizer.init(params)
+    # guard=True: NaN/Inf steps skip the update inside the compiled
+    # program and surface as bad_step records to the driver.
     step_fn = make_lm_train_step(model, optimizer, mesh,
-                                 data_axis=data_axis, donate=False)
-
-    start = 0
-    if args.ckpt_dir and latest_step(args.ckpt_dir) is not None:
-        state = restore(args.ckpt_dir,
-                        TrainState(0, params, opt_state))
-        params, opt_state, start = state.params, state.opt_state, \
-            state.step
-        print(f'resumed from step {start}')
+                                 data_axis=data_axis, donate=False,
+                                 guard=True)
 
     base_key = jax.random.key(2)
-    t0 = time.time()
-    loss = jnp.nan
-    for i in range(start, args.steps):
+
+    def batch_fn(i):
         # fold_in(step): the data stream is a function of the step
         # index, so a resumed run consumes exactly the batches an
         # uninterrupted run would (a split-chain restarted from the
         # base key would replay the pre-checkpoint batches).
-        batch = make_copy_batch(jax.random.fold_in(base_key, i),
-                                args.batch, args.seq_len,
-                                args.vocab, args.seg_len)
-        params, opt_state, loss = step_fn(params, opt_state, batch,
-                                          dropout_seed=i)
-        if i % args.log_every == 0 or i == args.steps - 1:
-            print(f'step {i:5d}  copy-loss {float(loss):.4f}')
-        if args.ckpt_dir and (i + 1) % args.ckpt_every == 0:
-            save(args.ckpt_dir, TrainState(i + 1, params, opt_state))
+        return make_copy_batch(jax.random.fold_in(base_key, i),
+                               args.batch, args.seq_len,
+                               args.vocab, args.seg_len)
+
+    # The resilient driver: auto-resume, periodic async saves with
+    # retry/backoff, SIGTERM/SIGINT -> final save + clean exit,
+    # NaN-guarded stepping with rollback, keep_last retention.
+    cfg = TrainLoopConfig(
+        num_steps=args.steps, ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every if args.ckpt_dir else 0,
+        keep_last=args.keep_last, max_bad_steps=3,
+        log_every=args.log_every)
+    t0 = time.time()
+    result = run_training(step_fn, TrainState(0, params, opt_state),
+                          batch_fn, cfg)
+    params, opt_state = result.state.params, result.state.opt_state
+    start = result.resumed_from or 0
+    loss = jnp.asarray(result.losses.get(result.state.step - 1, jnp.nan))
     dt = time.time() - t0
-    tok = (args.steps - start) * args.batch * args.seq_len
-    print(f'trained {args.steps - start} steps in {dt:.1f}s '
+    executed = result.state.step - start   # != args.steps when preempted
+    tok = executed * args.batch * args.seq_len
+    print(f'trained {executed} steps in {dt:.1f}s '
           f'({tok / max(dt, 1e-9):,.0f} tok/s incl. data+compile)')
-    if args.ckpt_dir:
-        save(args.ckpt_dir, TrainState(args.steps, params, opt_state))
+    if result.preempted:
+        print(f'preempted (exit code {result.exit_code}); state saved '
+              f'at step {result.state.step}')
+        sys.exit(result.exit_code)
 
     if args.generate:
         # One fresh segment: prompt = [BOS, prefix, SEP]; the model must

@@ -217,6 +217,11 @@ def main():
     ap.add_argument('--iters', type=int, default=5)
     ap.add_argument('--rerun', action='store_true',
                     help='re-measure configs whose result file exists')
+    ap.add_argument('--retries', type=int, default=1,
+                    help='re-run a failed config this many times (transient '
+                         'TPU-runtime/tunnel failures; backoff doubles from '
+                         '--retry-backoff seconds)')
+    ap.add_argument('--retry-backoff', type=float, default=10.0)
     args = ap.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
@@ -233,12 +238,25 @@ def main():
         cmd = [sys.executable, os.path.join(REPO, 'benchmark.py'),
                '--iters', str(args.iters), *bench_args, '--file', path]
         print(f'== {stem}: {" ".join(bench_args)}', flush=True)
-        t0 = time.time()
-        proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-        sys.stdout.write(proc.stdout)
-        print(f'== {stem}: rc={proc.returncode} ({time.time() - t0:.0f}s)',
-              flush=True)
+        delay = args.retry_backoff
+        for attempt in range(args.retries + 1):
+            t0 = time.time()
+            proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+            sys.stdout.write(proc.stdout)
+            print(f'== {stem}: rc={proc.returncode} '
+                  f'({time.time() - t0:.0f}s)', flush=True)
+            if proc.returncode == 0:
+                break
+            # One OOM/compile failure must not take down the sweep; a
+            # TRANSIENT failure (tunneled-TPU RPC resets, preempted
+            # runtime) should not even cost the config — retry with
+            # backoff before recording it as failed.
+            if attempt < args.retries:
+                print(f'== {stem}: retry {attempt + 1}/{args.retries} '
+                      f'in {delay:.0f}s', flush=True)
+                time.sleep(delay)
+                delay *= 2
         if proc.returncode != 0:
             failures.append(stem)
     if failures:

@@ -1,0 +1,129 @@
+#!/usr/bin/env bash
+# Kill/resume smoke test: run a tiny CPU training job through the
+# resilient driver (train_loop.run_training), SIGKILL it mid-run — the
+# one signal no handler can catch, i.e. a true crash — restart it, and
+# assert the final loss matches an uninterrupted run bit-for-bit.
+#
+#   scripts/smoke_resume.sh [steps] [kill_after_seconds]
+#
+# Exercises, end to end and against a REAL process death (the tier-1
+# tests cover the same invariant in-process via the fault harness):
+# auto-resume from the latest finalized checkpoint, recover_interrupted
+# cleanup of whatever the SIGKILL left behind, and the determinism of
+# the batch_fn(step) data stream.
+set -euo pipefail
+
+STEPS=${1:-40}
+KILL_AFTER=${2:-18}   # past the ~13s import+compile, well before the end
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d /tmp/ddp_tpu_smoke_resume.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+
+export JAX_PLATFORMS=cpu
+export PYTHONUNBUFFERED=1
+# job.py lives outside the repo; make the package importable anyway.
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+# The job lives in a real file so the interrupted run can background
+# `python` DIRECTLY: backgrounding a shell function would make $! a
+# subshell pid and the SIGKILL would miss the python process.
+cat > "$WORK/job.py" <<'PY'
+import sys
+
+from distributed_dot_product_tpu._compat import ensure_cpu_devices
+ensure_cpu_devices(8)
+
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_dot_product_tpu import (
+    DistributedDotProductAttn, TrainLoopConfig, TrainState, run_training,
+    seq_mesh,
+)
+from distributed_dot_product_tpu.train import make_train_step
+
+ckpt_dir, loss_out, steps = sys.argv[1] or None, sys.argv[2], int(sys.argv[3])
+
+mesh = seq_mesh(8)
+dim, heads, t, b = 16, 2, 16, 2
+model = DistributedDotProductAttn(key_dim=dim, num_heads=heads, offset=2)
+x0 = jax.random.normal(jax.random.key(0), (b, t, dim), jnp.float32)
+mask = jnp.zeros((b, t, t), dtype=bool)
+params = model.init(jax.random.key(1), x0, x0, x0, mask)
+optimizer = optax.adam(1e-2)
+step = make_train_step(model, optimizer, mesh, donate=False, guard=True)
+
+
+def batch_fn(i):
+    key = jax.random.fold_in(jax.random.key(2), i)
+    x = jax.random.normal(key, (b, t, dim), jnp.float32)
+    return (x, x, x, mask, jnp.zeros_like(x))
+
+
+# Slow the loop so the SIGKILL reliably lands mid-run.
+def slow_batch_fn(i):
+    time.sleep(0.5)
+    return batch_fn(i)
+
+cfg = TrainLoopConfig(num_steps=steps, ckpt_dir=ckpt_dir, ckpt_every=2,
+                      keep_last=3)
+result = run_training(step, TrainState(0, params, optimizer.init(params)),
+                      slow_batch_fn if ckpt_dir else batch_fn, cfg)
+final = result.losses.get(result.state.step - 1)
+if final is None:
+    # Resumed at/after num_steps: the "interrupted" run already finished
+    # before the kill landed — no final step executed here to compare.
+    print(f'nothing to do: resumed at step {result.state.step}',
+          file=sys.stderr)
+    sys.exit(2)
+with open(loss_out, 'w') as f:
+    f.write(repr(final))
+print(f'done: step={result.state.step} final_loss={final!r} '
+      f'resumed_from={result.resumed_from}')
+if ckpt_dir and '--expect-resume' in sys.argv and result.resumed_from is None:
+    print('no checkpoint found at start: the kill landed before the first '
+          'save (try a larger kill_after)', file=sys.stderr)
+    sys.exit(3)
+PY
+
+run_job() {  # run_job <ckpt_dir_or_empty> <loss_out> [--expect-resume]
+    (cd "$REPO" && python "$WORK/job.py" "$1" "$2" "$STEPS" "${3:-}")
+}
+
+echo "== uninterrupted reference run ($STEPS steps)"
+run_job "" "$WORK/loss_ref"
+
+echo "== interrupted run: SIGKILL after ${KILL_AFTER}s"
+(cd "$REPO" && exec python "$WORK/job.py" "$WORK/ckpt" \
+    "$WORK/loss_killed" "$STEPS") &
+PID=$!
+sleep "$KILL_AFTER"
+if kill -9 "$PID" 2>/dev/null; then
+    wait "$PID" 2>/dev/null || true
+    echo "== killed pid $PID; restarting"
+else
+    echo "!! job finished before the kill landed — raise steps or lower" \
+         "kill_after for a real mid-run kill" >&2
+fi
+
+echo "== resumed run"
+if ! run_job "$WORK/ckpt" "$WORK/loss_resumed" --expect-resume; then
+    echo "!! no genuine mid-run kill/resume was exercised — tune" \
+         "kill_after (killed too late: run finished; too early: no" \
+         "checkpoint yet)" >&2
+    exit 1
+fi
+
+REF="$(cat "$WORK/loss_ref")"
+RES="$(cat "$WORK/loss_resumed")"
+echo "== reference final loss: $REF"
+echo "== resumed   final loss: $RES"
+if [ "$REF" = "$RES" ]; then
+    echo "== smoke_resume OK: kill/resume run matches uninterrupted run"
+else
+    echo "== smoke_resume FAILED: losses differ" >&2
+    exit 1
+fi

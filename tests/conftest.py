@@ -27,8 +27,11 @@ _N_DEVICES = 8
 #   DDP_TPU_TESTS_ON_TPU=1 pytest tests -m tpu
 # Everything else assumes the 8-device CPU mesh and is skipped/fails there.
 if not os.environ.get('DDP_TPU_TESTS_ON_TPU'):
-    jax.config.update('jax_platforms', 'cpu')
-    jax.config.update('jax_num_cpu_devices', _N_DEVICES)
+    # ensure_cpu_devices handles old jax (no jax_num_cpu_devices option)
+    # by falling back to the XLA_FLAGS host-platform knob; importing the
+    # package also installs the jax.shard_map shim the tests rely on.
+    from distributed_dot_product_tpu._compat import ensure_cpu_devices
+    ensure_cpu_devices(_N_DEVICES)
 
 # Suite time is dominated by XLA:CPU compiles (~100 distinct jits), not by
 # the math — persist compiled executables across runs so the second and

@@ -1,0 +1,186 @@
+# -*- coding: utf-8 -*-
+"""
+Checkpoint-subsystem robustness: per-root async-save state scoping,
+structure-mismatch diagnostics, crash-mid-save recovery
+(``recover_interrupted``), and ``keep_last`` retention GC — the
+filesystem-level half of the fault-tolerance contract (the driver-level
+half lives in test_train_loop.py).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.utils import checkpoint as ckpt
+from distributed_dot_product_tpu.utils.checkpoint import (
+    CheckpointMismatchError, TrainState, gc_old_steps, latest_step,
+    recover_interrupted, restore, save, wait,
+)
+
+
+def _state(step, scale=1.0):
+    return TrainState(step, {'w': jnp.full((4,), scale)},
+                      {'m': jnp.zeros((4,))})
+
+
+def test_async_pending_state_scoped_per_root(tmp_path):
+    """Two runs (roots) in one process must not interleave each other's
+    deferred-backup cleanup: wait(A) finalizes and cleans A's overwrite
+    backup but leaves B's pending bookkeeping for B's own wait."""
+    root_a, root_b = str(tmp_path / 'a'), str(tmp_path / 'b')
+    save(root_a, _state(1, 1.0))
+    save(root_b, _state(1, 10.0))
+    # Async overwrites on BOTH roots: each defers its backup cleanup.
+    save(root_a, _state(1, 2.0), blocking=False)
+    save(root_b, _state(1, 20.0), blocking=False)
+    pend_a = ckpt._pending(root_a)
+    pend_b = ckpt._pending(root_b)
+    assert pend_a.async_pending and pend_b.async_pending
+    assert len(pend_a.backups) == 1 and len(pend_b.backups) == 1
+
+    wait(root_a)
+    assert not pend_a.async_pending and not pend_a.backups
+    # B untouched: still pending, backup still tracked (and on disk).
+    assert pend_b.async_pending and len(pend_b.backups) == 1
+    assert not any(n.endswith('.replaced') for n in os.listdir(root_a))
+
+    wait(root_b)
+    assert not pend_b.async_pending and not pend_b.backups
+    assert not any(n.endswith('.replaced') for n in os.listdir(root_b))
+    # Both roots restore their own (new) contents.
+    got_a = restore(root_a, _state(0))
+    got_b = restore(root_b, _state(0))
+    np.testing.assert_array_equal(np.asarray(got_a.params['w']),
+                                  np.full((4,), 2.0))
+    np.testing.assert_array_equal(np.asarray(got_b.params['w']),
+                                  np.full((4,), 20.0))
+
+
+def test_bare_wait_finalizes_all_roots(tmp_path):
+    root_a, root_b = str(tmp_path / 'a'), str(tmp_path / 'b')
+    save(root_a, _state(1))
+    save(root_b, _state(1))
+    save(root_a, _state(1, 2.0), blocking=False)
+    save(root_b, _state(1, 2.0), blocking=False)
+    wait()
+    for root in (root_a, root_b):
+        st = ckpt._pending(root)
+        assert not st.async_pending and not st.backups
+        assert not any(n.endswith('.replaced') for n in os.listdir(root))
+
+
+def test_restore_mismatch_raises_diagnostic_error(tmp_path):
+    """A template that doesn't match the on-disk tree must produce a
+    CheckpointMismatchError naming the step dir, both structures, and
+    the TrainState-change hint — not an opaque orbax traceback."""
+    save(tmp_path, _state(3))
+    bad_template = TrainState(0, {'completely': {'different': jnp.zeros(2)}},
+                              {'m': jnp.zeros((4,))})
+    with pytest.raises(CheckpointMismatchError) as ei:
+        restore(tmp_path, bad_template)
+    msg = str(ei.value)
+    assert 'step_000000003' in msg
+    assert 'expected (template)' in msg and 'found (on disk)' in msg
+    assert 'hint' in msg and 'TrainState' in msg
+    # The original orbax error is chained for debugging.
+    assert ei.value.__cause__ is not None
+    # A matching template still restores fine afterwards.
+    assert restore(tmp_path, _state(0)).step == 3
+
+
+def test_restore_missing_still_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore(tmp_path / 'nope', _state(0))
+
+
+def test_restore_io_errors_keep_their_type(tmp_path, monkeypatch):
+    """Transient I/O failures during restore must NOT be rebranded as
+    structure mismatches — callers need the OSError type to classify
+    and retry them."""
+    save(tmp_path, _state(1))
+
+    class _FlakyCkptr:
+        def restore(self, *a, **k):
+            raise PermissionError('storage said no')
+
+    monkeypatch.setattr(ckpt, '_checkpointer', lambda: _FlakyCkptr())
+    with pytest.raises(PermissionError):
+        restore(tmp_path, _state(0))
+
+
+def test_latest_step_skips_partial_write_and_recovers(tmp_path):
+    """Crash-mid-save recovery: an unfinalized .orbax-checkpoint-tmp dir
+    and a step_N.replaced backup on disk — latest_step skips the partial
+    write; recover_interrupted removes it and restores the backup, after
+    which the newest finalized step is the recovered one."""
+    save(tmp_path, _state(1, 1.0))
+    save(tmp_path, _state(2, 2.0))
+    # Simulate a crash mid-OVERWRITE of step 2: the old step 2 was
+    # renamed to .replaced and the replacement write never finalized.
+    d2 = tmp_path / 'step_000000002'
+    d2.rename(tmp_path / 'step_000000002.replaced')
+    partial = tmp_path / 'step_000000002.orbax-checkpoint-tmp-42'
+    partial.mkdir()
+    (partial / 'partial').write_text('dead write')
+
+    assert latest_step(tmp_path) == 1   # partial + backup both skipped
+
+    actions = recover_interrupted(tmp_path)
+    kinds = {a for a, _ in actions}
+    assert 'removed-partial' in kinds and 'restored-backup' in kinds
+    assert latest_step(tmp_path) == 2   # the backup IS step 2 again
+    got = restore(tmp_path, _state(0))
+    assert got.step == 2
+    np.testing.assert_array_equal(np.asarray(got.params['w']),
+                                  np.full((4,), 2.0))
+    assert not any('.orbax-checkpoint-tmp' in n
+                   for n in os.listdir(tmp_path))
+
+
+def test_recover_removes_stale_backup_of_finalized_step(tmp_path):
+    save(tmp_path, _state(1, 1.0))
+    # A stale backup whose original finalized fine: cleanup only.
+    stale = tmp_path / 'step_000000001.replaced'
+    stale.mkdir()
+    (stale / 'junk').write_text('old')
+    actions = recover_interrupted(tmp_path)
+    assert ('removed-stale-backup', 'step_000000001.replaced') in actions
+    assert latest_step(tmp_path) == 1
+    assert not (tmp_path / 'step_000000001.replaced').exists()
+
+
+def test_gc_old_steps_keeps_newest_finalized(tmp_path):
+    for s in (1, 2, 3, 4, 5):
+        save(tmp_path, _state(s, float(s)))
+    # An unfinalized partial must neither count toward keep_last nor be
+    # deleted (it may be an in-flight async save).
+    partial = tmp_path / 'step_000000006.orbax-checkpoint-tmp-1'
+    partial.mkdir()
+    deleted = gc_old_steps(tmp_path, keep_last=2)
+    assert deleted == [1, 2, 3]
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith('step_'))
+    assert names == ['step_000000004', 'step_000000005',
+                     'step_000000006.orbax-checkpoint-tmp-1']
+    assert latest_step(tmp_path) == 5
+    got = restore(tmp_path, _state(0))
+    np.testing.assert_array_equal(np.asarray(got.params['w']),
+                                  np.full((4,), 5.0))
+    # keep_last larger than what exists: no-op.
+    assert gc_old_steps(tmp_path, keep_last=10) == []
+    # Disabled retention: no-op.
+    assert gc_old_steps(tmp_path, keep_last=0) == []
+
+
+def test_gc_removes_stale_backups_of_deleted_steps(tmp_path):
+    for s in (1, 2, 3):
+        save(tmp_path, _state(s))
+    stale = tmp_path / 'step_000000001.replaced'
+    stale.mkdir()
+    (stale / 'junk').write_text('x')
+    assert gc_old_steps(tmp_path, keep_last=1) == [1, 2]
+    names = set(os.listdir(tmp_path))
+    assert 'step_000000001.replaced' not in names
+    assert 'step_000000003' in names

@@ -1,0 +1,118 @@
+# -*- coding: utf-8 -*-
+"""
+Fault-injection harness unit tests (utils/faults.py): each seam behaves
+deterministically on its own, so the driver tests that compose them
+(test_train_loop.py) are debuggable when they fail.
+"""
+
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.utils import checkpoint as ckpt
+from distributed_dot_product_tpu.utils.faults import (
+    FaultInjector, FaultPlan, SimulatedCrash, plan_from_env, poison_batch,
+)
+
+
+def test_poison_batch_nans_floats_only():
+    batch = (jnp.ones((2, 3)), jnp.arange(4), None,
+             jnp.zeros((2,), dtype=bool), {'t': jnp.full((2,), 2.0)})
+    poisoned = poison_batch(batch)
+    assert np.isnan(np.asarray(poisoned[0])).all()
+    np.testing.assert_array_equal(np.asarray(poisoned[1]), np.arange(4))
+    assert poisoned[2] is None
+    assert poisoned[3].dtype == bool
+    assert np.isnan(np.asarray(poisoned[4]['t'])).all()
+
+
+def test_poison_batch_requires_float_leaves():
+    """All-integer batches (LM tokens) cannot carry a NaN: silently not
+    injecting would fake guard coverage, so it must raise."""
+    with pytest.raises(ValueError, match='no floating'):
+        poison_batch((jnp.arange(4), None))
+
+
+def test_plan_from_env_parses_knobs():
+    env = {'DDP_TPU_FAULT_NAN_STEPS': '3, 7',
+           'DDP_TPU_FAULT_IO_ERRORS': '2',
+           'DDP_TPU_FAULT_CRASH_SAVE_STEP': '10',
+           'DDP_TPU_FAULT_SIGTERM_STEP': '20'}
+    plan = plan_from_env(env)
+    assert plan.nan_at_steps == frozenset({3, 7})
+    assert plan.io_error_saves == 2
+    assert plan.crash_in_save_at_step == 10
+    assert plan.sigterm_at_step == 20
+    assert plan.any()
+    assert not plan_from_env({}).any()
+
+
+def test_wrapped_batch_fn_fires_once_per_step():
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({1})))
+    wrapped = inj.wrap_batch_fn(lambda i: (jnp.ones(3),))
+    assert not np.isnan(np.asarray(wrapped(0)[0])).any()
+    assert np.isnan(np.asarray(wrapped(1)[0])).all()
+    # fire_once (the default): the replay after a rollback is clean.
+    assert not np.isnan(np.asarray(wrapped(1)[0])).any()
+
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({1}),
+                                  fire_once=False))
+    wrapped = inj.wrap_batch_fn(lambda i: (jnp.ones(3),))
+    assert np.isnan(np.asarray(wrapped(1)[0])).all()
+    assert np.isnan(np.asarray(wrapped(1)[0])).all()
+
+
+def test_io_error_injection_counts_down(tmp_path):
+    state = ckpt.TrainState(1, {'w': jnp.zeros(3)}, {'m': jnp.zeros(3)})
+    inj = FaultInjector(FaultPlan(io_error_saves=2))
+    with inj:
+        with pytest.raises(OSError, match='injected'):
+            ckpt.save(tmp_path, state)
+        with pytest.raises(OSError, match='injected'):
+            ckpt.save(tmp_path, state)
+        ckpt.save(tmp_path, state)   # countdown exhausted: save lands
+    assert ckpt.latest_step(tmp_path) == 1
+
+
+def test_crash_mid_save_leaves_unfinalized_dir(tmp_path):
+    import os
+    state = ckpt.TrainState(4, {'w': jnp.zeros(3)}, {'m': jnp.zeros(3)})
+    inj = FaultInjector(FaultPlan(crash_in_save_at_step=4))
+    with inj:
+        with pytest.raises(SimulatedCrash):
+            ckpt.save(tmp_path, state)
+    names = os.listdir(tmp_path)
+    assert any('.orbax-checkpoint-tmp' in n for n in names)
+    assert ckpt.latest_step(tmp_path) is None   # partial never selected
+    # SimulatedCrash models process death: no except-Exception handler
+    # (e.g. a retry loop) may swallow it.
+    assert not issubclass(SimulatedCrash, Exception)
+
+
+def test_injector_install_is_exclusive_and_restores():
+    inj1 = FaultInjector(FaultPlan(io_error_saves=1))
+    inj2 = FaultInjector(FaultPlan(io_error_saves=1))
+    with inj1:
+        with pytest.raises(RuntimeError, match='already installed'):
+            inj2.install()
+    assert ckpt._SAVE_FAULT_HOOK is None
+    with inj2:
+        assert ckpt._SAVE_FAULT_HOOK is inj2._hook
+    assert ckpt._SAVE_FAULT_HOOK is None
+
+
+def test_synthetic_sigterm_is_a_real_signal():
+    got = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        inj = FaultInjector(FaultPlan(sigterm_at_step=5))
+        inj.on_step(4)
+        assert got == []
+        inj.on_step(5)
+        assert got == [signal.SIGTERM]
+        inj.on_step(5)   # one-shot: a second visit does not re-signal
+        assert got == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, old)

@@ -94,9 +94,9 @@ def test_multihost_benchmark_aggregation(tmp_path):
                 '--heads', '4', '--num-processes', '2',
                 '--process-id', str(pid),
                 '--coordinator', f'127.0.0.1:{port}', '--file', out_file]
-        return ('import jax, sys; '
-                "jax.config.update('jax_platforms', 'cpu'); "
-                "jax.config.update('jax_num_cpu_devices', 4); "
+        return ('import sys; '
+                'from distributed_dot_product_tpu._compat import '
+                'ensure_cpu_devices; ensure_cpu_devices(4); '
                 f'sys.argv = {argv!r}; '
                 'import benchmark; benchmark.main()')
 

@@ -87,9 +87,9 @@ def test_graft_dryrun_self_provisions_from_single_device():
     this (MULTICHIP_r01.json rc=1). Runs in a subprocess so the conftest's
     8-device pin can't mask the condition."""
     import subprocess
-    code = ("import jax; "
-            "jax.config.update('jax_platforms', 'cpu'); "
-            "jax.config.update('jax_num_cpu_devices', 1); "
+    code = ("from distributed_dot_product_tpu._compat import "
+            "ensure_cpu_devices; ensure_cpu_devices(1); "
+            "import jax; "
             "assert len(jax.devices()) == 1, jax.devices(); "
             "import __graft_entry__ as g; g.dryrun_multichip(8)")
     env = {k: v for k, v in os.environ.items()

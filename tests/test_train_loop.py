@@ -1,0 +1,347 @@
+# -*- coding: utf-8 -*-
+"""
+Resilient-driver invariants, exercised through the deterministic
+fault-injection harness (utils/faults.py) — no real preemption or flaky
+disk needed:
+
+- kill/resume: a run interrupted by SIGTERM (and separately by a
+  simulated crash mid-save) resumes and produces BIT-IDENTICAL per-step
+  losses to an uninterrupted run;
+- NaN guard: an injected NaN step leaves params/opt_state exactly at
+  their step-(S-1) values (update skipped in-program), is counted, and K
+  consecutive bad steps trigger rollback to the last checkpoint;
+- retention: keep_last=N leaves exactly the N newest finalized step dirs;
+- transient checkpoint I/O errors are retried with backoff.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_dot_product_tpu import DistributedDotProductAttn
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+from distributed_dot_product_tpu.train import make_train_step
+from distributed_dot_product_tpu.train_loop import (
+    TrainLoopConfig, run_training,
+)
+from distributed_dot_product_tpu.utils.checkpoint import (
+    TrainState, latest_step,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    FaultInjector, FaultPlan, SimulatedCrash,
+)
+
+DIM, HEADS, T, B = 16, 2, 16, 2
+
+
+@pytest.fixture(scope='module')
+def rig():
+    """One compiled guarded step + deterministic data stream shared by
+    every test (initial params are never mutated: donate=False)."""
+    mesh = seq_mesh(8)
+    model = DistributedDotProductAttn(key_dim=DIM, num_heads=HEADS,
+                                      offset=2)
+    x0 = jax.random.normal(jax.random.key(0), (B, T, DIM), jnp.float32)
+    mask = jnp.zeros((B, T, T), dtype=bool)
+    params = model.init(jax.random.key(1), x0, x0, x0, mask)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    step = make_train_step(model, optimizer, mesh, donate=False,
+                           guard=True)
+
+    def batch_fn(i):
+        # Pure function of the step index: the property that makes
+        # kill/resume bit-identical (and it is what we assert).
+        key = jax.random.fold_in(jax.random.key(2), i)
+        x = jax.random.normal(key, (B, T, DIM), jnp.float32)
+        target = jnp.zeros_like(x)
+        return (x, x, x, mask, target)
+
+    return step, TrainState(0, params, opt_state), batch_fn
+
+
+def _clean_losses(rig_tuple, num_steps, tmp=None):
+    step, state0, batch_fn = rig_tuple
+    cfg = TrainLoopConfig(num_steps=num_steps,
+                          ckpt_dir=str(tmp) if tmp else None)
+    return run_training(step, state0, batch_fn, cfg)
+
+
+def test_uninterrupted_run_counts_and_saves(rig, tmp_path):
+    res = _clean_losses(rig, 4, tmp_path / 'base')
+    assert sorted(res.losses) == [0, 1, 2, 3]
+    assert res.bad_steps == 0 and res.rollbacks == 0
+    assert not res.preempted and res.exit_code == 0
+    assert res.state.step == 4
+    assert latest_step(tmp_path / 'base') == 4   # final save landed
+
+
+def test_sigterm_resume_bit_identical(rig, tmp_path):
+    """Kill/resume invariant, SIGTERM flavor: preempted at step 3, final
+    blocking save, clean 128+15 exit code; the restarted driver resumes
+    and every per-step loss equals the uninterrupted run's, bitwise."""
+    step, state0, batch_fn = rig
+    want = _clean_losses(rig, 6).losses
+
+    ck = str(tmp_path / 'sig')
+    inj = FaultInjector(FaultPlan(sigterm_at_step=3))
+    cfg = TrainLoopConfig(num_steps=6, ckpt_dir=ck, ckpt_every=2)
+    res1 = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res1.preempted and res1.exit_code == 128 + 15
+    assert res1.state.step == 3          # steps 0..2 ran, 3 never did
+    assert latest_step(ck) == 3          # the final preemption save
+
+    res2 = run_training(step, state0, batch_fn, cfg)   # "restart"
+    assert res2.resumed_from == 3 and not res2.preempted
+    merged = dict(res1.losses)
+    merged.update(res2.losses)
+    assert set(merged) == set(want)
+    np.testing.assert_array_equal(
+        [merged[i] for i in sorted(merged)],
+        [want[i] for i in sorted(want)])
+
+
+def test_crash_mid_save_resume_bit_identical(rig, tmp_path):
+    """Kill/resume invariant, crash flavor: the save of step 4 dies
+    mid-write (unfinalized orbax dir left behind); the restarted driver
+    skips the partial write, resumes from the newest finalized step, and
+    reproduces the uninterrupted losses bitwise."""
+    step, state0, batch_fn = rig
+    want = _clean_losses(rig, 6).losses
+
+    ck = str(tmp_path / 'crash')
+    inj = FaultInjector(FaultPlan(crash_in_save_at_step=4))
+    cfg = TrainLoopConfig(num_steps=6, ckpt_dir=ck, ckpt_every=2,
+                          async_saves=False)
+    with pytest.raises(SimulatedCrash):
+        run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    # The partial write is on disk but must never be selected.
+    import os
+    assert any('.orbax-checkpoint-tmp' in n for n in os.listdir(ck))
+    assert latest_step(ck) == 2
+
+    res2 = run_training(step, state0, batch_fn, cfg)
+    assert res2.resumed_from == 2
+    assert set(res2.losses) == {2, 3, 4, 5}   # replayed from step 2
+    np.testing.assert_array_equal(
+        [res2.losses[i] for i in sorted(res2.losses)],
+        [want[i] for i in (2, 3, 4, 5)])
+
+
+def test_nan_guard_skips_update_and_counts(rig, tmp_path):
+    """NaN-guard invariant: with a NaN gradient injected at step S, the
+    params/opt_state after step S are EXACTLY those after step S-1, and
+    the step is counted as bad (but the run continues)."""
+    step, state0, batch_fn = rig
+    s_bad = 2
+    snapshots = {}
+    # Drive manually around the injector to snapshot params per step.
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({s_bad})))
+    wrapped = inj.wrap_batch_fn(batch_fn)
+    params, opt_state = state0.params, state0.opt_state
+    with inj:
+        for i in range(4):
+            params, opt_state, rec = step(params, opt_state, wrapped(i),
+                                          dropout_seed=i)
+            rec = jax.device_get(rec)
+            snapshots[i] = (params, opt_state, rec)
+    assert bool(snapshots[s_bad][2]['bad_step'])
+    assert not np.isfinite(snapshots[s_bad][2]['loss'])
+    assert all(not bool(snapshots[i][2]['bad_step'])
+               for i in (0, 1, 3))
+    # params/opt_state after the bad step == after the previous step.
+    for tree_bad, tree_prev in zip(snapshots[s_bad][:2],
+                                   snapshots[s_bad - 1][:2]):
+        for a, b in zip(jax.tree.leaves(tree_bad),
+                        jax.tree.leaves(tree_prev)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...and the guarded step recovered on the next clean batch.
+    assert np.isfinite(snapshots[3][2]['loss'])
+
+    # Same invariant through the driver, which must also count it.
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({s_bad})))
+    cfg = TrainLoopConfig(num_steps=4, ckpt_dir=str(tmp_path / 'nan'),
+                          max_bad_steps=3)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.bad_steps == 1 and res.rollbacks == 0
+    assert not np.isfinite(res.losses[s_bad])
+    assert np.isfinite(res.losses[3])
+
+
+def test_consecutive_bad_steps_roll_back_to_checkpoint(rig, tmp_path):
+    """K consecutive bad steps trigger rollback to the last checkpoint;
+    the replayed (clean, fire_once injection) trajectory then matches the
+    uninterrupted run exactly."""
+    step, state0, batch_fn = rig
+    want = _clean_losses(rig, 6).losses
+
+    ck = str(tmp_path / 'roll')
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({2, 3})))
+    cfg = TrainLoopConfig(num_steps=6, ckpt_dir=ck, ckpt_every=2,
+                          max_bad_steps=2)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.bad_steps == 2 and res.rollbacks == 1
+    # Replay overwrote the bad records: the surviving per-step losses are
+    # the uninterrupted run's, bitwise.
+    assert set(res.losses) == set(want)
+    np.testing.assert_array_equal(
+        [res.losses[i] for i in sorted(res.losses)],
+        [want[i] for i in sorted(want)])
+
+
+def test_rollback_without_checkpoint_restores_initial_state(rig):
+    step, state0, batch_fn = rig
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({0, 1})))
+    cfg = TrainLoopConfig(num_steps=3, ckpt_dir=None, max_bad_steps=2)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.rollbacks == 1 and res.bad_steps == 2
+    assert np.isfinite(res.losses[2])
+    clean = _clean_losses(rig, 3).losses
+    np.testing.assert_array_equal(
+        [res.losses[i] for i in sorted(res.losses)],
+        [clean[i] for i in sorted(clean)])
+
+
+def test_tail_rollback_reenters_training(rig):
+    """A rollback triggered on the FINAL inflight record (processed
+    after the dispatch loop exits) must re-enter training, not return
+    'success' short of num_steps."""
+    step, state0, batch_fn = rig
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({1, 2})))
+    cfg = TrainLoopConfig(num_steps=3, ckpt_dir=None, max_bad_steps=2)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.state.step == 3
+    assert res.rollbacks == 1 and res.bad_steps == 2
+    clean = _clean_losses(rig, 3).losses
+    np.testing.assert_array_equal([res.losses[i] for i in range(3)],
+                                  [clean[i] for i in range(3)])
+
+
+def test_bad_step_boundary_not_checkpointed(rig, tmp_path):
+    """A boundary save scheduled right after a detected-bad step is
+    skipped: for bare-loss steps it would checkpoint poisoned params
+    (and keep_last GC would then destroy the good checkpoints)."""
+    import os
+    step, state0, batch_fn = rig
+    ck = str(tmp_path / 'badsave')
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({1})))
+    cfg = TrainLoopConfig(num_steps=4, ckpt_dir=ck, ckpt_every=2)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.bad_steps == 1 and res.state.step == 4
+    assert 'step_000000002' not in os.listdir(ck)   # bad boundary skipped
+    assert latest_step(ck) == 4
+
+
+def test_persistent_divergence_raises(rig):
+    step, state0, batch_fn = rig
+    # fire_once=False: the NaN comes back after every rollback.
+    inj = FaultInjector(FaultPlan(nan_at_steps=frozenset({0, 1}),
+                                  fire_once=False))
+    cfg = TrainLoopConfig(num_steps=3, ckpt_dir=None, max_bad_steps=2,
+                          max_rollbacks=1)
+    with pytest.raises(RuntimeError, match='diverged'):
+        run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+
+
+def test_checkpoint_retention_keep_last(rig, tmp_path):
+    """After a run with keep_last=3 and a save every step, exactly the 3
+    newest finalized step dirs remain and latest_step still resolves."""
+    import os
+    step, state0, batch_fn = rig
+    ck = str(tmp_path / 'keep')
+    cfg = TrainLoopConfig(num_steps=6, ckpt_dir=ck, ckpt_every=1,
+                          keep_last=3)
+    res = run_training(step, state0, batch_fn, cfg)
+    assert res.state.step == 6
+    step_dirs = sorted(n for n in os.listdir(ck) if n.startswith('step_'))
+    assert step_dirs == ['step_000000004', 'step_000000005',
+                         'step_000000006']
+    assert latest_step(ck) == 6
+
+
+def test_guard_refuses_donation():
+    """guard=True with explicit donate=True is a contract violation (the
+    driver's rollback path reuses earlier buffers); the default resolves
+    to the compatible value instead."""
+    mesh = seq_mesh(8)
+    model = DistributedDotProductAttn(key_dim=DIM, num_heads=HEADS,
+                                      offset=2)
+    with pytest.raises(ValueError, match='donate=False'):
+        make_train_step(model, optax.adam(1e-2), mesh, guard=True,
+                        donate=True)
+    # Defaulted donate with guard=True builds fine (donate=False picked).
+    make_train_step(model, optax.adam(1e-2), mesh, guard=True)
+
+
+def test_run_training_rejects_donating_step(rig):
+    """The default unguarded step donates its params/opt_state buffers —
+    incompatible with the driver's save/rollback paths; run_training
+    must refuse it up front instead of crashing mid-run on a deleted
+    array."""
+    _, state0, batch_fn = rig
+    mesh = seq_mesh(8)
+    model = DistributedDotProductAttn(key_dim=DIM, num_heads=HEADS,
+                                      offset=2)
+    donating = make_train_step(model, optax.adam(1e-2), mesh)
+    with pytest.raises(ValueError, match='non-donating'):
+        run_training(donating, state0, batch_fn,
+                     TrainLoopConfig(num_steps=1))
+
+
+def test_preempt_flag_escalates_on_second_signal():
+    """The first signal sets the flag AND restores the previous handlers
+    so a second signal terminates (e.g. a final save hung on unreachable
+    storage) instead of being swallowed."""
+    from distributed_dot_product_tpu.train_loop import _PreemptFlag
+    flag = _PreemptFlag()
+    restored = []
+    flag.restore = lambda: restored.append(True)
+    flag(15, None)
+    assert flag.set and flag.signum == 15 and restored == [True]
+    flag(15, None)          # second signal: restore NOT re-run
+    assert restored == [True]
+
+
+def test_failed_async_flush_falls_back_to_blocking_save(
+        rig, tmp_path, monkeypatch):
+    """A transient error surfacing from the BACKGROUND flush (raised by
+    wait, not by save) must not kill the run: the driver abandons the
+    pending bookkeeping and lands a blocking final save."""
+    from distributed_dot_product_tpu.utils import checkpoint as ckpt_mod
+
+    step, state0, batch_fn = rig
+    ck = str(tmp_path / 'flush')
+    real_wait = ckpt_mod.wait
+    calls = {'n': 0}
+
+    def flaky_wait(path=None):
+        calls['n'] += 1
+        if calls['n'] == 1:
+            raise OSError('injected background-flush failure')
+        return real_wait(path)
+
+    monkeypatch.setattr(ckpt_mod, 'wait', flaky_wait)
+    cfg = TrainLoopConfig(num_steps=4, ckpt_dir=ck, ckpt_every=2)
+    res = run_training(step, state0, batch_fn, cfg)
+    assert calls['n'] >= 1          # the failing drain was exercised
+    assert res.state.step == 4
+    assert latest_step(ck) == 4     # blocking fallback save landed
+
+
+def test_transient_save_errors_are_retried(rig, tmp_path):
+    step, state0, batch_fn = rig
+    ck = str(tmp_path / 'retry')
+    inj = FaultInjector(FaultPlan(io_error_saves=2))
+    cfg = TrainLoopConfig(num_steps=2, ckpt_dir=ck, save_retries=3,
+                          save_backoff=0.01)
+    res = run_training(step, state0, batch_fn, cfg, fault_injector=inj)
+    assert res.state.step == 2 and latest_step(ck) == 2
+
+    # More failures than retries: the error propagates.
+    inj = FaultInjector(FaultPlan(io_error_saves=10))
+    cfg = TrainLoopConfig(num_steps=2, ckpt_dir=str(tmp_path / 'retry2'),
+                          save_retries=1, save_backoff=0.01)
+    with pytest.raises(OSError):
+        run_training(step, state0, batch_fn, cfg, fault_injector=inj)
