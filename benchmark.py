@@ -45,8 +45,12 @@ def parse_args():
     # Same surface as reference benchmark.py:29-39, plus TPU-native extras.
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
-                                           'train', 'decode', 'lm'],
+                                           'train', 'decode', 'lm',
+                                           'decode-serve'],
                         default='nt')
+    parser.add_argument('--serve-requests', type=int, default=None,
+                        help='decode-serve mode: burst size (default '
+                             '4x slots)')
     parser.add_argument('--layers', type=int, default=8,
                         help='lm mode: transformer depth')
     parser.add_argument('--vocab', type=int, default=32768,
@@ -735,6 +739,104 @@ def run_decode(args):
     return record
 
 
+def run_decode_serve(args):
+    """``--mode decode-serve``: what the continuous-batching scheduler
+    COSTS over the bare kernels. Two measurements on the same
+    :class:`~distributed_dot_product_tpu.serve.engine.KernelEngine`
+    shape: (a) a bare lockstep decode loop (all slots always active, no
+    admission/health/accounting — the ceiling) and (b) the scheduler
+    draining a request burst end to end (admission, chunked prefill,
+    per-slot retirement, metrics, watchdog). The gap is the serving
+    layer's host-side overhead at this batch size; at real cache sizes
+    the compiled step dominates and the gap vanishes into it."""
+    import time as _time
+
+    import numpy as np
+
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, Scheduler, ServeConfig,
+    )
+    from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+    slots = args.batch if args.batch > 1 else 4
+    t_max = args.seq_len or 256
+    h, d = args.heads, args.head_dim
+    max_new = 16
+    # Whole rounds of `slots` concurrent sequences: both measurements
+    # then serve the same token volume, and the bare loop's per-round
+    # resets keep every sequence inside t_max (an unreset loop would
+    # cross the traced-overflow guard and silently decode against a
+    # frozen cache).
+    n_rounds = -(-(args.serve_requests or 4 * slots) // slots)
+    n_requests = n_rounds * slots
+    prompt_len = min(8, t_max - max_new - 1)
+
+    def make_engine():
+        return KernelEngine(slots=slots, t_max=t_max, vocab=256, heads=h,
+                            head_dim=d, prefill_chunk=8, seed=0)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, size=prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+
+    # (a) bare kernel loop: every slot decodes every step, nothing else
+    # but the per-round slot resets a real serving loop would also do.
+    eng = make_engine()
+    tokens = np.zeros(slots, np.int32)
+    active = np.ones(slots, bool)
+    steps_per_seq = prompt_len + max_new
+    eng.step(tokens, active)                      # compile + warm
+    for i in range(slots):
+        eng.reset(i)                              # warm append undone
+    t0 = _time.perf_counter()
+    for _ in range(n_rounds):
+        for _ in range(steps_per_seq):
+            tokens, _ = eng.step(tokens, active)
+        for i in range(slots):
+            eng.reset(i)
+    bare_s = _time.perf_counter() - t0
+    n_steps = n_rounds * steps_per_seq
+    bare_tps = slots * n_steps / bare_s
+
+    # (b) the scheduler serving the same token volume as a burst.
+    eng = make_engine()
+    eng.step(tokens, active)                      # same warm start
+    for i in range(slots):
+        eng.reset(i)                              # slots handed over clean
+    cfg = ServeConfig(queue_limit=max(8, n_requests),
+                      max_new_tokens=max_new, watchdog=False,
+                      degrade_watermark=1.1)      # measure undegraded
+    sched = Scheduler(eng, cfg, registry=MetricsRegistry())
+    t0 = _time.perf_counter()
+    for i, p in enumerate(prompts):
+        sched.submit(p, request_id=f'b{i}')
+    results = sched.run_until_idle()
+    sched_s = _time.perf_counter() - t0
+    sched.close()
+    n_tok = sum(len(r.tokens) for r in results.values())
+    sched_tps = n_tok / sched_s
+
+    record = {
+        'mode': 'decode-serve', 'slots': slots, 't_max': t_max,
+        'heads': h, 'head_dim': d, 'requests': n_requests,
+        'prompt_len': prompt_len, 'max_new_tokens': max_new,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'bare_tokens_per_s': bare_tps,
+        'sched_tokens_per_s': sched_tps,
+        'sched_overhead_pct': 100.0 * (bare_tps - sched_tps)
+                              / bare_tps,
+        'completed': sum(r.status == 'completed'
+                         for r in results.values()),
+    }
+    print(f"decode-serve slots={slots} t_max={t_max} "
+          f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
+          f"{bare_tps:,.0f} tok/s "
+          f"({record['sched_overhead_pct']:.1f}% overhead)")
+    _append_record(args.file, record)
+    return record
+
+
 def run(args):
     if args.mode == 'attn':
         return run_attn(args)
@@ -742,6 +844,8 @@ def run(args):
         return run_train(args)
     if args.mode == 'decode':
         return run_decode(args)
+    if args.mode == 'decode-serve':
+        return run_decode_serve(args)
     if args.mode == 'lm':
         return run_lm(args)
     mesh = seq_mesh(args.devices)

@@ -55,8 +55,9 @@ from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
     local_attention_reference, ring_attention,
 )
 from distributed_dot_product_tpu.models.decode import (  # noqa: F401
-    DecodeCache, append_kv, append_kv_sharded, decode_attention,
-    init_cache,
+    DecodeCache, append_kv, append_kv_sharded, append_kv_slots,
+    decode_attention, init_cache, init_slot_cache, reset_slot,
+    slots_all_finite,
 )
 from distributed_dot_product_tpu.models.transformer import (  # noqa: F401
     TransformerBlock, TransformerStack,
@@ -79,4 +80,8 @@ from distributed_dot_product_tpu.utils.checkpoint import (  # noqa: F401
 )
 from distributed_dot_product_tpu.train_loop import (  # noqa: F401
     TrainLoopConfig, TrainLoopResult, run_training,
+)
+from distributed_dot_product_tpu.serve import (  # noqa: F401
+    HealthMonitor, KernelEngine, Readiness, RejectReason, RejectedError,
+    Scheduler, ServeConfig,
 )

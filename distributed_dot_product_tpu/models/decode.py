@@ -54,7 +54,8 @@ from jax import lax
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
-           'decode_attention']
+           'decode_attention', 'init_slot_cache', 'append_kv_slots',
+           'reset_slot', 'slots_all_finite']
 
 
 class DecodeCache(NamedTuple):
@@ -228,6 +229,138 @@ def append_kv_sharded(cache: DecodeCache, k_new, v_new, *,
                        length=cache.length + n, k_q=k_q, k_scale=k_scale)
 
 
+def init_slot_cache(slots, kv_heads, t_max, head_dim, v_head_dim=None,
+                    dtype=jnp.bfloat16):
+    """Serving cache with PER-SLOT lengths: identical buffers to
+    :func:`init_cache` but ``length`` is a ``(slots,)`` vector — each
+    batch row is an independent decode slot holding its own sequence.
+    This is the continuous-batching substrate: slots fill, decode and
+    free on their own clocks (:func:`append_kv_slots`,
+    :func:`reset_slot`) with no whole-batch reallocation, and
+    :func:`decode_attention` masks each row against its own length.
+
+    The int8 K mirror is a chained-decode throughput optimization that
+    the serving scheduler doesn't drive yet, so ``qk_quant`` is not a
+    parameter here (a mirror-less cache still accepts
+    ``decode_attention(..., qk_quant='int8')`` via on-the-fly
+    quantization)."""
+    base = init_cache(slots, kv_heads, t_max, head_dim,
+                      v_head_dim=v_head_dim, dtype=dtype)
+    return base._replace(length=jnp.zeros((slots,), jnp.int32))
+
+
+def _concrete_lengths(length):
+    """Host ints when the length vector is concrete, else None (traced)."""
+    try:
+        return [int(x) for x in length]
+    except (jax.errors.ConcretizationTypeError, TypeError):
+        return None
+
+
+def append_kv_slots(cache: DecodeCache, k_new, v_new, *, slot_mask=None,
+                    counts=None) -> DecodeCache:
+    """Per-slot append onto a slot cache (``length`` a ``(B,)`` vector):
+    each slot's rows land at ITS length, in one compiled program.
+
+    ``k_new``/``v_new`` are ``(B, H_kv, n, d·)``; ``counts (B,) int32``
+    takes the first ``counts[i]`` of the ``n`` rows for slot ``i``
+    (padded prefill chunks keep one compiled shape; default: all ``n``);
+    ``slot_mask (B,) bool`` freezes unselected slots entirely (buffers
+    AND length — a decode step only appends for live slots).
+
+    The write is a masked gather over the ``t_max`` axis — O(t_max)
+    traffic, the same order as the attention step that follows, and the
+    only way distinct per-row offsets fit one ``jit``. Overflow matches
+    :func:`append_kv`'s contract per slot: concrete lengths raise
+    eagerly naming the slot; traced lengths write NOTHING for the
+    overflowing slot while its length still advances (detectable as
+    ``cache.length[i] > cache.t_max``)."""
+    if cache.length.ndim != 1:
+        raise ValueError(
+            'append_kv_slots needs a per-slot cache (init_slot_cache); '
+            'this cache has a scalar length — use append_kv')
+    b, _, _, _ = cache.k.shape
+    n = k_new.shape[-2]
+    if n > cache.t_max:
+        raise ValueError(f'appending {n} positions to a t_max='
+                         f'{cache.t_max} cache')
+    counts = (jnp.full((b,), n, jnp.int32) if counts is None
+              else jnp.asarray(counts, jnp.int32))
+    active = (jnp.ones((b,), bool) if slot_mask is None
+              else jnp.asarray(slot_mask, bool))
+    eff = jnp.where(active, jnp.clip(counts, 0, n), 0)     # rows per slot
+
+    host_len = _concrete_lengths(cache.length)
+    host_eff = _concrete_lengths(eff)
+    if host_len is not None and host_eff is not None:
+        for i, (cur, add) in enumerate(zip(host_len, host_eff)):
+            if cur + add > cache.t_max:
+                raise ValueError(
+                    f'KV-cache overflow on slot {i}: length {cur} + '
+                    f'{add} new positions exceeds t_max {cache.t_max} '
+                    f'— evict the slot (reset_slot) or stop its '
+                    f'generation loop')
+
+    ok = cache.length + eff <= cache.t_max                 # (B,)
+    g = jnp.arange(cache.t_max)[None, :]                   # (1, t_max)
+    lo = cache.length[:, None]                             # (B, 1)
+    hit = jnp.logical_and(
+        jnp.logical_and(g >= lo, g < lo + eff[:, None]),
+        ok[:, None])                                       # (B, t_max)
+    src = jnp.clip(g - lo, 0, n - 1)                       # (B, t_max)
+
+    def write(buf, new):
+        vals = jnp.take_along_axis(new.astype(buf.dtype),
+                                   src[:, None, :, None], axis=-2)
+        return jnp.where(hit[:, None, :, None], vals, buf)
+
+    k_q = k_scale = None
+    if cache.k_q is not None:
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        bb, h_kv, _, d = cache.k.shape
+        ki, sk = _quantize_rows(k_new.astype(cache.k.dtype), bb * h_kv,
+                                n, d)
+        k_q = write(cache.k_q, ki.reshape(bb, h_kv, n, d))
+        k_scale = write(cache.k_scale, sk.reshape(bb, h_kv, n, 1))
+    return DecodeCache(k=write(cache.k, k_new), v=write(cache.v, v_new),
+                       length=cache.length + eff, k_q=k_q,
+                       k_scale=k_scale)
+
+
+def reset_slot(cache: DecodeCache, slot) -> DecodeCache:
+    """Evict one sequence: zero slot ``slot``'s buffers and length. The
+    slot immediately serves a fresh sequence; every OTHER slot's bits
+    are untouched (tested bit-identical) and nothing reallocates —
+    that's the whole point of the per-slot length vector. ``slot`` may
+    be traced (one compiled program resets any slot)."""
+    if cache.length.ndim != 1:
+        raise ValueError(
+            'reset_slot needs a per-slot cache (init_slot_cache); a '
+            'scalar-length cache is reset by init_cache — its batch '
+            'rows share one sequence clock')
+    sel = jnp.arange(cache.k.shape[0]) == slot             # (B,)
+
+    def clear(buf):
+        return jnp.where(sel[:, None, None, None],
+                         jnp.zeros_like(buf), buf)
+
+    return cache._replace(
+        k=clear(cache.k), v=clear(cache.v),
+        length=jnp.where(sel, 0, cache.length),
+        k_q=None if cache.k_q is None else clear(cache.k_q),
+        k_scale=None if cache.k_scale is None else clear(cache.k_scale))
+
+
+def slots_all_finite(x):
+    """Per-slot all-finite predicate: ``(B, ...)`` → ``(B,) bool``. The
+    serving layer's quarantine test — the train loop's all-finite guard
+    (train.py ``guard=True``) at slot granularity, so ONE poisoned
+    sequence is evicted instead of failing the whole batch."""
+    return jnp.all(jnp.isfinite(x.reshape(x.shape[0], -1)), axis=-1)
+
+
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
                      alibi_slopes=None, segment_ids=None, seg_q=None,
                      qk_quant=None, axis_name=None):
@@ -313,29 +446,40 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     s = s.reshape(b, h_kv, group, n, t_max)
 
     # Query row i (0-based within the n new rows) sits at absolute
-    # position length - n + i; it attends positions <= its own. Sharded,
-    # this slab's columns sit at global offset shard·t_local.
+    # position length - n + i; it attends positions <= its own. A
+    # PER-SLOT cache (init_slot_cache: length is a (B,) vector) gives
+    # every batch row its own clock — each slot masks against its own
+    # length, which is what lets continuous batching pack sequences of
+    # different ages into one compiled step. Sharded, this slab's
+    # columns sit at global offset shard·t_local.
+    per_slot = cache.length.ndim == 1
+    if per_slot and axis_name is not None:
+        raise ValueError(
+            'per-slot lengths (init_slot_cache) are a local serving '
+            'construct; sequence-sharded decode uses the scalar global '
+            'length')
     col_off = (0 if axis_name is None
                else lax.axis_index(axis_name) * t_max)
-    pos_q = cache.length - n + jnp.arange(n)                # (n,)
+    lengths = cache.length[:, None] if per_slot else cache.length
+    pos_q = lengths - n + jnp.arange(n)       # (B, n) per-slot else (n,)
     pos_k = col_off + jnp.arange(t_max)                     # (t_local,)
-    allowed = pos_k[None, :] <= pos_q[:, None]              # (n, t_max)
+    rel = pos_k - pos_q[..., None]            # ([B,] n, t_max)
+    allowed = rel <= 0
     if window is not None:
-        allowed = jnp.logical_and(
-            allowed, pos_q[:, None] - pos_k[None, :] < window)
+        allowed = jnp.logical_and(allowed, -rel < window)
+    if not per_slot:
+        allowed, rel = allowed[None], rel[None]   # (1, n, t_max)
     if segment_ids is not None:
         if seg_q is None:
             raise ValueError('segment_ids needs seg_q (the query rows\' '
                              'ids)')
         same = (segment_ids[:, None, :] == seg_q[..., None])  # (B, n, Tm)
-        allowed = jnp.logical_and(allowed[None], same)[:, None, None]
-    else:
-        allowed = allowed[None, None, None]                 # bcast B,hkv,g
+        allowed = jnp.logical_and(allowed, same)
+    allowed = allowed[:, None, None]          # (B|1, 1, 1, n, Tm)
     if alibi_slopes is not None:
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
             h_kv, group, 1, 1)
-        s = s + slopes * (pos_k[None, :] - pos_q[:, None]).astype(
-            jnp.float32)
+        s = s + slopes * rel[:, None, None].astype(jnp.float32)
     s = jnp.where(allowed, s, -jnp.inf)
     m = jnp.max(s, axis=-1, keepdims=True)
     if axis_name is not None:

@@ -1,0 +1,37 @@
+# -*- coding: utf-8 -*-
+"""
+Resilient decode serving layer: continuous batching over the per-slot
+KV-cache kernels with admission control, backpressure, a watchdog
+health surface, and per-slot NaN quarantine.
+
+Composition (each piece standalone-testable):
+
+- :mod:`~distributed_dot_product_tpu.serve.engine` — the compiled
+  substrate: greedy decode over ``models/decode.py``'s per-slot cache.
+- :mod:`~distributed_dot_product_tpu.serve.admission` — bounded queue,
+  typed :class:`RejectedError` shedding, deadlines, token budgets,
+  degradation.
+- :mod:`~distributed_dot_product_tpu.serve.scheduler` — the
+  continuous-batching loop (admit → chunked prefill → batched decode →
+  retire) with the evict-before-reject ladder and quarantine/requeue.
+- :mod:`~distributed_dot_product_tpu.serve.health` — heartbeat
+  watchdog, liveness/readiness transitions, metrics snapshot.
+"""
+
+from distributed_dot_product_tpu.serve.admission import (  # noqa: F401
+    AdmissionController, RejectReason, RejectedError, Request,
+    RequestResult,
+)
+from distributed_dot_product_tpu.serve.engine import (  # noqa: F401
+    KernelEngine,
+)
+from distributed_dot_product_tpu.serve.health import (  # noqa: F401
+    HealthMonitor, Liveness, Readiness,
+)
+from distributed_dot_product_tpu.serve.scheduler import (  # noqa: F401
+    Scheduler, ServeConfig,
+)
+
+__all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
+           'Request', 'RequestResult', 'KernelEngine', 'HealthMonitor',
+           'Liveness', 'Readiness', 'Scheduler', 'ServeConfig']

@@ -1,0 +1,225 @@
+# -*- coding: utf-8 -*-
+"""
+Admission control and backpressure for the decode serving layer.
+
+A serving process dies from its edges, not its kernels: an unbounded
+queue OOMs the host, an oversized prompt wedges prefill, and a request
+that can never meet its deadline burns decode slots other requests need.
+This module owns the request boundary:
+
+- **Bounded queue**: ``queue_limit`` pending requests, hard. Past it the
+  scheduler sheds load (after trying eviction — scheduler.py's ladder).
+- **Typed rejection**: every shed request raises/records a
+  :class:`RejectedError` carrying a :class:`RejectReason` — operators
+  alarm on reasons, not on string-matching log lines, and the soak
+  invariant "zero dropped-without-reason" becomes checkable.
+- **Per-request deadlines**: absolute wall-clock points (injectable
+  clock for tests). Checked at submit (don't queue the doomed), while
+  queued (don't prefill the expired), and mid-stream (free the slot).
+- **Token budgets**: ``max_new_tokens`` clamped to the config cap and
+  to the cache capacity ``t_max - len(prompt)``; a prompt that leaves
+  no room to generate even one token is PROMPT_TOO_LONG.
+- **Graceful degradation**: above ``degrade_watermark`` queue pressure,
+  new requests are admitted with a REDUCED token budget
+  (``degraded_max_new_tokens``) instead of being rejected — trade
+  per-request depth for admission, shed only when that fails.
+"""
+
+import collections
+import dataclasses
+import enum
+import itertools
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ['RejectReason', 'RejectedError', 'Request', 'RequestResult',
+           'AdmissionController']
+
+
+class RejectReason(enum.Enum):
+    """Why a request was shed. The complete taxonomy — a rejection never
+    carries free text alone."""
+    QUEUE_FULL = 'queue_full'
+    DEADLINE_EXCEEDED = 'deadline_exceeded'
+    PROMPT_TOO_LONG = 'prompt_too_long'
+
+
+class RejectedError(Exception):
+    """A request was refused admission (or expired in the queue).
+    ``reason`` is always a :class:`RejectReason`."""
+
+    def __init__(self, reason: RejectReason, message: str):
+        super().__init__(f'[{reason.value}] {message}')
+        self.reason = reason
+
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its runtime bookkeeping (owned by the
+    scheduler once admitted). ``deadline`` is an ABSOLUTE clock value on
+    the scheduler's clock, or None for no deadline."""
+    prompt: np.ndarray
+    max_new_tokens: int
+    deadline: Optional[float] = None
+    id: str = ''
+    submitted_at: float = 0.0
+    # -- runtime state (scheduler-owned) --------------------------------
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    requeues: int = 0
+    degraded: bool = False
+    cancelled: bool = False
+    admit_index: Optional[int] = None   # admission order, fault-stable
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if not self.id:
+            self.id = f'req-{next(_ids)}'
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal record for one request. ``status`` is one of
+    ``'completed' | 'deadline_expired' | 'evicted' | 'abandoned' |
+    'failed_nan' | 'rejected'``; ``reason`` is the typed
+    :class:`RejectReason` when ``status == 'rejected'`` (else None).
+    Partial tokens are kept for every non-completed terminal state —
+    an evicted or expired stream still delivers what it produced."""
+    id: str
+    status: str
+    tokens: List[int]
+    prompt_len: int
+    reason: Optional[RejectReason] = None
+    requeues: int = 0
+    degraded: bool = False
+    finished_at: float = 0.0
+
+
+class AdmissionController:
+    """Bounded admission queue with validation, degradation and typed
+    shedding. The scheduler composes this with the slot engine; tests
+    drive it standalone with a virtual clock."""
+
+    def __init__(self, *, queue_limit, t_max, max_new_tokens,
+                 degrade_watermark=0.75, degraded_max_new_tokens=None,
+                 clock=time.monotonic, registry=None):
+        if queue_limit < 1:
+            raise ValueError(f'queue_limit must be >= 1, got {queue_limit}')
+        self.queue_limit = queue_limit
+        self.t_max = t_max
+        self.max_new_tokens = max_new_tokens
+        self.degrade_watermark = degrade_watermark
+        self.degraded_max_new_tokens = (degraded_max_new_tokens
+                                        or max(1, max_new_tokens // 4))
+        self.clock = clock
+        self._queue = collections.deque()
+        if registry is not None:
+            self._c_admit = registry.counter('serve.admitted')
+            self._c_degraded = registry.counter('serve.degraded')
+            self._c_reject = {r: registry.counter(f'serve.rejected.{r.value}')
+                              for r in RejectReason}
+            self._g_depth = registry.gauge('serve.queue_depth')
+        else:
+            self._c_admit = self._c_degraded = self._g_depth = None
+            self._c_reject = {}
+
+    # -- introspection --------------------------------------------------
+    @property
+    def depth(self):
+        return len(self._queue)
+
+    @property
+    def full(self):
+        return len(self._queue) >= self.queue_limit
+
+    @property
+    def pressure(self):
+        """Queue fullness in [0, 1] — the degradation ladder's input."""
+        return len(self._queue) / self.queue_limit
+
+    def _update_depth(self):
+        if self._g_depth is not None:
+            self._g_depth.set(len(self._queue))
+
+    def _reject(self, reason: RejectReason, message: str):
+        if reason in self._c_reject:
+            self._c_reject[reason].inc()
+        raise RejectedError(reason, message)
+
+    def reject_count(self, reason: RejectReason):
+        c = self._c_reject.get(reason)
+        return c.value if c is not None else 0
+
+    # -- admission ------------------------------------------------------
+    def validate(self, request: Request, now=None):
+        """Typed-reject anything that can never be served: an expired
+        deadline, or a prompt leaving no room to generate one token.
+        Clamps the token budget to the config cap and cache capacity."""
+        now = self.clock() if now is None else now
+        if request.deadline is not None and request.deadline <= now:
+            self._reject(RejectReason.DEADLINE_EXCEEDED,
+                         f'request {request.id}: deadline already passed '
+                         f'at submit')
+        room = self.t_max - len(request.prompt)
+        if len(request.prompt) < 1 or room < 1:
+            self._reject(RejectReason.PROMPT_TOO_LONG,
+                         f'request {request.id}: prompt of '
+                         f'{len(request.prompt)} tokens leaves no room '
+                         f'to generate in a t_max={self.t_max} cache')
+        request.max_new_tokens = max(1, min(request.max_new_tokens,
+                                            self.max_new_tokens, room))
+
+    def maybe_degrade(self, request: Request):
+        """Above the pressure watermark, cap the request's token budget
+        instead of rejecting it — rung one of the degradation ladder."""
+        if self.pressure >= self.degrade_watermark \
+                and request.max_new_tokens > self.degraded_max_new_tokens:
+            request.max_new_tokens = self.degraded_max_new_tokens
+            request.degraded = True
+            if self._c_degraded is not None:
+                self._c_degraded.inc()
+
+    def push(self, request: Request):
+        """Enqueue an ADMITTED request; caller has already resolved the
+        queue-full ladder (this raises QUEUE_FULL as the last resort)."""
+        if self.full:
+            self._reject(RejectReason.QUEUE_FULL,
+                         f'request {request.id}: queue at limit '
+                         f'{self.queue_limit}')
+        self._queue.append(request)
+        if self._c_admit is not None:
+            self._c_admit.inc()
+        self._update_depth()
+
+    def push_front(self, request: Request):
+        """Requeue already-admitted work (NaN-quarantine retry) at the
+        FRONT, bypassing the bound: admitted work is never dropped by
+        capacity — that would convert a fault into a silent loss."""
+        self._queue.appendleft(request)
+        self._update_depth()
+
+    def pop_ready(self, now=None) -> Tuple[Optional[Request],
+                                           List[Request]]:
+        """Next serviceable request plus any that expired while queued
+        (the caller finalizes those as typed DEADLINE_EXCEEDED
+        rejections — queue death is never silent)."""
+        now = self.clock() if now is None else now
+        expired = []
+        while self._queue:
+            req = self._queue.popleft()
+            if req.cancelled:
+                expired.append(req)   # caller records 'abandoned'
+                continue
+            if req.deadline is not None and req.deadline <= now:
+                if RejectReason.DEADLINE_EXCEEDED in self._c_reject:
+                    self._c_reject[RejectReason.DEADLINE_EXCEEDED].inc()
+                expired.append(req)
+                continue
+            self._update_depth()
+            return req, expired
+        self._update_depth()
+        return None, expired

@@ -1,0 +1,160 @@
+# -*- coding: utf-8 -*-
+"""
+The compiled substrate the scheduler drives: a minimal greedy LM over
+the KV-cache decode kernels (``models/decode.py``), batched across
+decode SLOTS with per-slot lengths.
+
+Why a dedicated engine instead of :class:`TransformerLM`: continuous
+batching needs every batch row on its OWN sequence clock, which is
+exactly what the per-slot cache (``init_slot_cache`` /
+``append_kv_slots`` / per-slot-masked ``decode_attention``) provides at
+the kernel level. The flax stack's decode surface shares one scalar
+length across the batch (lockstep generation); threading per-slot
+lengths through it is a model-side project — the serving layer's job is
+the scheduling around the kernels, so it drives them directly: token
+embedding → q/k/v projections → per-slot cache append → per-slot masked
+attention → logits. Fixed seeded weights (serving robustness doesn't
+need trained weights; determinism does).
+
+Three compiled programs serve the whole lifecycle, shapes fixed at
+construction so nothing ever retraces mid-serve:
+
+- ``decode``: one token for EVERY slot (inactive slots masked out of
+  the append; their outputs ignored) + per-slot all-finite verdict on
+  the logits. The fault injector's NaN mask is applied IN-PROGRAM so
+  the quarantine predicate sees real NaNs from the compiled step.
+- ``prefill``: one padded prompt chunk into one slot's cache rows (no
+  attention — only the last prompt position's logits matter, and the
+  scheduler feeds that token through ``decode``).
+- ``reset``: zero one slot's rows and length (eviction/quarantine).
+
+Every computation is batch-row independent (embedding lookups, row-wise
+matmuls, per-slot masked attention, per-row argmax), so a request's
+tokens depend only on its prompt and the seed — NOT on which slot it
+lands in or what its neighbors are doing. The scheduler's bit-identity
+guarantees (quarantine leaves other slots' streams untouched; a
+requeued request regenerates the same tokens) rest on this property,
+and the tests pin it.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.models.decode import (
+    append_kv_slots, decode_attention, init_slot_cache, reset_slot,
+    slots_all_finite,
+)
+
+__all__ = ['KernelEngine']
+
+
+class KernelEngine:
+    """Greedy decode engine over ``slots`` independent sequences.
+
+    ``prefill_chunk`` is the compiled chunk width for prompt ingestion
+    (prompts append in ceil(len/chunk) calls — "chunked prefill", so a
+    long prompt never monopolizes the loop between decode steps).
+    """
+
+    def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
+                 prefill_chunk=8, seed=0, dtype=jnp.float32):
+        if slots < 1 or t_max < 2:
+            raise ValueError(f'need slots >= 1 and t_max >= 2, got '
+                             f'{slots}/{t_max}')
+        self.slots = slots
+        self.t_max = t_max
+        self.vocab = vocab
+        self.heads = heads
+        self.head_dim = head_dim
+        self.prefill_chunk = prefill_chunk
+        dim = heads * head_dim
+        ks = jax.random.split(jax.random.key(seed), 5)
+        scale = 1.0 / np.sqrt(dim)
+        self._embed = jax.random.normal(ks[0], (vocab, dim), dtype) * scale
+        self._wq = jax.random.normal(ks[1], (dim, dim), dtype) * scale
+        self._wk = jax.random.normal(ks[2], (dim, dim), dtype) * scale
+        self._wv = jax.random.normal(ks[3], (dim, dim), dtype) * scale
+        self._wo = jax.random.normal(ks[4], (dim, vocab), dtype) * scale
+        self.cache = init_slot_cache(slots, heads, t_max, head_dim,
+                                     dtype=dtype)
+        # Donated caches: appends write in place — see models/decode.py's
+        # performance note. One compiled program each for the lifetime.
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(0,))
+        self._reset = jax.jit(reset_slot, donate_argnums=(0,))
+
+    # -- compiled bodies ------------------------------------------------
+    def _project(self, tokens):
+        """tokens (S,) → q, k, v each (S, H, 1, D)."""
+        s = tokens.shape[0]
+        x = jnp.take(self._embed, tokens, axis=0)          # (S, dim)
+        shape = (s, self.heads, 1, self.head_dim)
+        return ((x @ self._wq).reshape(shape),
+                (x @ self._wk).reshape(shape),
+                (x @ self._wv).reshape(shape))
+
+    def _decode_impl(self, cache, tokens, active, poison):
+        q, k, v = self._project(tokens)
+        cache = append_kv_slots(cache, k, v, slot_mask=active)
+        out = decode_attention(q, cache)                   # (S, H, 1, D)
+        logits = out.reshape(self.slots, -1) @ self._wo    # (S, vocab)
+        logits = jnp.where(poison[:, None], jnp.nan, logits)
+        finite = slots_all_finite(logits)
+        # Fully-masked argmax input for a poisoned row would be NaN-
+        # ordered garbage; the scheduler discards non-finite slots'
+        # tokens, so the value only needs to be deterministic.
+        next_tok = jnp.argmax(
+            jnp.where(jnp.isfinite(logits), logits, -jnp.inf),
+            axis=-1).astype(jnp.int32)
+        return cache, next_tok, finite
+
+    def _prefill_impl(self, cache, slot, tokens, count):
+        """Append ``count`` of the ``prefill_chunk`` padded ``tokens``
+        into ``slot``'s rows. Projections are computed once and
+        broadcast — the masked write only lands on the one slot."""
+        x = jnp.take(self._embed, tokens, axis=0)          # (C, dim)
+        c = tokens.shape[0]
+        k = jnp.moveaxis((x @ self._wk).reshape(
+            c, self.heads, self.head_dim), 0, 1)           # (H, C, D)
+        v = jnp.moveaxis((x @ self._wv).reshape(
+            c, self.heads, self.head_dim), 0, 1)
+        k = jnp.broadcast_to(k[None], (self.slots,) + k.shape)
+        v = jnp.broadcast_to(v[None], (self.slots,) + v.shape)
+        sel = jnp.arange(self.slots) == slot
+        counts = jnp.where(sel, count, 0).astype(jnp.int32)
+        return append_kv_slots(cache, k, v, slot_mask=sel, counts=counts)
+
+    # -- host surface (numpy in, numpy out) -----------------------------
+    def step(self, tokens, active, poison=None):
+        """One decode step for all slots. ``tokens (S,) int`` — each
+        ACTIVE slot's input token (its previous output, or the last
+        prompt token right after prefill); inactive entries ignored.
+        Returns ``(next_tokens (S,), finite (S,))`` numpy arrays."""
+        poison = (np.zeros(self.slots, bool) if poison is None
+                  else np.asarray(poison, bool))
+        self.cache, tok, finite = self._decode(
+            self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(active, bool), jnp.asarray(poison))
+        return np.asarray(tok), np.asarray(finite)
+
+    def prefill(self, slot, tokens):
+        """Append one prompt chunk (``len(tokens) <= prefill_chunk``)
+        into ``slot``. Pads to the compiled chunk width; padded rows
+        never land (counts mask)."""
+        n = len(tokens)
+        if n > self.prefill_chunk:
+            raise ValueError(f'chunk of {n} exceeds prefill_chunk='
+                             f'{self.prefill_chunk}')
+        buf = np.zeros(self.prefill_chunk, np.int32)
+        buf[:n] = np.asarray(tokens, np.int32)
+        self.cache = self._prefill(self.cache, jnp.int32(slot),
+                                   jnp.asarray(buf), jnp.int32(n))
+
+    def reset(self, slot):
+        """Evict ``slot`` (zero rows + length); other slots untouched."""
+        self.cache = self._reset(self.cache, jnp.int32(slot))
+
+    def lengths(self):
+        return np.asarray(self.cache.length)

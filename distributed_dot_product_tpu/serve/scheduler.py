@@ -1,0 +1,417 @@
+# -*- coding: utf-8 -*-
+"""
+Continuous-batching decode scheduler — the serving loop that keeps the
+compiled decode step full and survives the traffic that tries to kill
+it.
+
+Design (the standard continuous-batching shape, scaled to this repo's
+kernels): the engine owns ``S`` fixed decode slots over ONE donated
+per-slot KV cache (``models/decode.py``: ``init_slot_cache`` /
+``append_kv_slots`` / per-slot-masked ``decode_attention``). Every tick:
+
+1. **Admit**: free slots pull from the bounded admission queue
+   (``admission.py`` — typed rejection, deadlines, token budgets,
+   degradation). Requests that expired while queued are finalized with
+   a typed reason, never silently dropped.
+2. **Chunked prefill**: each prefilling slot appends ONE prompt chunk
+   (``engine.prefill_chunk`` wide) between decode steps, so a long
+   prompt interleaves with live decoding instead of stalling it. The
+   prompt's last token then enters the decode step like any other
+   input token — same compiled program end to end.
+3. **Decode**: one batched step for ALL active slots. The per-slot
+   all-finite verdict comes back with the tokens; a non-finite slot is
+   **quarantined** (slot reset + request requeued from scratch, bounded
+   by ``max_requeues``) while every other slot's stream continues
+   bit-identically — one poisoned sequence must not fail the batch.
+4. **Retire**: completed / expired / abandoned sequences free their
+   slot (``reset_slot`` — zero rows, no reallocation).
+
+Failure-handling ladder at submit, in order: admit → admit degraded
+(token budget capped under queue pressure) → evict the longest-idle
+running sequence and admit → reject with typed ``QUEUE_FULL``.
+
+Liveness is judged OUTSIDE the loop: the scheduler heartbeats the
+:class:`~distributed_dot_product_tpu.serve.health.HealthMonitor` every
+tick and a watchdog thread flags a stuck compiled step (no heartbeat)
+as STALLED/NOT_READY; the first post-stall tick restores READY.
+
+Fault injection (``utils/faults.py`` ``ServeFaultInjector``, or the
+``DDP_TPU_FAULT_STUCK_STEP`` / ``..._NAN_DECODE_STEP`` /
+``..._ABANDON_REQUEST`` env knobs when none is passed) drives every one
+of these paths deterministically in CPU tests.
+"""
+
+import dataclasses
+import enum
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from distributed_dot_product_tpu.serve.admission import (
+    AdmissionController, RejectReason, Request, RequestResult,
+)
+from distributed_dot_product_tpu.serve.health import (
+    HealthMonitor, Liveness, Readiness,
+)
+from distributed_dot_product_tpu.utils import faults as faults_lib
+from distributed_dot_product_tpu.utils import tracing
+
+__all__ = ['ServeConfig', 'Scheduler']
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Knobs of the serving loop. ``queue_limit``/``max_new_tokens``/
+    ``degrade_watermark``/``degraded_max_new_tokens`` parameterize
+    admission (see admission.py). ``evict_before_reject``: try freeing
+    the longest-idle slot (idle ≥ ``min_evict_idle`` seconds) before
+    shedding a submit with QUEUE_FULL. ``max_requeues`` bounds
+    NaN-quarantine retries per request. ``stall_timeout`` is the
+    watchdog's no-heartbeat threshold (``watchdog=False`` disables the
+    thread — e.g. under a virtual clock that would never beat in real
+    time)."""
+    queue_limit: int = 8
+    max_new_tokens: int = 16
+    degrade_watermark: float = 0.75
+    degraded_max_new_tokens: Optional[int] = None
+    evict_before_reject: bool = True
+    min_evict_idle: float = 0.0
+    max_requeues: int = 2
+    eos_id: Optional[int] = None
+    stall_timeout: float = 2.0
+    watchdog: bool = True
+    watchdog_poll: Optional[float] = None
+
+
+class _SlotState(enum.Enum):
+    FREE = 'free'
+    PREFILL = 'prefill'
+    ACTIVE = 'active'
+
+
+@dataclasses.dataclass
+class _Slot:
+    index: int
+    state: _SlotState = _SlotState.FREE
+    request: Optional[Request] = None
+    prefill_pos: int = 0
+    input_token: int = 0
+    produced: int = 0
+    last_progress: float = 0.0
+
+
+class Scheduler:
+    """Drive ``engine`` (a :class:`~distributed_dot_product_tpu.serve
+    .engine.KernelEngine` or anything with its surface) under the
+    policy in ``config``.
+
+    Usage::
+
+        sched = Scheduler(KernelEngine(slots=4, t_max=256), ServeConfig())
+        try:
+            req = sched.submit(prompt, max_new_tokens=32,
+                               deadline=clock() + 1.0)
+        except RejectedError as e:
+            ...                       # e.reason is typed
+        sched.run_until_idle()
+        sched.results[req.id]         # RequestResult
+        sched.close()
+
+    ``clock`` is the deadline/idleness clock (injectable — tests run
+    virtual time); the watchdog always measures real time.
+    ``on_tick(scheduler)`` runs at the end of every tick (tests advance
+    their virtual clock there)."""
+
+    def __init__(self, engine, config: Optional[ServeConfig] = None, *,
+                 fault_injector=None, clock=time.monotonic,
+                 registry: Optional[tracing.MetricsRegistry] = None,
+                 health: Optional[HealthMonitor] = None,
+                 on_tick: Optional[Callable] = None):
+        self.engine = engine
+        self.cfg = config or ServeConfig()
+        self.clock = clock
+        self.on_tick = on_tick
+        self.registry = registry or tracing.get_registry()
+        self.admission = AdmissionController(
+            queue_limit=self.cfg.queue_limit, t_max=engine.t_max,
+            max_new_tokens=self.cfg.max_new_tokens,
+            degrade_watermark=self.cfg.degrade_watermark,
+            degraded_max_new_tokens=self.cfg.degraded_max_new_tokens,
+            clock=clock, registry=self.registry)
+        # None = "consult the env knobs" (a shell faults a real run);
+        # False = explicitly unfaulted even when knobs are set (the
+        # clean reference run a fault-isolation audit compares against).
+        if fault_injector is None:
+            plan = faults_lib.serve_plan_from_env()
+            fault_injector = (faults_lib.ServeFaultInjector(plan)
+                              if plan.any() else None)
+        self.injector = fault_injector or None
+        self.health = health or HealthMonitor(
+            stall_timeout=self.cfg.stall_timeout,
+            poll_interval=self.cfg.watchdog_poll, registry=self.registry)
+        if self.cfg.watchdog:
+            self.health.start()
+        self._slots = [_Slot(i) for i in range(engine.slots)]
+        self.results: Dict[str, RequestResult] = {}
+        self._step_idx = 0
+        self._admit_counter = 0
+        self._closed = False
+        reg = self.registry
+        self._c = {name: reg.counter(f'serve.{name}') for name in
+                   ('completed', 'evicted', 'nan_quarantined', 'requeued',
+                    'abandoned', 'deadline_expired', 'failed',
+                    'decode_steps', 'tokens_generated')}
+        self._g_active = reg.gauge('serve.active_slots')
+        self._h_step = reg.histogram('serve.step_seconds')
+
+    # -- submission surface --------------------------------------------
+    def submit(self, prompt, *, max_new_tokens=None, deadline=None,
+               request_id=None) -> Request:
+        """Admit one request or raise a typed
+        :class:`~distributed_dot_product_tpu.serve.admission
+        .RejectedError`. Applies the full backpressure ladder (degrade →
+        evict → reject)."""
+        req = Request(prompt=prompt,
+                      max_new_tokens=max_new_tokens
+                      or self.cfg.max_new_tokens,
+                      deadline=deadline, id=request_id or '')
+        req.submitted_at = self.clock()
+        try:
+            self.admission.validate(req)
+            self.admission.maybe_degrade(req)
+            if self.admission.full and self.cfg.evict_before_reject:
+                # Freeing a slot lets a queued request promote out of
+                # the queue, which is what makes room for this one.
+                if self._evict_longest_idle():
+                    self._admit_into_free_slots()
+            self.admission.push(req)
+        finally:
+            self._update_readiness()
+        return req
+
+    def cancel(self, request_id):
+        """Mid-stream client abandon: the request's slot frees at the
+        next tick (queued requests resolve when they reach the head).
+        Returns False for an unknown/already-finished id."""
+        for slot in self._slots:
+            if slot.request is not None \
+                    and slot.request.id == request_id:
+                slot.request.cancelled = True
+                return True
+        for req in list(self.admission._queue):
+            if req.id == request_id:
+                req.cancelled = True
+                return True
+        return False
+
+    # -- scheduling internals ------------------------------------------
+    def _finalize_request(self, req: Request, status,
+                          reason: Optional[RejectReason] = None):
+        self.results[req.id] = RequestResult(
+            id=req.id, status=status, tokens=list(req.tokens),
+            prompt_len=len(req.prompt), reason=reason,
+            requeues=req.requeues, degraded=req.degraded,
+            finished_at=self.clock())
+
+    def _finish(self, slot: _Slot, status,
+                reason: Optional[RejectReason] = None):
+        """Retire a slot's request with a terminal status and free the
+        slot (rows zeroed — the next sequence starts clean)."""
+        self._finalize_request(slot.request, status, reason)
+        if status in self._c:
+            self._c[status].inc()
+        self.engine.reset(slot.index)
+        slot.state = _SlotState.FREE
+        slot.request = None
+        slot.produced = 0
+        slot.prefill_pos = 0
+
+    def _quarantine(self, slot: _Slot):
+        """Non-finite logits in ONE slot: reset it and retry the request
+        from scratch (the greedy stream is deterministic, so a retry
+        reproduces what the fault destroyed) — or fail it with a typed
+        status once ``max_requeues`` is exhausted. Other slots are
+        untouched by construction (per-slot cache + row-independent
+        engine), which the tests pin bit-exactly."""
+        req = slot.request
+        self._c['nan_quarantined'].inc()
+        self.engine.reset(slot.index)
+        slot.state = _SlotState.FREE
+        slot.request = None
+        slot.produced = 0
+        slot.prefill_pos = 0
+        if req.requeues < self.cfg.max_requeues:
+            req.requeues += 1
+            req.tokens = []
+            self._c['requeued'].inc()
+            self.admission.push_front(req)
+        else:
+            self._c['failed'].inc()
+            self._finalize_request(req, 'failed_nan')
+
+    def _evict_longest_idle(self):
+        """Rung two of the ladder: evict the busy slot that has gone
+        longest without progress (ties → oldest admission), if it has
+        been idle at least ``min_evict_idle``. The evicted request
+        terminates with status ``'evicted'`` and its partial tokens."""
+        now = self.clock()
+        busy = [s for s in self._slots if s.state is not _SlotState.FREE]
+        if not busy:
+            return False
+        victim = max(busy, key=lambda s: (now - s.last_progress,
+                                          -(s.request.admit_index or 0)))
+        if now - victim.last_progress < self.cfg.min_evict_idle:
+            return False
+        self._finish(victim, 'evicted')
+        return True
+
+    def _record_dropped(self, dropped):
+        for req in dropped:
+            if req.cancelled:
+                self._c['abandoned'].inc()
+                self._finalize_request(req, 'abandoned')
+            else:
+                # Counted by the admission controller already.
+                self._finalize_request(req, 'rejected',
+                                       RejectReason.DEADLINE_EXCEEDED)
+
+    def _admit_into_free_slots(self):
+        for slot in self._slots:
+            if slot.state is not _SlotState.FREE:
+                continue
+            req, dropped = self.admission.pop_ready()
+            self._record_dropped(dropped)
+            if req is None:
+                break
+            req.admit_index = self._admit_counter
+            self._admit_counter += 1
+            slot.request = req
+            slot.produced = 0
+            slot.prefill_pos = 0
+            slot.last_progress = self.clock()
+            if len(req.prompt) == 1:
+                slot.state = _SlotState.ACTIVE
+                slot.input_token = int(req.prompt[-1])
+            else:
+                slot.state = _SlotState.PREFILL
+
+    def _update_readiness(self):
+        if self.health.liveness is Liveness.STALLED or self._closed:
+            return      # the watchdog owns NOT_READY during a stall
+        if self.admission.full:
+            self.health.set_readiness(Readiness.NOT_READY, 'queue full')
+        elif self.admission.pressure >= self.cfg.degrade_watermark:
+            self.health.set_readiness(Readiness.DEGRADED,
+                                      'queue pressure')
+        else:
+            self.health.set_readiness(Readiness.READY, 'serving')
+
+    # -- the loop -------------------------------------------------------
+    def step(self) -> bool:
+        """One scheduler tick (admit → prefill chunk → decode step →
+        retire). Returns True while work remains."""
+        now = self.clock()
+        self.health.beat()
+        self._admit_into_free_slots()
+
+        for slot in self._slots:
+            if slot.state is not _SlotState.PREFILL:
+                continue
+            req = slot.request
+            if req.cancelled:
+                self._finish(slot, 'abandoned')
+                continue
+            if req.deadline is not None and req.deadline <= now:
+                self._finish(slot, 'deadline_expired')
+                continue
+            # ONE chunk per tick per slot: long prompts interleave with
+            # decoding instead of monopolizing the loop.
+            end = min(slot.prefill_pos + self.engine.prefill_chunk,
+                      len(req.prompt) - 1)
+            if end > slot.prefill_pos:
+                self.engine.prefill(slot.index,
+                                    req.prompt[slot.prefill_pos:end])
+                slot.prefill_pos = end
+                slot.last_progress = now
+            if slot.prefill_pos >= len(req.prompt) - 1:
+                slot.state = _SlotState.ACTIVE
+                slot.input_token = int(req.prompt[-1])
+
+        active = np.array([s.state is _SlotState.ACTIVE
+                           for s in self._slots])
+        if active.any():
+            if self.injector is not None:
+                self.injector.on_decode_step(self._step_idx)
+            poison = (self.injector.poison_slots(self._step_idx,
+                                                 len(self._slots))
+                      if self.injector is not None else None)
+            tokens_in = np.array([s.input_token for s in self._slots],
+                                 np.int32)
+            t0 = time.perf_counter()
+            toks, finite = self.engine.step(tokens_in, active, poison)
+            self._h_step.observe(time.perf_counter() - t0)
+            self.health.beat()   # the step returned: not stuck
+            self._c['decode_steps'].inc()
+            now = self.clock()
+            for slot in self._slots:
+                if slot.state is not _SlotState.ACTIVE:
+                    continue
+                req = slot.request
+                if not finite[slot.index]:
+                    self._quarantine(slot)
+                    continue
+                tok = int(toks[slot.index])
+                req.tokens.append(tok)
+                slot.produced += 1
+                slot.input_token = tok
+                slot.last_progress = now
+                self._c['tokens_generated'].inc()
+                if req.cancelled or (
+                        self.injector is not None
+                        and self.injector.should_abandon(
+                            req.admit_index, slot.produced)):
+                    self._finish(slot, 'abandoned')
+                elif req.deadline is not None and req.deadline <= now:
+                    self._finish(slot, 'deadline_expired')
+                elif (self.cfg.eos_id is not None
+                        and tok == self.cfg.eos_id):
+                    self._finish(slot, 'completed')
+                elif slot.produced >= req.max_new_tokens:
+                    self._finish(slot, 'completed')
+            self._step_idx += 1
+
+        self._g_active.set(sum(s.state is not _SlotState.FREE
+                               for s in self._slots))
+        self._update_readiness()
+        if self.on_tick is not None:
+            self.on_tick(self)
+        return bool(self.admission.depth) or any(
+            s.state is not _SlotState.FREE for s in self._slots)
+
+    def run_until_idle(self, max_ticks=100_000):
+        """Drive ticks until queue and slots are empty. ``max_ticks``
+        bounds runaway loops (a bug, not load, is the only way to hit
+        it)."""
+        ticks = 0
+        while self.step():
+            ticks += 1
+            if ticks >= max_ticks:
+                raise RuntimeError(
+                    f'scheduler still busy after {max_ticks} ticks: '
+                    f'queue={self.admission.depth} slots='
+                    f'{[s.state.value for s in self._slots]}')
+        return self.results
+
+    def close(self):
+        """Stop the watchdog and mark the surface STOPPED."""
+        if not self._closed:
+            self._closed = True
+            self.health.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

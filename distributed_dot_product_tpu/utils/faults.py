@@ -38,6 +38,7 @@ no explicit injector is passed, so a shell can fault a real run):
 import dataclasses
 import os
 import signal
+import time
 from typing import FrozenSet, Optional
 
 import jax
@@ -46,7 +47,8 @@ import jax.numpy as jnp
 from distributed_dot_product_tpu.utils import checkpoint as _ckpt
 
 __all__ = ['FaultPlan', 'FaultInjector', 'SimulatedCrash', 'plan_from_env',
-           'poison_batch']
+           'poison_batch', 'ServeFaultPlan', 'ServeFaultInjector',
+           'serve_plan_from_env', 'burst_prompts']
 
 
 class SimulatedCrash(BaseException):
@@ -223,3 +225,151 @@ class FaultInjector:
             raise OSError(
                 f'injected transient checkpoint I/O failure '
                 f'({self._io_errors_left} more to come)')
+
+
+# ---------------------------------------------------------------------------
+# Serving-path fault injection (serve/scheduler.py)
+#
+# The decode serving layer has its own failure modes, orthogonal to the
+# training driver's: a compiled step that hangs (driver bug, pathological
+# retrace, wedged runtime), NaN logits poisoning ONE slot of the batch, a
+# request burst overflowing admission, and a client abandoning a stream
+# mid-generation. Each is injectable deterministically so tier-1 CPU tests
+# exercise the watchdog, the per-slot quarantine, load shedding, and slot
+# reclamation — and the same knobs fault a real serving run from the shell.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultPlan:
+    """What to inject into the serving loop, and when. ``fire_once``
+    (default) makes every fault one-shot so recovery is provable."""
+    stuck_at_step: Optional[int] = None     # decode step index to stall
+    stuck_seconds: float = 0.75             # how long the stall lasts
+    nan_at_step: Optional[int] = None       # decode step to poison
+    nan_slot: int = 0                       # slot whose logits go NaN
+    abandon_request: Optional[int] = None   # k-th ADMITTED request (0-based)
+    abandon_after_tokens: int = 2           # ...after this many tokens
+    burst: int = 0                          # request-burst size (drivers)
+    fire_once: bool = True
+
+    def any(self):
+        return (self.stuck_at_step is not None
+                or self.nan_at_step is not None
+                or self.abandon_request is not None
+                or self.burst > 0)
+
+
+def serve_plan_from_env(environ=None) -> ServeFaultPlan:
+    """Build a :class:`ServeFaultPlan` from ``DDP_TPU_FAULT_*`` env knobs
+    (an empty plan when none are set):
+
+    - ``DDP_TPU_FAULT_STUCK_STEP=5``          stall decode step 5
+    - ``DDP_TPU_FAULT_STUCK_SECONDS=1.5``     ...for 1.5 s
+    - ``DDP_TPU_FAULT_NAN_DECODE_STEP=8``     NaN logits at decode step 8
+    - ``DDP_TPU_FAULT_NAN_DECODE_SLOT=2``     ...in slot 2
+    - ``DDP_TPU_FAULT_ABANDON_REQUEST=3``     4th admitted request abandons
+    - ``DDP_TPU_FAULT_ABANDON_AFTER=4``       ...after 4 tokens
+    - ``DDP_TPU_FAULT_BURST=64``              drivers submit a 64-request
+      burst (examples/serve_lm.py, scripts/smoke_serve.sh)
+    """
+    env = os.environ if environ is None else environ
+
+    def _int(name):
+        v = env.get(name)
+        return int(v) if v not in (None, '') else None
+
+    def _float(name, default):
+        v = env.get(name)
+        return float(v) if v not in (None, '') else default
+
+    def _int_default(name, default):
+        # Explicit None check: `or default` would rewrite a deliberate
+        # 0 (e.g. abandon after 0 tokens) to the default.
+        v = _int(name)
+        return default if v is None else v
+
+    return ServeFaultPlan(
+        stuck_at_step=_int('DDP_TPU_FAULT_STUCK_STEP'),
+        stuck_seconds=_float('DDP_TPU_FAULT_STUCK_SECONDS', 0.75),
+        nan_at_step=_int('DDP_TPU_FAULT_NAN_DECODE_STEP'),
+        nan_slot=_int_default('DDP_TPU_FAULT_NAN_DECODE_SLOT', 0),
+        abandon_request=_int('DDP_TPU_FAULT_ABANDON_REQUEST'),
+        abandon_after_tokens=_int_default('DDP_TPU_FAULT_ABANDON_AFTER',
+                                          2),
+        burst=_int_default('DDP_TPU_FAULT_BURST', 0),
+    )
+
+
+def burst_prompts(n, prompt_len=8, vocab=64, seed=0):
+    """Deterministic request burst: ``n`` prompts of ``prompt_len``
+    tokens drawn from ``[0, vocab)`` — the adversarial admission load
+    for soak tests and :mod:`scripts/smoke_serve.sh`. Seeded numpy, no
+    device work: generating the burst must not perturb the run being
+    faulted."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+            for _ in range(n)]
+
+
+class ServeFaultInjector:
+    """Runtime for a :class:`ServeFaultPlan`. The scheduler calls the
+    three hooks at its seams:
+
+    - :meth:`on_decode_step` right before dispatching decode step ``i``
+      — a stuck-step plan sleeps here, exactly what a hung compiled
+      step looks like to the watchdog (no heartbeat while the host is
+      blocked on the device).
+    - :meth:`poison_slots` — the per-step NaN mask the engine applies
+      to its logits IN-PROGRAM, so the per-slot finite predicate is
+      exercised on real NaNs flowing out of the compiled step.
+    - :meth:`should_abandon` after each token — mid-stream client
+      abandon, keyed by admission order (stable under rescheduling).
+    """
+
+    def __init__(self, plan: ServeFaultPlan):
+        self.plan = plan
+        self._stuck_fired = False
+        self._nan_fired = False
+        self._abandon_fired = False
+        self.stalls_injected = 0
+
+    def on_decode_step(self, step):
+        p = self.plan
+        if p.stuck_at_step is not None and step == p.stuck_at_step \
+                and not (p.fire_once and self._stuck_fired):
+            self._stuck_fired = True
+            self.stalls_injected += 1
+            time.sleep(p.stuck_seconds)
+
+    def poison_slots(self, step, n_slots):
+        """Bool list of slots whose logits the engine must NaN at this
+        step, or None for a clean step. ``fire_once=True`` (default)
+        poisons exactly decode step ``nan_at_step`` — a transient
+        glitch the quarantine+retry must fully absorb;
+        ``fire_once=False`` poisons EVERY step from ``nan_at_step`` on
+        — a persistently bad path that must exhaust ``max_requeues``
+        into a typed failure instead of retrying forever."""
+        p = self.plan
+        if p.nan_at_step is None:
+            return None
+        if p.fire_once:
+            if step != p.nan_at_step or self._nan_fired:
+                return None
+        elif step < p.nan_at_step:
+            return None
+        self._nan_fired = True
+        if not 0 <= p.nan_slot < n_slots:
+            raise ValueError(f'nan_slot {p.nan_slot} out of range for '
+                             f'{n_slots} slots')
+        return [i == p.nan_slot for i in range(n_slots)]
+
+    def should_abandon(self, admit_index, tokens_done):
+        p = self.plan
+        if p.abandon_request is None or admit_index != p.abandon_request \
+                or tokens_done < p.abandon_after_tokens \
+                or (p.fire_once and self._abandon_fired):
+            return False
+        self._abandon_fired = True
+        return True

@@ -23,8 +23,10 @@ Differences, deliberate:
   ``benchmark.py --profile-dir``).
 """
 
+import collections
 import functools
 import os
+import threading
 import time
 
 import jax
@@ -194,3 +196,156 @@ def _timed_sync(out):
     t0 = time.perf_counter()
     hard_sync(out)
     return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# Lightweight metrics registry (serving observability)
+#
+# The serving scheduler (serve/scheduler.py) needs queue depth, admissions,
+# rejections-by-reason, evictions and step-latency percentiles exported
+# somewhere a health endpoint / operator can read them. No external metrics
+# dependency is available in the image, so this is the minimal honest core:
+# monotonic counters, last-value gauges, and a bounded-reservoir histogram
+# with nearest-rank percentiles. Thread-safe (the watchdog thread reads
+# while the scheduler loop writes).
+# ---------------------------------------------------------------------------
+
+
+class Counter:
+    """Monotonic event counter."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-value gauge (queue depth, active slots, readiness code)."""
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Bounded reservoir of the most recent ``maxlen`` observations with
+    nearest-rank percentiles — enough for honest p50/p99 step latency
+    without an external metrics stack. Older observations age out, so
+    the percentiles track CURRENT behavior (what a readiness probe
+    wants), not the run's whole history."""
+
+    def __init__(self, maxlen=4096):
+        self._values = collections.deque(maxlen=maxlen)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        with self._lock:
+            self._values.append(float(value))
+            self._count += 1
+            self._sum += float(value)
+
+    @property
+    def count(self):
+        return self._count
+
+    def percentile(self, p):
+        """Nearest-rank percentile over the reservoir (NaN when empty)."""
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return float('nan')
+        idx = min(len(vals) - 1, max(0, int(round(
+            (p / 100.0) * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self):
+        with self._lock:
+            vals = sorted(self._values)
+            count, total = self._count, self._sum
+        if not vals:
+            return {'count': count, 'mean': float('nan'),
+                    'p50': float('nan'), 'p99': float('nan'),
+                    'max': float('nan')}
+
+        def _pct(p):
+            return vals[min(len(vals) - 1,
+                            max(0, int(round((p / 100.0)
+                                             * (len(vals) - 1)))))]
+
+        return {'count': count, 'mean': total / max(count, 1),
+                'p50': _pct(50), 'p99': _pct(99), 'max': vals[-1]}
+
+
+class MetricsRegistry:
+    """Named metric store with one-call :meth:`snapshot`. Get-or-create
+    accessors, so call sites never coordinate registration order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def counter(self, name) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name, maxlen=4096) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram(maxlen))
+
+    def snapshot(self):
+        """Plain-dict view: ``{'counters': {name: int}, 'gauges':
+        {name: float}, 'histograms': {name: {count, mean, p50, p99,
+        max}}}`` — JSON-serializable, safe to hand to a health
+        endpoint."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            'counters': {k: c.value for k, c in counters.items()},
+            'gauges': {k: g.value for k, g in gauges.items()},
+            'histograms': {k: h.summary() for k, h in histograms.items()},
+        }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_DEFAULT_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (the serving layer's default sink)."""
+    return _DEFAULT_REGISTRY
+
+
+def metrics():
+    """Snapshot of the process-default registry."""
+    return _DEFAULT_REGISTRY.snapshot()

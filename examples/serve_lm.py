@@ -1,0 +1,224 @@
+# -*- coding: utf-8 -*-
+"""
+Drive the resilient decode serving layer end to end — the serving
+counterpart of ``examples/train_lm.py``'s training demo, and the soak
+harness ``scripts/smoke_serve.sh`` runs under injected faults.
+
+A seeded request burst (mixed prompt lengths, optional deadlines) is
+submitted through the continuous-batching scheduler; the run then
+drains to idle and the driver audits the serving layer's contract:
+
+- every submitted request reached a TERMINAL state — completed,
+  evicted, deadline_expired, abandoned, failed_nan, or a typed
+  rejection (at submit or in queue). Zero dropped-without-reason.
+- with faults injected (``DDP_TPU_FAULT_STUCK_STEP``,
+  ``DDP_TPU_FAULT_NAN_DECODE_STEP``, ``DDP_TPU_FAULT_ABANDON_REQUEST``
+  env knobs), the faulted paths fire (watchdog stall recorded, NaN slot
+  quarantined+retried, abandoned slot reclaimed) and readiness still
+  ends READY.
+- completed requests' token streams are BIT-IDENTICAL to a fault-free
+  run of the same seed (``--check-identical`` reruns clean and
+  compares) — a quarantine or stall must not perturb surviving
+  streams.
+
+Exit code 0 iff every audit passes.
+
+Run (CPU):
+  JAX_PLATFORMS=cpu python examples/serve_lm.py --requests 24
+Faulted soak (what smoke_serve.sh does):
+  DDP_TPU_FAULT_STUCK_STEP=4 DDP_TPU_FAULT_NAN_DECODE_STEP=7 \\
+  JAX_PLATFORMS=cpu python examples/serve_lm.py --requests 24 \\
+      --queue-limit 6 --check-identical
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from distributed_dot_product_tpu.serve import (  # noqa: E402
+    KernelEngine, Readiness, RejectedError, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils import faults as faults_lib  # noqa: E402
+from distributed_dot_product_tpu.utils.tracing import (  # noqa: E402
+    MetricsRegistry,
+)
+
+
+def build_requests(args):
+    """Seeded mixed burst: prompt lengths cycle short/medium/long, every
+    4th request carries a deadline. Deterministic — the fault-free and
+    faulted runs submit byte-identical traffic."""
+    rng = np.random.default_rng(args.seed)
+    reqs = []
+    for i in range(args.requests):
+        plen = int(rng.integers(1, args.prompt_len + 1))
+        prompt = rng.integers(0, args.vocab, size=plen).astype(np.int32)
+        reqs.append((f'req-{i:03d}', prompt))
+    return reqs
+
+
+def run_burst(args, *, fault_injector, deadline_every=0):
+    """``fault_injector=False`` means EXPLICITLY unfaulted (the clean
+    reference run) — plain None would let the scheduler re-arm the same
+    env knobs and make the bit-identity audit compare a faulted run
+    against itself."""
+    registry = MetricsRegistry()
+    engine = KernelEngine(slots=args.slots, t_max=args.t_max,
+                          vocab=args.vocab,
+                          prefill_chunk=args.prefill_chunk,
+                          seed=args.seed)
+    # Warm all three compiled programs before the watchdog arms: first
+    # compile (~0.3-0.5 s on CPU) would otherwise register as a stall
+    # and let the "watchdog fired" audit pass without the injected
+    # stuck step ever being detected.
+    engine.step(np.zeros(args.slots, np.int32),
+                np.ones(args.slots, bool))
+    engine.prefill(0, np.asarray([0], np.int32))
+    for i in range(args.slots):
+        engine.reset(i)
+    cfg = ServeConfig(queue_limit=args.queue_limit,
+                      max_new_tokens=args.max_new,
+                      stall_timeout=args.stall_timeout,
+                      # The burst intentionally overflows the queue; the
+                      # audit wants typed QUEUE_FULL rejections, not
+                      # partial 'evicted' streams, so the ladder stops
+                      # before eviction here (eviction has its own
+                      # tests).
+                      evict_before_reject=False)
+    sched = Scheduler(engine, cfg, fault_injector=fault_injector,
+                      registry=registry)
+    rejected = {}
+    submitted = build_requests(args)
+    t0 = time.perf_counter()
+    for i, (rid, prompt) in enumerate(submitted):
+        deadline = None
+        if deadline_every and i % deadline_every == 3:
+            deadline = sched.clock() + args.deadline_s
+        try:
+            sched.submit(prompt, request_id=rid, deadline=deadline)
+        except RejectedError as e:
+            rejected[rid] = e.reason
+        # Drain a tick every few submissions: a real frontend interleaves
+        # arrivals with serving — and it lets the burst actually overflow
+        # a small queue while slots are busy.
+        if i % 4 == 3:
+            sched.step()
+    results = sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    sched.close()
+    return sched, registry, submitted, rejected, results, wall
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--slots', type=int, default=4)
+    p.add_argument('--t-max', type=int, default=64)
+    p.add_argument('--vocab', type=int, default=48)
+    p.add_argument('--requests', type=int, default=24)
+    p.add_argument('--prompt-len', type=int, default=12,
+                   help='max prompt length (burst mixes 1..this)')
+    p.add_argument('--prefill-chunk', type=int, default=4)
+    p.add_argument('--max-new', type=int, default=8)
+    p.add_argument('--queue-limit', type=int, default=8)
+    p.add_argument('--deadline-every', type=int, default=0,
+                   help='every Nth request gets a deadline (0: none)')
+    p.add_argument('--deadline-s', type=float, default=0.5)
+    p.add_argument('--stall-timeout', type=float, default=0.25)
+    p.add_argument('--seed', type=int, default=0)
+    p.add_argument('--check-identical', action='store_true',
+                   help='rerun fault-free and require completed '
+                        'streams to match bit for bit')
+    args = p.parse_args(argv)
+
+    plan = faults_lib.serve_plan_from_env()
+    if plan.burst:
+        args.requests = plan.burst
+    injector = (faults_lib.ServeFaultInjector(plan) if plan.any()
+                else None)
+    if injector is not None:
+        print(f'faults armed: {plan}')
+
+    sched, registry, submitted, rejected, results, wall = run_burst(
+        args, fault_injector=injector,
+        deadline_every=args.deadline_every)
+
+    snap = registry.snapshot()
+    counters = {k: v for k, v in snap['counters'].items() if v}
+    lat = snap['histograms']['serve.step_seconds']
+    n_tokens = snap['counters'].get('serve.tokens_generated', 0)
+    by_status = {}
+    for r in results.values():
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+    print(f'submitted={len(submitted)} rejected_at_submit={len(rejected)} '
+          f'terminal={by_status}')
+    print(f'counters: {counters}')
+    print(f'step latency: p50={lat["p50"] * 1e3:.2f}ms '
+          f'p99={lat["p99"] * 1e3:.2f}ms over {lat["count"]} steps')
+    print(f'throughput: {n_tokens} tokens in {wall:.2f}s '
+          f'({n_tokens / max(wall, 1e-9):,.0f} tok/s)')
+
+    failures = []
+    # 1. Full accounting: terminal state or typed rejection for everyone.
+    for rid, _ in submitted:
+        if rid in rejected:
+            if rejected[rid] is None:
+                failures.append(f'{rid}: rejection without a reason')
+        elif rid not in results:
+            failures.append(f'{rid}: dropped without any terminal state')
+        elif results[rid].status == 'rejected' \
+                and results[rid].reason is None:
+            failures.append(f'{rid}: queue rejection without a reason')
+    # 2. Faults fired where armed, and the surface recovered.
+    if injector is not None:
+        if plan.stuck_at_step is not None \
+                and sched.health.stall_events < 1:
+            failures.append('stuck step armed but watchdog never fired')
+        if plan.nan_at_step is not None \
+                and snap['counters'].get('serve.nan_quarantined', 0) < 1:
+            failures.append('NaN armed but no slot was quarantined')
+        if plan.abandon_request is not None \
+                and by_status.get('abandoned', 0) < 1:
+            failures.append('abandon armed but no stream abandoned')
+    if sched.health.readiness is not Readiness.STOPPED:
+        failures.append(f'close() left readiness '
+                        f'{sched.health.readiness.value}')
+    ready_line = [v for _, kind, v, _ in sched.health.transitions
+                  if kind == 'readiness']
+    if not ready_line or ready_line[-1] != Readiness.STOPPED.value \
+            or (len(ready_line) > 1 and ready_line[-2]
+                != Readiness.READY.value):
+        failures.append(f'readiness not restored to ready before stop: '
+                        f'{ready_line}')
+    # 3. Fault isolation: completed streams identical to a clean run.
+    if args.check_identical:
+        _, _, _, rej0, clean, _ = run_burst(args, fault_injector=False,
+                                            deadline_every=0)
+        for rid, r in results.items():
+            if r.status != 'completed' or r.degraded:
+                continue
+            ref = clean.get(rid)
+            if ref is not None and ref.status == 'completed' \
+                    and not ref.degraded and ref.tokens != r.tokens:
+                failures.append(f'{rid}: tokens diverged from the '
+                                f'fault-free run')
+        print(f'bit-identity check against clean rerun: '
+              f'{"FAILED" if any("diverged" in f for f in failures) else "ok"}')
+
+    if failures:
+        print('AUDIT FAILED:')
+        for f in failures:
+            print(f'  - {f}')
+        return 1
+    print(f'serve_lm OK: all {len(submitted)} requests accounted for, '
+          f'readiness restored')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

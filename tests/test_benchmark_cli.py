@@ -98,6 +98,17 @@ def test_train_mode(tmp_path):
     assert rec['causal'] is True and rec['step_gflops_per_chip'] > 0
 
 
+def test_decode_serve_mode(tmp_path):
+    """The serving microbenchmark: scheduler vs bare decode loop on the
+    same engine shape, both rates recorded."""
+    rec = _run(tmp_path, 'dserve', '--mode', 'decode-serve',
+               '--seq-len', '48', '--serve-requests', '4')
+    assert rec['mode'] == 'decode-serve'
+    assert rec['completed'] == 4
+    assert rec['bare_tokens_per_s'] > 0
+    assert rec['sched_tokens_per_s'] > 0
+
+
 def test_train_mode_window(tmp_path):
     rec = _run(tmp_path, 'train_w', '--mode', 'train', '--attn-impl',
                'flash', '--seq-len', '64', '--no-mask', '--causal',

@@ -1,0 +1,172 @@
+# -*- coding: utf-8 -*-
+"""
+Per-slot KV-cache primitives (models/decode.py): the continuous-batching
+substrate. A slot cache packs independent sequences on independent
+clocks into one batch — correctness means each slot's attention is
+bit-for-bit the attention it would compute alone, eviction touches ONE
+slot, and overflow is loud.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.models.decode import (
+    append_kv, append_kv_slots, decode_attention, init_cache,
+    init_slot_cache, reset_slot, slots_all_finite,
+)
+
+B, H, D, T = 3, 2, 8, 16
+LENS = [5, 9, 1]        # staggered slot fills — the serving steady state
+
+
+def _operands(key=0, t=None):
+    ks = jax.random.split(jax.random.key(key), 3)
+    t = t or max(LENS)
+    k = jax.random.normal(ks[0], (B, H, t, D), jnp.float32)
+    v = jax.random.normal(ks[1], (B, H, t, D), jnp.float32)
+    q = jax.random.normal(ks[2], (B, H, 1, D), jnp.float32)
+    return q, k, v
+
+
+def _filled_slot_cache(k, v, lens=LENS, chunk=4):
+    """Fill a slot cache via padded chunked appends with per-slot
+    counts — exactly how the scheduler's prefill lands."""
+    cache = init_slot_cache(B, H, T, D, dtype=jnp.float32)
+    for c0 in range(0, max(lens), chunk):
+        n = k[:, :, c0:c0 + chunk].shape[2]
+        counts = jnp.asarray([max(0, min(ln - c0, n)) for ln in lens],
+                             jnp.int32)
+        cache = append_kv_slots(cache, k[:, :, c0:c0 + chunk],
+                                v[:, :, c0:c0 + chunk], counts=counts)
+    return cache
+
+
+def test_per_slot_decode_matches_isolated_caches():
+    """Each slot of a staggered batch must attend exactly as it would
+    alone in a scalar-length cache of its own fill."""
+    q, k, v = _operands()
+    cache = _filled_slot_cache(k, v)
+    assert [int(x) for x in cache.length] == LENS
+    out = decode_attention(q, cache)
+    for i, ln in enumerate(LENS):
+        solo = init_cache(1, H, T, D, dtype=jnp.float32)
+        solo = append_kv(solo, k[i:i + 1, :, :ln], v[i:i + 1, :, :ln])
+        want = decode_attention(q[i:i + 1], solo)
+        np.testing.assert_allclose(np.asarray(out[i:i + 1]),
+                                   np.asarray(want), atol=1e-6)
+
+
+def test_per_slot_decode_window():
+    q, k, v = _operands(key=1)
+    cache = _filled_slot_cache(k, v)
+    out = decode_attention(q, cache, window=4)
+    for i, ln in enumerate(LENS):
+        solo = init_cache(1, H, T, D, dtype=jnp.float32)
+        solo = append_kv(solo, k[i:i + 1, :, :ln], v[i:i + 1, :, :ln])
+        want = decode_attention(q[i:i + 1], solo, window=4)
+        np.testing.assert_allclose(np.asarray(out[i:i + 1]),
+                                   np.asarray(want), atol=1e-6)
+
+
+def test_empty_slot_outputs_zero():
+    """A FREE slot (length 0) is fully masked: zero output, no NaN from
+    the empty softmax."""
+    q, _, _ = _operands()
+    cache = init_slot_cache(B, H, T, D, dtype=jnp.float32)
+    out = decode_attention(q, cache)
+    assert float(jnp.abs(out).sum()) == 0.0
+
+
+def test_reset_slot_is_surgical():
+    """Eviction zeroes ONE slot; every other slot's buffers are
+    BIT-identical (the quarantine isolation guarantee starts here)."""
+    _, k, v = _operands()
+    cache = _filled_slot_cache(k, v)
+    out = reset_slot(cache, 1)
+    assert [int(x) for x in out.length] == [LENS[0], 0, LENS[2]]
+    np.testing.assert_array_equal(np.asarray(out.k[0]),
+                                  np.asarray(cache.k[0]))
+    np.testing.assert_array_equal(np.asarray(out.v[2]),
+                                  np.asarray(cache.v[2]))
+    assert float(jnp.abs(out.k[1]).sum()) == 0.0
+    # The freed slot serves a fresh sequence immediately.
+    refill = append_kv_slots(
+        out, k[:, :, :1], v[:, :, :1],
+        slot_mask=jnp.asarray([False, True, False]))
+    assert [int(x) for x in refill.length] == [LENS[0], 1, LENS[2]]
+
+
+def test_slot_mask_freezes_inactive_slots():
+    """A decode append only advances ACTIVE slots — buffers and lengths
+    of masked slots must not move."""
+    _, k, v = _operands()
+    cache = _filled_slot_cache(k, v)
+    mask = jnp.asarray([True, False, True])
+    out = append_kv_slots(cache, k[:, :, :1], v[:, :, :1],
+                          slot_mask=mask)
+    assert [int(x) for x in out.length] == [6, 9, 2]
+    np.testing.assert_array_equal(np.asarray(out.k[1]),
+                                  np.asarray(cache.k[1]))
+
+
+def test_slot_overflow_raises_concretely():
+    """Host-side (concrete-length) overflow must raise naming the slot,
+    not wrap around."""
+    cache = init_slot_cache(2, H, 4, D, dtype=jnp.float32)
+    cache = cache._replace(length=jnp.asarray([3, 0], jnp.int32))
+    one = jnp.ones((2, H, 2, D))
+    with pytest.raises(ValueError, match='slot 0'):
+        append_kv_slots(cache, one, one)
+
+
+def test_slot_overflow_traced_guard():
+    """Under jit the overflowing slot writes NOTHING while its length
+    still advances (detectable), and in-bounds slots append normally —
+    append_kv's contract, per slot."""
+    cache = init_slot_cache(2, H, 4, D, dtype=jnp.float32)
+    cache = cache._replace(length=jnp.asarray([3, 0], jnp.int32))
+    one = jnp.ones((2, H, 2, D))
+    out = jax.jit(append_kv_slots)(cache, one, one)
+    assert int(out.length[0]) == 5 and int(out.length[0]) > out.t_max
+    assert float(jnp.abs(out.k[0]).sum()) == 0.0
+    assert int(out.length[1]) == 2
+    assert float(jnp.abs(out.k[1]).sum()) > 0.0
+
+
+def test_scalar_cache_rejects_slot_ops():
+    cache = init_cache(B, H, T, D)
+    one = jnp.ones((B, H, 1, D))
+    with pytest.raises(ValueError, match='init_slot_cache'):
+        append_kv_slots(cache, one, one)
+    with pytest.raises(ValueError, match='init_slot_cache'):
+        reset_slot(cache, 0)
+
+
+def test_slots_all_finite():
+    x = jnp.asarray([[1.0, 2.0], [jnp.nan, 1.0], [3.0, jnp.inf]])
+    assert list(np.asarray(slots_all_finite(x))) == [True, False, False]
+    # Works on any per-slot trailing shape (logits, hidden states, ...).
+    y = jnp.zeros((2, 3, 4)).at[1, 2, 1].set(jnp.nan)
+    assert list(np.asarray(slots_all_finite(y))) == [True, False]
+
+
+def test_decode_jit_one_program_all_slots():
+    """The serving invariant: one compiled (append + attend) program
+    serves every slot configuration — staggered lengths and masks are
+    data, not shapes."""
+    q, k, v = _operands(key=5)
+    cache = _filled_slot_cache(k, v)
+
+    @jax.jit
+    def step(c, q1, k1, v1, mask):
+        c = append_kv_slots(c, k1, v1, slot_mask=mask)
+        return c, decode_attention(q1, c)
+
+    m1 = jnp.asarray([True, True, False])
+    m2 = jnp.asarray([False, True, True])
+    cache, o1 = step(cache, q, k[:, :, :1], v[:, :, :1], m1)
+    cache, o2 = step(cache, q, k[:, :, 1:2], v[:, :, 1:2], m2)
+    assert o1.shape == o2.shape == (B, H, 1, D)
+    assert [int(x) for x in cache.length] == [6, 11, 2]

@@ -1,0 +1,139 @@
+# -*- coding: utf-8 -*-
+"""
+Admission control and backpressure (serve/admission.py) — driven
+standalone under a virtual clock: typed rejection taxonomy, deadline
+handling at submit and in queue, token-budget clamping, and the
+degradation watermark. No device work: admission is pure host policy.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.serve.admission import (
+    AdmissionController, RejectReason, RejectedError, Request,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ctrl(**kw):
+    clock = VClock()
+    reg = MetricsRegistry()
+    kw.setdefault('queue_limit', 4)
+    kw.setdefault('t_max', 32)
+    kw.setdefault('max_new_tokens', 8)
+    return AdmissionController(clock=clock, registry=reg, **kw), clock, reg
+
+
+def _req(plen=4, max_new=8, deadline=None):
+    return Request(prompt=np.arange(plen), max_new_tokens=max_new,
+                   deadline=deadline)
+
+
+def test_prompt_too_long_is_typed():
+    ctrl, _, _ = _ctrl(t_max=8)
+    with pytest.raises(RejectedError, match='prompt_too_long') as ei:
+        ctrl.validate(_req(plen=8))    # leaves no room for one token
+    assert ei.value.reason is RejectReason.PROMPT_TOO_LONG
+    assert ctrl.reject_count(RejectReason.PROMPT_TOO_LONG) == 1
+
+
+def test_expired_deadline_rejected_at_submit():
+    ctrl, clock, _ = _ctrl()
+    clock.advance(10.0)
+    with pytest.raises(RejectedError, match='deadline') as ei:
+        ctrl.validate(_req(deadline=5.0))
+    assert ei.value.reason is RejectReason.DEADLINE_EXCEEDED
+
+
+def test_token_budget_clamped_to_cap_and_capacity():
+    ctrl, _, _ = _ctrl(t_max=12, max_new_tokens=8)
+    r = _req(plen=4, max_new=100)
+    ctrl.validate(r)
+    assert r.max_new_tokens == 8          # config cap
+    r2 = _req(plen=10, max_new=8)
+    ctrl.validate(r2)
+    assert r2.max_new_tokens == 2         # cache capacity t_max - plen
+
+
+def test_queue_full_is_typed_and_counted():
+    ctrl, _, _ = _ctrl(queue_limit=2)
+    ctrl.push(_req())
+    ctrl.push(_req())
+    assert ctrl.full and ctrl.pressure == 1.0
+    with pytest.raises(RejectedError, match='queue_full') as ei:
+        ctrl.push(_req())
+    assert ei.value.reason is RejectReason.QUEUE_FULL
+    assert ctrl.reject_count(RejectReason.QUEUE_FULL) == 1
+
+
+def test_degradation_watermark_caps_budget():
+    """Above the watermark new requests are admitted with a REDUCED
+    budget — rung one of the ladder, before any shedding."""
+    ctrl, _, reg = _ctrl(queue_limit=4, degrade_watermark=0.5,
+                         degraded_max_new_tokens=2)
+    below = _req(max_new=8)
+    ctrl.validate(below)
+    ctrl.maybe_degrade(below)
+    assert not below.degraded and below.max_new_tokens == 8
+    ctrl.push(_req())
+    ctrl.push(_req())                     # pressure now 0.5
+    above = _req(max_new=8)
+    ctrl.validate(above)
+    ctrl.maybe_degrade(above)
+    assert above.degraded and above.max_new_tokens == 2
+    assert reg.snapshot()['counters']['serve.degraded'] == 1
+
+
+def test_queue_expiry_is_loud():
+    """Requests whose deadline passes while QUEUED come back from
+    pop_ready as expired (typed, counted) — never silently skipped."""
+    ctrl, clock, _ = _ctrl()
+    doomed = _req(deadline=1.0)
+    ok = _req(deadline=50.0)
+    ctrl.push(doomed)
+    ctrl.push(ok)
+    clock.advance(2.0)
+    req, expired = ctrl.pop_ready()
+    assert req is ok
+    assert expired == [doomed]
+    assert ctrl.reject_count(RejectReason.DEADLINE_EXCEEDED) == 1
+
+
+def test_cancelled_queued_request_surfaces_on_pop():
+    ctrl, _, _ = _ctrl()
+    gone = _req()
+    gone.cancelled = True
+    ctrl.push(gone)
+    req, expired = ctrl.pop_ready()
+    assert req is None and expired == [gone]
+
+
+def test_push_front_bypasses_bound():
+    """Requeued (already-admitted) work is never dropped by capacity."""
+    ctrl, _, _ = _ctrl(queue_limit=1)
+    ctrl.push(_req())
+    retry = _req()
+    ctrl.push_front(retry)                # full, but admitted work
+    assert ctrl.depth == 2
+    req, _ = ctrl.pop_ready()
+    assert req is retry                   # retries go first
+
+
+def test_queue_depth_gauge_tracks():
+    ctrl, _, reg = _ctrl()
+    ctrl.push(_req())
+    ctrl.push(_req())
+    assert reg.snapshot()['gauges']['serve.queue_depth'] == 2
+    ctrl.pop_ready()
+    assert reg.snapshot()['gauges']['serve.queue_depth'] == 1

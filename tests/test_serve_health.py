@@ -1,0 +1,172 @@
+# -*- coding: utf-8 -*-
+"""
+Watchdog + health surface (serve/health.py) and the NaN-slot
+quarantine: a stuck compiled step is detected from OUTSIDE the loop and
+recovery is an explicit readiness transition; a poisoned slot is
+quarantined with every other slot's stream bit-identical.
+
+The watchdog measures real wall time, so these tests use real (small)
+sleeps with generous margins rather than the virtual clock.
+"""
+
+import time
+
+import numpy as np
+
+from distributed_dot_product_tpu.serve import (
+    HealthMonitor, KernelEngine, Readiness, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.serve.health import Liveness
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+SLOTS, T_MAX, VOCAB = 3, 32, 16
+
+
+def _warm_engine(**kw):
+    """Engine with all three programs compiled and slots re-zeroed, so
+    compile time can't masquerade as a stall in watchdog tests."""
+    eng = KernelEngine(slots=SLOTS, t_max=T_MAX, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=7, **kw)
+    eng.step(np.zeros(SLOTS, np.int32), np.ones(SLOTS, bool))
+    eng.prefill(0, np.asarray([1, 2], np.int32))
+    for i in range(SLOTS):
+        eng.reset(i)
+    return eng
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_monitor_detects_stall_and_recovers():
+    reg = MetricsRegistry()
+    with HealthMonitor(stall_timeout=0.1, poll_interval=0.02,
+                       registry=reg) as mon:
+        mon.beat()
+        mon.set_readiness(Readiness.READY)
+        assert _wait_for(lambda: mon.liveness is Liveness.STALLED)
+        assert mon.readiness is Readiness.NOT_READY
+        assert mon.stall_events == 1
+        mon.beat()                       # loop resumed
+        assert mon.liveness is Liveness.ALIVE
+        mon.set_readiness(Readiness.READY)
+    assert mon.readiness is Readiness.STOPPED
+    kinds = [(k, v) for _, k, v, _ in mon.transitions]
+    assert ('liveness', 'stalled') in kinds
+    assert ('liveness', 'alive') in kinds
+    assert kinds[-1] == ('readiness', 'stopped')
+    assert reg.snapshot()['counters']['serve.watchdog_stalls'] == 1
+    assert reg.snapshot()['counters']['serve.watchdog_recoveries'] == 1
+
+
+def test_monitor_quiet_while_beating():
+    with HealthMonitor(stall_timeout=0.25, poll_interval=0.02) as mon:
+        for _ in range(10):
+            mon.beat()
+            time.sleep(0.02)
+        assert mon.liveness is Liveness.ALIVE
+        assert mon.stall_events == 0
+        assert mon.last_beat_age() < 0.25
+
+
+def test_watchdog_fires_on_injected_stuck_step():
+    """The acceptance path: a stuck compiled decode step (injected
+    host-side stall, exactly what a hung device call looks like) trips
+    the watchdog mid-run, and readiness returns to READY once the step
+    unsticks — asserted from the transition log, not just the end
+    state."""
+    plan = ServeFaultPlan(stuck_at_step=2, stuck_seconds=0.6)
+    cfg = ServeConfig(queue_limit=8, max_new_tokens=5,
+                      stall_timeout=0.15, watchdog_poll=0.02)
+    sched = Scheduler(_warm_engine(), cfg,
+                      fault_injector=ServeFaultInjector(plan),
+                      registry=MetricsRegistry())
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        sched.submit(rng.integers(0, VOCAB, size=3), request_id=f'r{i}')
+    res = sched.run_until_idle()
+    assert sched.health.stall_events >= 1
+    assert sched.health.readiness is Readiness.READY
+    assert all(r.status == 'completed' for r in res.values())
+    line = [(k, v) for _, k, v, _ in sched.health.transitions]
+    stall_at = line.index(('liveness', 'stalled'))
+    assert ('readiness', 'ready') in line[:stall_at], 'was ready first'
+    assert ('readiness', 'not_ready') in line[stall_at:], 'drained'
+    assert ('readiness', 'ready') in line[
+        line.index(('readiness', 'not_ready'), stall_at):], 'restored'
+    sched.close()
+    assert sched.health.readiness is Readiness.STOPPED
+
+
+def test_nan_quarantine_leaves_other_slots_bit_identical():
+    """One poisoned slot must cost exactly one retry: the quarantined
+    request requeues and completes with the SAME tokens, and every
+    other request's stream is bit-identical to the fault-free run."""
+    prompts = [np.asarray(p, np.int32)
+               for p in ([2, 9], [5], [11, 3, 7], [1, 1], [8, 4])]
+
+    def run(injector):
+        cfg = ServeConfig(queue_limit=16, max_new_tokens=6,
+                          watchdog=False)
+        sched = Scheduler(
+            KernelEngine(slots=SLOTS, t_max=T_MAX, vocab=VOCAB, heads=2,
+                         head_dim=4, prefill_chunk=4, seed=7),
+            cfg, fault_injector=injector, registry=MetricsRegistry())
+        for i, p in enumerate(prompts):
+            sched.submit(p, request_id=f'r{i}')
+        res = sched.run_until_idle()
+        snap = sched.registry.snapshot()['counters']
+        sched.close()
+        return res, snap
+
+    clean, _ = run(None)
+    plan = ServeFaultPlan(nan_at_step=2, nan_slot=1)
+    faulted, counters = run(ServeFaultInjector(plan))
+    assert counters['serve.nan_quarantined'] == 1
+    assert counters['serve.requeued'] == 1
+    hit = [r for r in faulted.values() if r.requeues == 1]
+    assert len(hit) == 1, 'exactly one request took the poison'
+    for rid in clean:
+        assert faulted[rid].status == 'completed'
+        assert faulted[rid].tokens == clean[rid].tokens, \
+            f'{rid}: fault leaked across slots'
+
+
+def test_nan_exhausted_requeues_fails_typed():
+    """A slot that NaNs on every retry must end in a TYPED failure, not
+    an infinite requeue loop."""
+    plan = ServeFaultPlan(nan_at_step=1, nan_slot=0, fire_once=False)
+    cfg = ServeConfig(queue_limit=8, max_new_tokens=5, max_requeues=1,
+                      watchdog=False)
+    sched = Scheduler(
+        KernelEngine(slots=1, t_max=T_MAX, vocab=VOCAB, heads=2,
+                     head_dim=4, prefill_chunk=4, seed=7),
+        cfg, fault_injector=ServeFaultInjector(plan),
+        registry=MetricsRegistry())
+    sched.submit(np.asarray([3], np.int32), request_id='r')
+    res = sched.run_until_idle()
+    assert res['r'].status == 'failed_nan'
+    assert res['r'].requeues == 1
+    snap = sched.registry.snapshot()['counters']
+    assert snap['serve.nan_quarantined'] == 2
+    assert snap['serve.failed'] == 1
+    sched.close()
+
+
+def test_health_snapshot_shape():
+    with HealthMonitor(stall_timeout=1.0) as mon:
+        mon.beat()
+        mon.set_readiness(Readiness.READY)
+        snap = mon.snapshot()
+    assert snap['liveness'] == 'alive'
+    assert snap['last_beat_age_s'] >= 0
+    assert 'serve.watchdog_stalls' in snap['metrics']['counters']
+    assert isinstance(snap['metrics'], dict)

@@ -1,0 +1,199 @@
+# -*- coding: utf-8 -*-
+"""
+Continuous-batching scheduler (serve/scheduler.py) over the kernel
+engine: request lifecycle, chunked prefill, deadline expiry mid-stream
+and in queue, the evict-before-reject ladder, and mid-stream abandon —
+all under a virtual clock (the watchdog thread stays off; health.py has
+its own real-time tests).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, RejectReason, RejectedError, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+SLOTS, T_MAX, VOCAB = 3, 32, 16
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _engine(prefill_chunk=4, slots=SLOTS):
+    return KernelEngine(slots=slots, t_max=T_MAX, vocab=VOCAB, heads=2,
+                        head_dim=4, prefill_chunk=prefill_chunk, seed=7)
+
+
+def _sched(engine=None, clock=None, tick_dt=0.0, injector=None, **cfg_kw):
+    clock = clock or VClock()
+    cfg_kw.setdefault('queue_limit', 8)
+    cfg_kw.setdefault('max_new_tokens', 5)
+    cfg = ServeConfig(watchdog=False, **cfg_kw)
+    on_tick = (lambda s: clock.advance(tick_dt)) if tick_dt else None
+    return Scheduler(engine or _engine(), cfg, clock=clock,
+                     registry=MetricsRegistry(), fault_injector=injector,
+                     on_tick=on_tick), clock
+
+
+def _prompts(n, seed=0, max_len=8):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB,
+                         size=int(rng.integers(1, max_len + 1))
+                         ).astype(np.int32) for _ in range(n)]
+
+
+def test_batched_tokens_match_solo_runs():
+    """A request's stream must not depend on its slot or neighbors:
+    the batched run reproduces each isolated single-request run bit for
+    bit — the foundation of every fault-isolation guarantee."""
+    prompts = _prompts(6)
+    sched, _ = _sched()
+    for i, p in enumerate(prompts):
+        sched.submit(p, request_id=f'r{i}')
+    batched = sched.run_until_idle()
+    assert all(batched[f'r{i}'].status == 'completed' for i in range(6))
+    for i, p in enumerate(prompts):
+        solo, _ = _sched()
+        solo.submit(p, request_id='solo')
+        ref = solo.run_until_idle()['solo']
+        assert batched[f'r{i}'].tokens == ref.tokens, f'r{i} diverged'
+
+
+def test_prefill_chunking_is_invisible():
+    """Chunk width is a scheduling knob, not a numerics knob: the same
+    prompt through chunk=2 and chunk=16 engines yields identical
+    tokens."""
+    prompt = np.arange(11, dtype=np.int32) % VOCAB
+    outs = []
+    for chunk in (2, 16):
+        sched, _ = _sched(engine=_engine(prefill_chunk=chunk))
+        sched.submit(prompt, request_id='r')
+        outs.append(sched.run_until_idle()['r'].tokens)
+        assert len(outs[-1]) == 5
+    assert outs[0] == outs[1]
+
+
+def test_deadline_expiry_mid_stream():
+    """A slot whose deadline passes mid-generation frees with its
+    partial tokens and a typed terminal status."""
+    sched, clock = _sched(tick_dt=1.0, max_new_tokens=50)
+    sched.submit(np.asarray([1], np.int32), request_id='r',
+                 deadline=3.5)
+    res = sched.run_until_idle()['r']
+    assert res.status == 'deadline_expired'
+    assert 1 <= len(res.tokens) < 50
+
+
+def test_deadline_expiry_in_queue():
+    """With every slot busy, a queued request whose deadline lapses is
+    finalized as a typed DEADLINE_EXCEEDED rejection when it reaches
+    the head — queue death is never silent."""
+    sched, clock = _sched(engine=_engine(slots=1), tick_dt=1.0,
+                          max_new_tokens=30)
+    sched.submit(np.asarray([1], np.int32), request_id='long')
+    sched.submit(np.asarray([2], np.int32), request_id='doomed',
+                 deadline=4.0)
+    res = sched.run_until_idle()
+    assert res['long'].status == 'completed'
+    assert res['doomed'].status == 'rejected'
+    assert res['doomed'].reason is RejectReason.DEADLINE_EXCEEDED
+
+
+def test_evict_before_reject_ladder():
+    """Queue full: the longest-idle running sequence is evicted (typed,
+    partial tokens kept) to admit new work; only when eviction is off
+    does the submit shed with QUEUE_FULL."""
+    sched, clock = _sched(engine=_engine(slots=1), queue_limit=1,
+                          max_new_tokens=30)
+    sched.submit(np.asarray([1], np.int32), request_id='victim')
+    for _ in range(3):      # victim decodes a few tokens
+        sched.step()
+    sched.submit(np.asarray([2], np.int32), request_id='queued')
+    sched.submit(np.asarray([3], np.int32), request_id='late')
+    res = sched.run_until_idle()
+    assert res['victim'].status == 'evicted'
+    assert 1 <= len(res['victim'].tokens) < 30
+    assert res['queued'].status == 'completed'
+    assert res['late'].status == 'completed'
+    assert sched.registry.snapshot()['counters']['serve.evicted'] == 1
+
+
+def test_queue_full_sheds_typed_when_eviction_off():
+    sched, _ = _sched(engine=_engine(slots=1), queue_limit=1,
+                      evict_before_reject=False, max_new_tokens=30)
+    sched.submit(np.asarray([1], np.int32), request_id='a')
+    sched.step()
+    sched.submit(np.asarray([2], np.int32), request_id='b')
+    with pytest.raises(RejectedError, match='queue_full') as ei:
+        sched.submit(np.asarray([3], np.int32), request_id='c')
+    assert ei.value.reason is RejectReason.QUEUE_FULL
+    res = sched.run_until_idle()
+    assert res['a'].status == res['b'].status == 'completed'
+
+
+def test_midstream_abandon_frees_slot():
+    """A client abandoning its stream (injector-driven, exactly how the
+    DDP_TPU_FAULT_ABANDON_* knobs land) frees the slot for queued work;
+    the abandoned request keeps a typed status + partial tokens."""
+    plan = ServeFaultPlan(abandon_request=0, abandon_after_tokens=2)
+    sched, _ = _sched(engine=_engine(slots=1),
+                      injector=ServeFaultInjector(plan),
+                      max_new_tokens=30)
+    sched.submit(np.asarray([1], np.int32), request_id='gone')
+    sched.submit(np.asarray([2], np.int32), request_id='next')
+    res = sched.run_until_idle()
+    assert res['gone'].status == 'abandoned'
+    assert len(res['gone'].tokens) == 2
+    assert res['next'].status == 'completed'
+
+
+def test_cancel_api():
+    sched, _ = _sched(max_new_tokens=30)
+    sched.submit(np.asarray([1], np.int32), request_id='r')
+    sched.step()
+    assert sched.cancel('r')
+    assert not sched.cancel('nope')
+    assert sched.run_until_idle()['r'].status == 'abandoned'
+
+
+def test_completion_frees_slot_for_reuse():
+    """More requests than slots: every slot cycles through several
+    sequences; lengths return to zero at idle (nothing leaks)."""
+    sched, _ = _sched(queue_limit=12)
+    prompts = _prompts(9, seed=3)
+    for i, p in enumerate(prompts):
+        sched.submit(p, request_id=f'r{i}')
+    res = sched.run_until_idle()
+    assert sum(r.status == 'completed' for r in res.values()) == 9
+    assert list(sched.engine.lengths()) == [0] * SLOTS
+    snap = sched.registry.snapshot()
+    assert snap['counters']['serve.completed'] == 9
+    assert snap['histograms']['serve.step_seconds']['count'] > 0
+
+
+def test_degraded_admission_is_prefix_of_full_run():
+    """Degradation caps the budget, not the content: a degraded stream
+    is a PREFIX of the undegraded stream for the same prompt."""
+    prompt = np.asarray([3, 1, 4], np.int32)
+    full, _ = _sched(max_new_tokens=8)
+    full.submit(prompt, request_id='r')
+    want = full.run_until_idle()['r'].tokens
+    tight, _ = _sched(queue_limit=2, degrade_watermark=0.0,
+                      max_new_tokens=8, degraded_max_new_tokens=3)
+    tight.submit(prompt, request_id='r')
+    got = tight.run_until_idle()['r']
+    assert got.degraded and len(got.tokens) == 3
+    assert got.tokens == want[:3]
