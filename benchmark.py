@@ -108,6 +108,19 @@ def parse_args():
                         help='attn/train modes: grouped-query K/V head '
                              'count (< --heads, must divide it); default '
                              '= --heads (standard multi-head)')
+    parser.add_argument('--decode-impl', choices=['auto', 'kernel', 'xla'],
+                        default='auto',
+                        help='decode/decode-serve modes: decode-step '
+                             'path — the fused Pallas kernel (in-place '
+                             'aliased cache append + split-K attention) '
+                             'vs the XLA append+einsum step; auto = '
+                             'kernel on TPU. Recorded in the result row '
+                             'so kernel-vs-XLA tables read straight off '
+                             'the JSON')
+    parser.add_argument('--no-ttft', action='store_true',
+                        help='decode mode: skip the time-to-first-token '
+                             'prefill-latency row (it compiles a full '
+                             'prefill flash pass at the cache fill)')
     parser.add_argument('--use-rope', action='store_true',
                         help='train mode: rotary position embeddings on '
                              'the projected score operands (module '
@@ -630,7 +643,9 @@ def run_decode(args):
     model = DistributedDotProductAttn(
         key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
         causal=True, use_rope=args.use_rope, softmax_impl='flash',
-        qk_quant=args.qk_quant, dtype=dtype)
+        qk_quant=args.qk_quant, dtype=dtype,
+        decode_impl=(None if args.decode_impl == 'auto'
+                     else args.decode_impl))
     b = args.batch
     x0 = jnp.zeros((b, 16, h * d), dtype)
     params = model.init(jax.random.key(0), x0, x0, x0, None)
@@ -708,6 +723,27 @@ def run_decode(args):
     # round-4 semantics, where b was always 1) and ms_per_step carries
     # the per-step latency the batched table reads.
     step_time = best / chain
+
+    # Time-to-first-token: cold cache → whole prompt ingested through
+    # the prefill flash pass → the logits that commit token 1. Timed as
+    # (fresh cache + prefill) per call so repeats don't overflow the
+    # buffer; the decode-step latency above is added so the headline is
+    # prompt-to-first-EMITTED-token, matching how a serving loop feeds
+    # the prefill's last logits through one decode dispatch.
+    prefill_time = None
+    if not args.no_ttft:
+        prompt = jax.random.normal(jax.random.key(3), (b, fill, h * d),
+                                   dtype)
+
+        def prefill_fn(p, toks):
+            c = model.make_decode_cache(b, t_max, dtype=dtype)
+            c, out = model.apply(p, toks, toks, toks, c,
+                                 method='prefill')
+            return out[:, -1:]            # tiny residue forces the pass
+
+        prefill_jit = jax.jit(prefill_fn)
+        prefill_time, _ = time_fn(prefill_jit, params, prompt,
+                                  iters=max(2, args.iters // 2))
     # Bytes the attention actually streams per step: V at the cache
     # dtype plus K at the cache dtype — or the 1-byte int8 mirror (and
     # its small per-row scales) when qk_quant carries one, so the GB/s
@@ -716,11 +752,22 @@ def run_decode(args):
     k_bytes = (t_max * d * 1 + t_max * 4 if args.qk_quant == 'int8'
                else t_max * d * elem)
     cache_bytes = b * h_kv * (t_max * d * elem + k_bytes)
+    # The path actually measured (auto resolves per backend), so
+    # kernel-vs-XLA tables read straight off the records — resolved by
+    # the SAME function decode_step uses, so the label cannot drift
+    # from the code path.
+    from distributed_dot_product_tpu.models.decode import (
+        _resolve_decode_impl,
+    )
+    impl_resolved = _resolve_decode_impl(
+        None if args.decode_impl == 'auto' else args.decode_impl,
+        cache_box[0], 1, None, args.qk_quant)
     record = {
         'mode': 'decode', 't_max': t_max, 'fill': fill, 'heads': h,
         'kv_heads': h_kv, 'head_dim': d, 'dtype': args.dtype,
         'use_rope': args.use_rope, 'world': 1,
         'batch': b, 'chain': chain, 'qk_quant': args.qk_quant,
+        'decode_impl': impl_resolved,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'ms_per_step': step_time * 1e3,
@@ -728,13 +775,21 @@ def run_decode(args):
         'ms_per_token_mean': mean / chain / b * 1e3,
         'tokens_per_s': b * chain / best,
         'cache_gb_per_s': cache_bytes / step_time / 1e9,
+        'prefill_ms': (None if prefill_time is None
+                       else prefill_time * 1e3),
+        'ttft_ms': (None if prefill_time is None
+                    else (prefill_time + step_time) * 1e3),
     }
     gq = '' if h_kv == h else f'/kv{h_kv}'
     bc = '' if (b == 1 and chain == 1) else f' B={b} chain={chain}'
-    print(f"decode t_max={t_max} fill={fill} H={h}{gq} d={d}{bc}: "
+    ttft = ('' if prefill_time is None
+            else f" TTFT {record['ttft_ms']:.1f} ms")
+    print(f"decode[{impl_resolved}] t_max={t_max} fill={fill} "
+          f"H={h}{gq} d={d}{bc}: "
           f"{record['ms_per_step']:.3f} ms/step "
           f"{record['tokens_per_s']:,.0f} tok/s "
-          f"({record['cache_gb_per_s']:.0f} GB/s over the cache)")
+          f"({record['cache_gb_per_s']:.0f} GB/s over the cache)"
+          + ttft)
     _append_record(args.file, record)
     return record
 
@@ -773,7 +828,9 @@ def run_decode_serve(args):
 
     def make_engine():
         return KernelEngine(slots=slots, t_max=t_max, vocab=256, heads=h,
-                            head_dim=d, prefill_chunk=8, seed=0)
+                            head_dim=d, prefill_chunk=8, seed=0,
+                            decode_impl=(None if args.decode_impl == 'auto'
+                                         else args.decode_impl))
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 256, size=prompt_len).astype(np.int32)
@@ -798,6 +855,22 @@ def run_decode_serve(args):
     n_steps = n_rounds * steps_per_seq
     bare_tps = slots * n_steps / bare_s
 
+    # Time-to-first-token through the engine surface: chunked prefill
+    # of one prompt + the first decode step, host-clocked on warm
+    # compiled programs — what a request admitted to an idle slot waits
+    # before its first token.
+    chunks = [prompts[0][i:i + eng.prefill_chunk]
+              for i in range(0, prompt_len, eng.prefill_chunk)]
+    for c in chunks:                              # warm the prefill jit
+        eng.prefill(0, c)
+    eng.step(tokens, active)
+    eng.reset(0)
+    t0 = _time.perf_counter()
+    for c in chunks:
+        eng.prefill(0, c)
+    eng.step(tokens, active)
+    ttft_s = _time.perf_counter() - t0
+
     # (b) the scheduler serving the same token volume as a burst.
     eng = make_engine()
     eng.step(tokens, active)                      # same warm start
@@ -816,23 +889,32 @@ def run_decode_serve(args):
     n_tok = sum(len(r.tokens) for r in results.values())
     sched_tps = n_tok / sched_s
 
+    from distributed_dot_product_tpu.models.decode import (
+        _resolve_decode_impl,
+    )
+    impl_resolved = _resolve_decode_impl(
+        None if eng.decode_impl == 'auto' else eng.decode_impl,
+        eng.cache, 1, None, None)
     record = {
         'mode': 'decode-serve', 'slots': slots, 't_max': t_max,
         'heads': h, 'head_dim': d, 'requests': n_requests,
         'prompt_len': prompt_len, 'max_new_tokens': max_new,
+        'decode_impl': impl_resolved,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'bare_tokens_per_s': bare_tps,
         'sched_tokens_per_s': sched_tps,
         'sched_overhead_pct': 100.0 * (bare_tps - sched_tps)
                               / bare_tps,
+        'ttft_ms': ttft_s * 1e3,
         'completed': sum(r.status == 'completed'
                          for r in results.values()),
     }
-    print(f"decode-serve slots={slots} t_max={t_max} "
+    print(f"decode-serve[{impl_resolved}] slots={slots} t_max={t_max} "
           f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
           f"{bare_tps:,.0f} tok/s "
-          f"({record['sched_overhead_pct']:.1f}% overhead)")
+          f"({record['sched_overhead_pct']:.1f}% overhead, "
+          f"TTFT {record['ttft_ms']:.1f} ms)")
     _append_record(args.file, record)
     return record
 

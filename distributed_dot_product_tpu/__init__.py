@@ -56,8 +56,8 @@ from distributed_dot_product_tpu.models.ring_attention import (  # noqa: F401
 )
 from distributed_dot_product_tpu.models.decode import (  # noqa: F401
     DecodeCache, append_kv, append_kv_sharded, append_kv_slots,
-    decode_attention, init_cache, init_slot_cache, reset_slot,
-    slots_all_finite,
+    decode_attention, decode_kernel_eligible, decode_step, init_cache,
+    init_slot_cache, reset_slot, slots_all_finite,
 )
 from distributed_dot_product_tpu.models.transformer import (  # noqa: F401
     TransformerBlock, TransformerStack,

@@ -38,7 +38,9 @@ reference module.py:19).
 """
 
 import math
+import warnings
 import zlib
+from collections import OrderedDict
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -154,6 +156,14 @@ class DistributedDotProductAttn(nn.Module):
     # reference module.py:41-58.
     use_rope: bool = False
     rope_base: float = 10000.0
+    # Decode-step implementation: None/'auto' picks the fused Pallas
+    # decode kernel (in-place aliased cache append + split-K masked
+    # attention, ops/pallas_decode.py) on TPU and the portable XLA
+    # append+einsum step elsewhere; 'kernel'/'xla' force a path (the
+    # kernel runs interpreted off-TPU, mirroring the flash-kernel
+    # gating). Applies to decode/decode_sharded; prefill always runs
+    # the flash kernel.
+    decode_impl: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -190,6 +200,10 @@ class DistributedDotProductAttn(nn.Module):
                                  'position and require causal=True')
         if self.qk_quant is not None:
             features.check('qk_quant', self.softmax_impl)
+        if self.decode_impl not in (None, 'auto', 'kernel', 'xla'):
+            raise ValueError(f"decode_impl must be None, 'auto', "
+                             f"'kernel' or 'xla', got "
+                             f'{self.decode_impl!r}')
         if self.ring_layout == 'zigzag':
             features.check('ring_layout=zigzag', self.softmax_impl)
         if self.flash_softmax_mode == 'bounded':
@@ -636,22 +650,29 @@ class DistributedDotProductAttn(nn.Module):
         segments: pass this step's ``segment_ids (B, n)`` with the
         cached positions' ``seg_cache (B, t_max)``. Requires
         ``causal=True`` (autoregressive semantics); dropout is
-        inference-off; runs locally (replicate or batch-shard for
-        serving — sequence parallelism is a training concern). Use
-        ``apply(params, k, q, v, cache, method='decode')``; returns
-        ``(cache, out (B, n, value_dim))``.
+        inference-off. This method runs on ONE device's cache
+        (replicate or batch-shard for serving); when the serving
+        context outgrows one chip's HBM, the sequence-SHARDED decode
+        surface is :meth:`decode_sharded` (slab-sharded cache inside a
+        ``shard_map``) with :func:`decode_seq_parallel` /
+        :func:`make_decode_step` as the global-array wrappers. The
+        append+attend pair runs as one fused step
+        (:func:`~distributed_dot_product_tpu.models.decode.decode_step`;
+        the ``decode_impl`` field selects the Pallas kernel vs the XLA
+        formulation). Use ``apply(params, k, q, v, cache,
+        method='decode')``; returns ``(cache, out (B, n, value_dim))``.
         """
         from distributed_dot_product_tpu.models.decode import (
-            append_kv, decode_attention,
+            decode_step,
         )
         keys, queries, values = self._project_for_decode(
             keys, queries, values, cache)
-        cache = append_kv(cache, queries, values)
-        out = decode_attention(
-            keys, cache, scale=1.0 / math.sqrt(self.head_dim),
+        cache, out = decode_step(
+            keys, cache, queries, values,
+            scale=1.0 / math.sqrt(self.head_dim),
             window=self.window, alibi_slopes=self.alibi_slopes,
             qk_quant=self.qk_quant, segment_ids=seg_cache,
-            seg_q=segment_ids)
+            seg_q=segment_ids, impl=self.decode_impl)
         return cache, self._merge_decode_heads(out)
 
     def decode_sharded(self, keys, queries, values, cache,
@@ -666,19 +687,22 @@ class DistributedDotProductAttn(nn.Module):
         Inputs/projections are replicated; ``seg_cache`` (if used) is
         the slab's LOCAL ``(B, t_max/N)`` shard. Same knob coverage as
         ``decode``; bit-for-tolerance parity with it is pinned by
-        tests/test_decode_sharded.py."""
+        tests/test_decode_sharded.py. On the kernel path
+        (``decode_impl``) each shard runs the fused Pallas step over
+        its slab (owner appends in place) and the shards merge by the
+        flash-decoding pmax/psum rule."""
         from distributed_dot_product_tpu.models.decode import (
-            append_kv_sharded, decode_attention,
+            decode_step,
         )
         ax = axis_name or self.axis_name
         keys, queries, values = self._project_for_decode(
             keys, queries, values, cache)
-        cache = append_kv_sharded(cache, queries, values, axis_name=ax)
-        out = decode_attention(
-            keys, cache, scale=1.0 / math.sqrt(self.head_dim),
+        cache, out = decode_step(
+            keys, cache, queries, values,
+            scale=1.0 / math.sqrt(self.head_dim),
             window=self.window, alibi_slopes=self.alibi_slopes,
             qk_quant=self.qk_quant, segment_ids=seg_cache,
-            seg_q=segment_ids, axis_name=ax)
+            seg_q=segment_ids, axis_name=ax, impl=self.decode_impl)
         return cache, self._merge_decode_heads(out)
 
 
@@ -729,7 +753,11 @@ def make_decode_step(module, mesh, mesh_axis=None, donate=True):
     donation each token copies the full K/V slabs first — the same ~1
     ms/token copy `benchmark.py`'s local decode isolates). Reuse the
     returned step across tokens; rebuilding it per token would re-trace
-    the whole module apply each time."""
+    the whole module apply each time. The step routes through the fused
+    decode path (``module.decode_impl``): on the kernel path each
+    shard's append+attend is one Pallas program with the slab aliased
+    in place — donation then means the slab is NEVER copied, not even
+    once per step."""
     mesh_axis = mesh_axis or module.axis_name
     from distributed_dot_product_tpu.models.decode import DecodeCache
     spec4 = P(None, None, mesh_axis, None)
@@ -749,7 +777,14 @@ def make_decode_step(module, mesh, mesh_axis=None, donate=True):
     return jax.jit(step, donate_argnums=(4,) if donate else ())
 
 
-_DECODE_STEPS = {}
+# Compiled decode steps keyed by (module, mesh, axis). BOUNDED: a
+# serving host cycling many module/mesh configurations would otherwise
+# grow this forever (each entry pins a compiled executable); least-
+# recently-used entries are evicted past the cap — eviction only costs
+# a re-trace on revisit, never correctness.
+_DECODE_STEPS = OrderedDict()
+_DECODE_STEPS_CAP = 16
+_WARNED_UNHASHABLE = False
 
 
 def decode_seq_parallel(module, params, mesh, keys, queries, values,
@@ -765,15 +800,32 @@ def decode_seq_parallel(module, params, mesh, keys, queries, values,
     which is the whole point: one chip's HBM stops bounding the serving
     context.
 
-    The compiled step is cached per ``(module, mesh, axis)`` so a
-    per-token loop traces once; serving loops that want explicit
-    control use :func:`make_decode_step` directly."""
+    The compiled step is cached per ``(module, mesh, axis)`` — LRU-
+    bounded to ``_DECODE_STEPS_CAP`` entries — so a per-token loop
+    traces once. A module with an unhashable field (e.g. array ALiBi
+    slopes) cannot be cached: that silently rebuilds AND re-traces the
+    whole step EVERY token, so it warns once — pass hashable slopes
+    (a tuple) or hold the step from :func:`make_decode_step` yourself."""
+    global _WARNED_UNHASHABLE
     key = (module, mesh, mesh_axis)
     try:
         step = _DECODE_STEPS.get(key)
         if step is None:
             step = _DECODE_STEPS[key] = make_decode_step(
                 module, mesh, mesh_axis)
+        else:
+            _DECODE_STEPS.move_to_end(key)
+        while len(_DECODE_STEPS) > _DECODE_STEPS_CAP:
+            _DECODE_STEPS.popitem(last=False)
     except TypeError:   # unhashable module field (e.g. array slopes)
+        if not _WARNED_UNHASHABLE:
+            _WARNED_UNHASHABLE = True
+            warnings.warn(
+                'decode_seq_parallel: module is unhashable (an array-'
+                'valued field such as alibi_slopes?) — the compiled '
+                'decode step cannot be cached and EVERY token will '
+                're-trace and re-jit the full module apply. Use a '
+                'hashable field (e.g. a tuple of slopes) or build the '
+                'step once with make_decode_step.', stacklevel=2)
         step = make_decode_step(module, mesh, mesh_axis)
     return step(params, keys, queries, values, cache)

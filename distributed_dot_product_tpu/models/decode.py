@@ -55,7 +55,8 @@ from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'decode_attention', 'init_slot_cache', 'append_kv_slots',
-           'reset_slot', 'slots_all_finite']
+           'reset_slot', 'slots_all_finite', 'decode_step',
+           'decode_kernel_eligible']
 
 
 class DecodeCache(NamedTuple):
@@ -359,6 +360,191 @@ def slots_all_finite(x):
     (train.py ``guard=True``) at slot granularity, so ONE poisoned
     sequence is evicted instead of failing the whole batch."""
     return jnp.all(jnp.isfinite(x.reshape(x.shape[0], -1)), axis=-1)
+
+
+def decode_kernel_eligible(cache: DecodeCache, n=1, segment_ids=None,
+                           qk_quant=None):
+    """Can :func:`decode_step` take the fused Pallas kernel for this
+    call? The kernel covers the serving hot path — one new token per
+    slot, causal/window/ALiBi/GQA masking, the int8 mirror — and leaves
+    the long tail (packed segments, multi-row chunks, mirror-less int8,
+    K splits that don't divide ``t_max``) to the XLA formulation."""
+    from distributed_dot_product_tpu.ops.pallas_decode import (
+        decode_block_k,
+    )
+    if n != 1 or segment_ids is not None:
+        return False
+    if qk_quant == 'int8' and cache.k_q is None:
+        return False
+    return decode_block_k(cache.t_max) is not None
+
+
+def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant):
+    if impl in (None, 'auto'):
+        # Mirror the flash-kernel gating: the kernel is the TPU path;
+        # elsewhere it would run interpreted (covered by tests that
+        # force impl='kernel'), so the portable XLA step is the default.
+        if (decode_kernel_eligible(cache, n, segment_ids, qk_quant)
+                and jax.default_backend() == 'tpu'):
+            return 'kernel'
+        return 'xla'
+    if impl not in ('kernel', 'xla'):
+        raise ValueError(f"decode impl must be None/'auto'/'kernel'/"
+                         f"'xla', got {impl!r}")
+    if impl == 'kernel' and not decode_kernel_eligible(
+            cache, n, segment_ids, qk_quant):
+        raise ValueError(
+            'decode_step: the fused kernel does not cover this call '
+            '(needs n=1, no segment_ids, an int8 mirror when '
+            "qk_quant='int8', and a t_max the K split divides) — use "
+            "impl='auto' to fall back")
+    return impl
+
+
+def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
+                scale=None, window=None, alibi_slopes=None,
+                segment_ids=None, seg_q=None, qk_quant=None,
+                axis_name=None, impl=None, interpret=None):
+    """One fused decode step: append ``k_new``/``v_new`` to the cache
+    AND attend ``q`` against the result — ``append_kv*`` +
+    :func:`decode_attention` as ONE call, so the kernel path
+    (``impl='kernel'``, or ``'auto'`` on TPU) runs it as a single
+    Pallas program with the cache appended IN PLACE via
+    ``input_output_aliases`` (no scan-carry or donated-copy round trip
+    of the buffers; see ``ops/pallas_decode.py``). ``impl='xla'`` (and
+    ``'auto'`` off-TPU, or
+    whenever the kernel doesn't cover the call —
+    :func:`decode_kernel_eligible`) computes the identical math through
+    the existing portable ops.
+
+    ``q (B, H, n, d)`` with ``n == 1`` on the kernel path; per-slot
+    caches (:func:`init_slot_cache`) take ``slot_mask`` exactly as
+    :func:`append_kv_slots` does (masked slots append nothing and their
+    queries attend their un-advanced prefix); ``axis_name`` runs the
+    sequence-sharded step (inside a ``shard_map``, slab-sharded cache —
+    the kernel path merges shards by the flash-decoding pmax/psum
+    rule). Overflow follows the append contracts: concrete lengths
+    raise eagerly, traced lengths write nothing while the length still
+    advances. Returns ``(cache, out (B, H, n, d_v))``.
+    """
+    n = q.shape[-2]
+    impl = _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant)
+    per_slot = cache.length.ndim == 1
+    if per_slot and axis_name is not None:
+        raise ValueError(
+            'per-slot lengths (init_slot_cache) are a local serving '
+            'construct; sequence-sharded decode uses the scalar global '
+            'length')
+    if slot_mask is not None and not per_slot:
+        raise ValueError('slot_mask needs a per-slot cache '
+                         '(init_slot_cache); scalar-length caches share '
+                         'one sequence clock')
+
+    if impl == 'xla':
+        if axis_name is not None:
+            cache = append_kv_sharded(cache, k_new, v_new,
+                                      axis_name=axis_name)
+        elif per_slot:
+            cache = append_kv_slots(cache, k_new, v_new,
+                                    slot_mask=slot_mask)
+        else:
+            cache = append_kv(cache, k_new, v_new)
+        out = decode_attention(
+            q, cache, scale=scale, window=window,
+            alibi_slopes=alibi_slopes, segment_ids=segment_ids,
+            seg_q=seg_q, qk_quant=qk_quant, axis_name=axis_name)
+        return cache, out
+
+    from distributed_dot_product_tpu.ops.pallas_decode import (
+        flash_decode,
+    )
+    b = q.shape[0]
+    t_max = cache.t_max
+    if axis_name is not None:
+        # Sharded slab: the append lands on the owning shard only; the
+        # masking bound is the query's GLOBAL position localized to
+        # this slab (negative = slab wholly in the future).
+        p = cache.length
+        col_off = lax.axis_index(axis_name) * t_max
+        ok = p + 1 <= lax.psum(1, axis_name) * t_max
+        owner = jnp.logical_and(
+            jnp.logical_and(p >= col_off, p < col_off + t_max), ok)
+        vt = jnp.broadcast_to(p - col_off, (b,))
+        ap = jnp.broadcast_to(jnp.where(owner, p - col_off, -1), (b,))
+        new_length = cache.length + 1
+    else:
+        lengths = (cache.length if per_slot
+                   else jnp.broadcast_to(cache.length, (b,)))
+        active = (jnp.ones((b,), bool) if slot_mask is None
+                  else jnp.asarray(slot_mask, bool))
+        # Eager overflow raise when the lengths are concrete — same
+        # contract (and message shape) as the append ops.
+        host_len = _concrete_lengths(lengths)
+        try:
+            host_act = [bool(x) for x in active]
+        except (jax.errors.ConcretizationTypeError, TypeError):
+            host_act = None
+        if host_len is not None and host_act is not None:
+            for i, (cur, act) in enumerate(zip(host_len, host_act)):
+                if act and cur + 1 > t_max:
+                    where = f' on slot {i}' if per_slot else ''
+                    raise ValueError(
+                        f'KV-cache overflow{where}: length {cur} + 1 '
+                        f'new position exceeds t_max {t_max} — evict '
+                        f'the slot (reset_slot) or stop the generation '
+                        f'loop')
+        fits = lengths + 1 <= t_max
+        ap = jnp.where(jnp.logical_and(active, fits), lengths, -1)
+        # Active queries sit AT the appended position; frozen slots'
+        # queries attend their un-advanced prefix (decode_attention's
+        # semantics after a slot-masked append). An overflowing append
+        # writes nothing but the query still masks at its advanced
+        # position — matching the traced-guard contract bit for bit.
+        vt = jnp.where(active, lengths, lengths - 1)
+        adv = active.astype(cache.length.dtype)
+        new_length = (cache.length + adv if per_slot
+                      else cache.length + 1)
+
+    res = flash_decode(
+        q, k_new, v_new, cache.k, cache.v, vt, ap,
+        k_q=cache.k_q if qk_quant == 'int8' else None,
+        k_scale=cache.k_scale if qk_quant == 'int8' else None,
+        scale=scale, window=window, alibi_slopes=alibi_slopes,
+        qk_quant=qk_quant, interpret=interpret,
+        partials=axis_name is not None)
+    out, new_k, new_v, new_kq, new_ks = res
+    if cache.k_q is not None and new_kq is None:
+        # A non-int8 step on a mirror-carrying cache still has to keep
+        # the mirror exact — quantize the appended row the append-op
+        # way (rare path: mirrors exist for int8 decoding).
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        bb, h_kv, _, d = cache.k.shape
+        ki8, ks = _quantize_rows(k_new.astype(cache.k.dtype), bb * h_kv,
+                                 1, d)
+        g = jnp.arange(t_max)[None, :]
+        hit = (g == ap[:, None])[:, None, :, None]
+        new_kq = jnp.where(hit, ki8.reshape(bb, h_kv, 1, d), cache.k_q)
+        new_ks = jnp.where(hit, ks.reshape(bb, h_kv, 1, 1),
+                           cache.k_scale)
+    elif cache.k_q is not None:
+        pass                                    # kernel maintained it
+    else:
+        new_kq = new_ks = None
+    cache = DecodeCache(k=new_k, v=new_v, length=new_length,
+                        k_q=new_kq, k_scale=new_ks)
+    if axis_name is None:
+        return cache, out
+    # Flash-decoding cross-shard merge: shift every shard's partials by
+    # the global base-2 max, then numerator/denominator are plain psums.
+    num, m, l = out
+    m_g = lax.pmax(m, axis_name)
+    corr = jnp.exp2(m - m_g)
+    num = lax.psum(num * corr, axis_name)
+    den = lax.psum(l * corr, axis_name)
+    out = (num / jnp.where(den == 0.0, 1.0, den)).astype(cache.v.dtype)
+    return cache, out
 
 
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,

@@ -137,9 +137,15 @@ class TransformerLM(nn.Module):
 
     def _head(self, x):
         x = self.ln_f(x)
-        # logits = x · Eᵀ on the MXU, fp32 accumulation.
+        # logits = x · Eᵀ on the MXU, fp32 accumulation — requested
+        # explicitly (preferred_element_type) so the contraction
+        # accumulates in fp32 on EVERY backend, not just where it's the
+        # hardware default; the result is cast back to the activation
+        # dtype (the contract is fp32 accumulation, not fp32 logits).
         return jnp.einsum('...d,vd->...v', x,
-                          self._head_table().astype(x.dtype))
+                          self._head_table().astype(x.dtype),
+                          preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
 
     def __call__(self, tokens, segment_ids=None, deterministic=False,
                  dropout_seed=None):
@@ -243,8 +249,13 @@ def greedy_generate(model, params, prompt, steps, t_max, donate=True):
     if steps < 1:
         raise ValueError(f'steps must be >= 1, got {steps} (the prefill '
                          'logits already commit the first token)')
-    if n + steps > t_max:
-        raise ValueError(f'prompt {n} + steps {steps} exceeds t_max '
+    # Capacity: prefill appends the n prompt rows and the loop appends
+    # steps − 1 more (the FIRST generated token comes from the prefill
+    # logits and its k/v land on the first loop iteration), so exactly
+    # n + steps − 1 cache rows are written.
+    if n + steps - 1 > t_max:
+        raise ValueError(f'prompt {n} + steps {steps} needs '
+                         f'{n + steps - 1} cache rows but t_max is '
                          f'{t_max}')
     caches = model.make_decode_caches(b, t_max)
     caches, logits = jax.jit(

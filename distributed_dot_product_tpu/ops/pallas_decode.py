@@ -1,0 +1,443 @@
+# -*- coding: utf-8 -*-
+"""
+Fused single-token KV-cache decode kernel (the serving hot path).
+
+``models/decode.py``'s XLA formulation runs a decode step as two ops —
+``append_kv_slots`` (a masked gather over the whole ``t_max`` axis) and
+``decode_attention`` (a masked einsum softmax over the full buffer) —
+which is correct and backend-portable but leaves the chained serving
+loop ~2× above its own measured physics floor: with the cache riding a
+``lax.scan`` carry between the two ops, XLA materializes full
+cache-shaped copies per step (RESULTS.md "KV-cache decode": 10.34
+ms/step at B=8/131K vs 4.25 + 0.9 ms of attention + append in
+isolation), and the int8 K mirror *loses* to bf16 (0.32 vs 0.21
+ms/step) because XLA's s8 dot lowering at 4-row operands never cashes
+the halved bytes in.
+
+This kernel is the fix both RESULTS entries name: ONE Pallas program
+per decode step that
+
+- **appends in place**: the K/V buffers (and the int8 mirror, when the
+  cache carries one) are passed as aliased outputs
+  (``input_output_aliases``), and only the single block containing the
+  append row is ever written — the cache never travels through a scan
+  carry or a donated-copy, and unwritten blocks keep their bits by the
+  aliasing contract;
+- **splits K over the time axis**: the grid sweeps ``t_max`` in
+  ``block_k`` chunks with running ``(max, denom, acc)`` accumulators in
+  VMEM scratch (the flash-decoding work partition; on TPU the grid is
+  sequential per core, so the split is what lets Pallas double-buffer
+  the HBM→VMEM cache stream while the MXU works);
+- **masks per slot**: the per-slot valid lengths arrive as a
+  scalar-prefetch vector that both the kernel (causal/window masking,
+  the new row's score substitution) and the BlockSpec index maps read —
+  blocks past a slot's fill are never even DMA'd (the index map clamps
+  to the last useful block, and Pallas skips re-fetching a resident
+  block), so a half-empty serving batch streams half the bytes;
+- **dequantizes int8 in kernel**: the quantized path streams the 1-byte
+  ``k_q`` mirror plus its per-row scales and scores s8×s8→s32 on the
+  MXU with the dequantization applied to the s32 block — the halved K
+  bytes finally reach the memory system as halved traffic instead of
+  dying in XLA's s8 lowering.
+
+Numerics: the same exp2-trick online softmax as
+:mod:`~distributed_dot_product_tpu.ops.pallas_attention` (scale·log2e
+pre-folded into q, masked logits −inf against a ``_NEG_BIG``-clamped
+running max, empty rows → exact 0). Outputs are the UN-normalized
+``(num, max, denom)`` triple so sequence-sharded callers can merge
+shards by the flash-decoding pmax/psum rule; local callers divide once
+outside (G rows — noise).
+
+Off-TPU the kernel runs under the Pallas interpreter like the training
+kernels (``interpret=None`` auto-selects), so the CPU tier-1 suite
+covers the identical code path.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_dot_product_tpu.ops.pallas_attention import (
+    _LOG2E, _NEG_BIG, _quantize_rows,
+)
+
+__all__ = ['flash_decode', 'decode_block_k']
+
+# K-split cap: 1024 rows/block keeps the double-buffered K+V stream
+# well inside VMEM at every head dim the repo uses (d=256 worst case:
+# 2·(1024·256·2 B)·2 buffers ≈ 4 MB of the ~16 MB budget).
+_BLOCK_K_CAP = 1024
+
+
+def decode_block_k(t_max, cap=_BLOCK_K_CAP):
+    """Largest usable K-split for a ``t_max``-row cache, or None when the
+    kernel doesn't apply. The cache buffers are ALIASED outputs, so they
+    cannot be padded — the split must divide ``t_max`` exactly. Any
+    ``t_max <= cap`` is one split; larger caches take the biggest
+    power-of-two divisor (serving caches are powers of two; an odd
+    131071-row cache falls back to the XLA path rather than running a
+    degenerate grid)."""
+    if t_max <= cap:
+        return t_max
+    for bk in (1024, 512, 256, 128):
+        if bk <= cap and t_max % bk == 0:
+            return bk
+    return None
+
+
+def _pad_rows(x, mult):
+    """Pad axis -2 up to a multiple of ``mult``."""
+    n = x.shape[-2]
+    target = -(-n // mult) * mult
+    if target == n:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[-2] = (0, target - n)
+    return jnp.pad(x, pad)
+
+
+def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
+                        has_alibi):
+    """Kernel body; refs are ordered to match ``flash_decode``'s spec
+    list below. Grid = (B·H_kv, ns) with the K split innermost; the
+    running softmax state lives in scratch across splits."""
+
+    def kernel(vt_ref, ap_ref, *refs):
+        b = pl.program_id(0)
+        ki = pl.program_id(1)
+        br = b // h_kv                          # cache batch row
+        vt = vt_ref[br]                         # last valid local column
+        ap = ap_ref[br]                         # append column (−1 none)
+        # The block the append write targets — must equal the k/v OUT
+        # BlockSpec index maps exactly (ap < 0 ⇒ a copy-through of
+        # block 0, because Pallas writes every output block back and an
+        # unwritten one would clobber the aliased cache with garbage).
+        wsplit = jnp.where(ap >= 0, jnp.clip(ap // bk, 0, ns - 1), 0)
+
+        it = iter(refs)
+        q_ref = next(it)
+        sqf_ref = next(it) if quantized else None
+        kn_ref = next(it)
+        kqn_ref = next(it) if quantized else None
+        ksn_ref = next(it) if quantized else None
+        vn_ref = next(it)
+        k_ref = next(it)
+        kq_ref = next(it) if quantized else None
+        ks_ref = next(it) if quantized else None
+        v_ref = next(it)
+        alibi_ref = next(it) if has_alibi else None
+        (o_ref, m_ref, l_ref, ko_ref, vo_ref) = (
+            next(it), next(it), next(it), next(it), next(it))
+        kqo_ref = next(it) if quantized else None
+        kso_ref = next(it) if quantized else None
+        m_s, l_s, acc_s = next(it), next(it), next(it)
+
+        @pl.when(ki == 0)
+        def _():
+            m_s[:] = jnp.full_like(m_s, _NEG_BIG)
+            l_s[:] = jnp.zeros_like(l_s)
+            acc_s[:] = jnp.zeros_like(acc_s)
+
+        # Block-skip: no valid column in this split (strictly past the
+        # slot's fill, or — with a window — wholly before the lookback).
+        run = ki * bk <= vt
+        if window is not None:
+            run = jnp.logical_and(run, ki * bk + bk - 1 > vt - window)
+
+        @pl.when(run)
+        def _():
+            cols = (ki * bk
+                    + jax.lax.broadcasted_iota(jnp.int32, (g_pad, bk), 1))
+            if quantized:
+                # ks_ref blocks are (1, BK): the K-row scales already
+                # laid out as a row vector (the training kernels'
+                # convention — no in-kernel transpose/relayout).
+                s = jax.lax.dot_general(
+                    q_ref[0], kq_ref[0], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32).astype(jnp.float32)
+                s = s * sqf_ref[0] * ks_ref[0]
+                s_new = jax.lax.dot_general(
+                    q_ref[0], kqn_ref[0], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32).astype(jnp.float32)
+                s_new = s_new * sqf_ref[0] * ksn_ref[0, 0, 0]
+            else:
+                s = jax.lax.dot_general(
+                    q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s_new = jax.lax.dot_general(
+                    q_ref[0], kn_ref[0], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            # The appended row's score replaces whatever the buffer held
+            # at its column (ap == −1 matches no column: cols are ≥ 0).
+            s = jnp.where(cols == ap, s_new, s)
+            rel = cols - vt                       # ≤ 0 on valid columns
+            if alibi_ref is not None:
+                s = s + alibi_ref[0] * rel.astype(jnp.float32)
+            masked = rel > 0
+            if window is not None:
+                masked = jnp.logical_or(masked, rel <= -window)
+            s = jnp.where(masked, -jnp.inf, s)
+
+            rows_v = (ki * bk
+                      + jax.lax.broadcasted_iota(
+                          jnp.int32, v_ref.shape[1:], 0))
+            v = jnp.where(rows_v == ap, vn_ref[0], v_ref[0])
+
+            m_prev = m_s[:]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            corr = jnp.exp2(m_prev - m_new)
+            m_s[:] = m_new
+            l_s[:] = l_s[:] * corr + p.sum(axis=-1, keepdims=True)
+            acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        # In-place append: substitute the new row into the resident
+        # block and write it back — the ONLY cache block written this
+        # step (every other aliased block keeps its bits untouched).
+        @pl.when(ki == wsplit)
+        def _():
+            rows_k = (ki * bk
+                      + jax.lax.broadcasted_iota(
+                          jnp.int32, k_ref.shape[1:], 0))
+            ko_ref[0] = jnp.where(rows_k == ap, kn_ref[0], k_ref[0])
+            rows_v = (ki * bk
+                      + jax.lax.broadcasted_iota(
+                          jnp.int32, v_ref.shape[1:], 0))
+            vo_ref[0] = jnp.where(rows_v == ap, vn_ref[0], v_ref[0])
+            if quantized:
+                kqo_ref[0] = jnp.where(rows_k == ap, kqn_ref[0],
+                                       kq_ref[0])
+                cols_s = (ki * bk
+                          + jax.lax.broadcasted_iota(
+                              jnp.int32, ks_ref.shape[1:], 1))
+                kso_ref[0] = jnp.where(cols_s == ap, ksn_ref[0, 0, 0],
+                                       ks_ref[0])
+
+        @pl.when(ki == ns - 1)
+        def _():
+            o_ref[0] = acc_s[:]
+            m_ref[0] = m_s[:]
+            l_ref[0] = l_s[:]
+
+    return kernel
+
+
+def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
+                 *, k_q=None, k_scale=None, scale=None, window=None,
+                 alibi_slopes=None, qk_quant=None, interpret=None,
+                 block_k=None, partials=False):
+    """One fused decode step: in-place cache append + masked online-
+    softmax attention of each slot's query against its own prefix.
+
+    ``q (B, H, 1, d)``; ``k_new/v_new (B, H_kv, 1, d·)`` the step's new
+    row per slot; ``cache_k/cache_v (B, H_kv, t_max, d·)`` the (static-
+    shape) cache buffers, returned UPDATED — aliased in place on TPU,
+    so jit callers should donate them. GQA is native: each group of
+    ``H/H_kv`` query heads attends its cache head.
+
+    ``valid_to (B,) int32``: per slot, the highest cache column its
+    query attends (its own global position, localized by the caller for
+    sharded slabs; −1 or less = fully masked row → zero output).
+    ``append_at (B,) int32``: the local column where ``k_new/v_new``
+    land, or −1 to append nothing (inactive slot / non-owning shard).
+    When ``append_at[i] >= 0`` it must equal ``valid_to[i]`` (standard
+    causal decode ordering: the query attends the row it appends).
+
+    ``qk_quant='int8'`` requires the cache's append-time mirror
+    (``k_q``/``k_scale``) and scores s8×s8→s32 with in-kernel
+    dequantization — the mirror's halved K bytes become halved stream
+    traffic. The mirror and the bf16 buffer are BOTH appended in place.
+
+    Returns ``(out, cache_k, cache_v, k_q, k_scale)`` with
+    ``out (B, H, 1, dv)`` in ``cache_v.dtype`` — or, with
+    ``partials=True``, ``((num, m, l), cache_k, cache_v, k_q, k_scale)``
+    where ``num (B, H, 1, dv) f32`` is the un-normalized context and
+    ``m/l (B, H, 1, 1)`` the base-2 running max / denominator, for the
+    flash-decoding cross-shard merge (pmax the maxes, rescale, psum).
+    """
+    b, h, n, d = q.shape
+    h_kv, t_max = cache_k.shape[1], cache_k.shape[2]
+    dv = cache_v.shape[-1]
+    if n != 1:
+        raise ValueError(f'flash_decode is a single-token kernel; got '
+                         f'{n} query rows (use prefill for chunks)')
+    if h % h_kv:
+        raise ValueError(f'query heads {h} must be a multiple of cache '
+                         f'kv heads {h_kv}')
+    quantized = qk_quant == 'int8'
+    if qk_quant not in (None, 'int8'):
+        raise ValueError(f"qk_quant must be None or 'int8', "
+                         f'got {qk_quant!r}')
+    if quantized and (k_q is None or k_scale is None):
+        raise ValueError("qk_quant='int8' needs the cache's k_q/k_scale "
+                         'mirror (init_cache(qk_quant=...))')
+    bk = block_k or decode_block_k(t_max)
+    if bk is None or t_max % bk:
+        raise ValueError(
+            f'no usable K split for t_max={t_max} (block_k must divide '
+            f'it); use the XLA decode path for this cache shape')
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    group = h // h_kv
+    ns = t_max // bk
+    nb = b * h_kv
+
+    # Query rows grouped per cache head, padded to the sublane multiple
+    # of their kernel dtype; padded rows are sliced off the output.
+    qg = q.reshape(nb, group, d)
+    sub = 32 if quantized else (16 if cache_k.dtype == jnp.bfloat16
+                                else 8)
+    g_pad = -(-group // sub) * sub
+    if quantized:
+        qi, sq = _quantize_rows(qg, nb, group, d)
+        qf = _pad_rows(qi, sub)
+        sqf = _pad_rows(sq * (scale * _LOG2E), sub)
+        kni, kns = _quantize_rows(
+            k_new.astype(cache_k.dtype).reshape(nb, 1, d), nb, 1, d)
+    else:
+        qf = _pad_rows(
+            (qg.astype(jnp.float32) * (scale * _LOG2E)
+             ).astype(cache_k.dtype), sub)
+
+    knf = k_new.astype(cache_k.dtype).reshape(nb, 1, d)
+    vnf = v_new.astype(cache_v.dtype).reshape(nb, 1, dv)
+    kf = cache_k.reshape(nb, t_max, d)
+    vf = cache_v.reshape(nb, t_max, dv)
+    valid_to = jnp.asarray(valid_to, jnp.int32)
+    append_at = jnp.asarray(append_at, jnp.int32)
+
+    def const_idx(bi, ki, *rs):
+        return (bi, 0, 0)
+
+    def _stream_blk(bi, ki, vt):
+        # Never DMA past a slot's last useful block: beyond-fill splits
+        # alias the resident block (skipped in-kernel), so a half-empty
+        # slot streams half the bytes.
+        last = jnp.clip(vt[bi // h_kv] // bk, 0, ns - 1)
+        return jnp.minimum(ki, last)
+
+    def _write_blk(bi, ap):
+        a = ap[bi // h_kv]
+        return jnp.where(a >= 0, jnp.clip(a // bk, 0, ns - 1), 0)
+
+    def stream_idx(bi, ki, vt, ap):
+        return (bi, _stream_blk(bi, ki, vt), 0)
+
+    def write_idx(bi, ki, vt, ap):
+        return (bi, _write_blk(bi, ap), 0)
+
+    # The int8 scale mirror rides as a (nb, 1, t_max) ROW vector (a
+    # size-1-axis reshape — a bitcast, not a transpose), blocked on the
+    # LAST axis, so the kernel consumes (1, BK) scale rows directly.
+    def stream_idx_row(bi, ki, vt, ap):
+        return (bi, 0, _stream_blk(bi, ki, vt))
+
+    def write_idx_row(bi, ki, vt, ap):
+        return (bi, 0, _write_blk(bi, ap))
+
+    in_specs = [pl.BlockSpec((1, g_pad, d), const_idx)]
+    args = [qf]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, g_pad, 1), const_idx))
+        args.append(sqf)
+    in_specs.append(pl.BlockSpec((1, 1, d), const_idx))
+    args.append(knf)
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, d), const_idx),
+                     pl.BlockSpec((1, 1, 1), const_idx)]
+        args += [kni, kns.reshape(nb, 1, 1)]
+    in_specs.append(pl.BlockSpec((1, 1, dv), const_idx))
+    args.append(vnf)
+    # The bf16 K buffer: streamed for scoring in the plain path; in the
+    # quantized path scoring reads the mirror instead, so K is fetched
+    # ONLY at its write block (one DMA per slot, to seed the append).
+    in_specs.append(pl.BlockSpec((1, bk, d),
+                                 write_idx if quantized else stream_idx))
+    k_in_pos = len(args)
+    args.append(kf)
+    kq_in_pos = ks_in_pos = None
+    if quantized:
+        kqf = k_q.reshape(nb, t_max, d)
+        ksf = k_scale.reshape(nb, 1, t_max)
+        in_specs += [pl.BlockSpec((1, bk, d), stream_idx),
+                     pl.BlockSpec((1, 1, bk), stream_idx_row)]
+        kq_in_pos = len(args)
+        args.append(kqf)
+        ks_in_pos = len(args)
+        args.append(ksf)
+    in_specs.append(pl.BlockSpec((1, bk, dv), stream_idx))
+    v_in_pos = len(args)
+    args.append(vf)
+    has_alibi = alibi_slopes is not None
+    if has_alibi:
+        # Per-query-head slopes, pre-folded by log2e (the kernel's
+        # logits are in log2 units), laid out (nb, g_pad, 1) so slope
+        # rows align with their grouped query rows.
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            h_kv, group, 1) * _LOG2E
+        slopes = jnp.broadcast_to(slopes[None], (b, h_kv, group, 1))
+        in_specs.append(pl.BlockSpec((1, g_pad, 1), const_idx))
+        args.append(_pad_rows(slopes.reshape(nb, group, 1), sub))
+
+    out_specs = [
+        pl.BlockSpec((1, g_pad, dv), const_idx),   # num
+        pl.BlockSpec((1, g_pad, 1), const_idx),    # m
+        pl.BlockSpec((1, g_pad, 1), const_idx),    # l
+        pl.BlockSpec((1, bk, d), write_idx),       # k (aliased)
+        pl.BlockSpec((1, bk, dv), write_idx),      # v (aliased)
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((nb, g_pad, dv), jnp.float32),
+        jax.ShapeDtypeStruct((nb, g_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct((nb, g_pad, 1), jnp.float32),
+        jax.ShapeDtypeStruct(kf.shape, kf.dtype),
+        jax.ShapeDtypeStruct(vf.shape, vf.dtype),
+    ]
+    # +2: alias indices count the scalar-prefetch operands.
+    aliases = {2 + k_in_pos: 3, 2 + v_in_pos: 4}
+    if quantized:
+        out_specs += [pl.BlockSpec((1, bk, d), write_idx),
+                      pl.BlockSpec((1, 1, bk), write_idx_row)]
+        out_shape += [jax.ShapeDtypeStruct(kqf.shape, kqf.dtype),
+                      jax.ShapeDtypeStruct(ksf.shape, ksf.dtype)]
+        aliases[2 + kq_in_pos] = 5
+        aliases[2 + ks_in_pos] = 6
+
+    kernel = _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
+                                 has_alibi)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nb, ns),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((g_pad, 1), jnp.float32),
+                            pltpu.VMEM((g_pad, 1), jnp.float32),
+                            pltpu.VMEM((g_pad, dv), jnp.float32)]),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret)(valid_to, append_at, *args)
+
+    num, m, l, new_k, new_v = outs[:5]
+    new_kq = new_ks = None
+    if quantized:
+        new_kq = outs[5].reshape(k_q.shape)
+        new_ks = outs[6].reshape(k_scale.shape)   # same flat order
+    new_k = new_k.reshape(cache_k.shape)
+    new_v = new_v.reshape(cache_v.shape)
+
+    def head_shape(x):
+        return x[:, :group].reshape(b, h, 1, x.shape[-1])
+
+    num, m, l = head_shape(num), head_shape(m), head_shape(l)
+    if partials:
+        return (num, m, l), new_k, new_v, new_kq, new_ks
+    out = (num / jnp.where(l == 0.0, 1.0, l)).astype(cache_v.dtype)
+    return out, new_k, new_v, new_kq, new_ks

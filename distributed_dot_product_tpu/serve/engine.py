@@ -21,7 +21,10 @@ construction so nothing ever retraces mid-serve:
 
 - ``decode``: one token for EVERY slot (inactive slots masked out of
   the append; their outputs ignored) + per-slot all-finite verdict on
-  the logits. The fault injector's NaN mask is applied IN-PROGRAM so
+  the logits. The append+attend pair is the FUSED step
+  (``models.decode.decode_step``): on the kernel path it is one Pallas
+  program with the cache aliased in place, so the donated buffers are
+  never copied. The fault injector's NaN mask is applied IN-PROGRAM so
   the quarantine predicate sees real NaNs from the compiled step.
 - ``prefill``: one padded prompt chunk into one slot's cache rows (no
   attention — only the last prompt position's logits matter, and the
@@ -37,17 +40,35 @@ requeued request regenerates the same tokens) rest on this property,
 and the tests pin it.
 """
 
+import os
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from distributed_dot_product_tpu.models.decode import (
-    append_kv_slots, decode_attention, init_slot_cache, reset_slot,
+    append_kv_slots, decode_step, init_slot_cache, reset_slot,
     slots_all_finite,
 )
 
 __all__ = ['KernelEngine']
+
+
+def _resolve_decode_impl(decode_impl):
+    """Engine decode-path selection: an explicit argument wins; else the
+    ``DDP_TPU_DECODE_KERNEL`` env knob (1/kernel → fused Pallas step,
+    0/xla → portable step) — the hook ``scripts/smoke_serve.sh`` uses
+    to prove the fault cocktail on the kernel path; else 'auto' (kernel
+    on TPU, XLA elsewhere — see models/decode.decode_step)."""
+    if decode_impl is not None:
+        return decode_impl
+    env = os.environ.get('DDP_TPU_DECODE_KERNEL', '').strip().lower()
+    if env in ('1', 'true', 'kernel'):
+        return 'kernel'
+    if env in ('0', 'false', 'xla'):
+        return 'xla'
+    return 'auto'
 
 
 class KernelEngine:
@@ -56,13 +77,24 @@ class KernelEngine:
     ``prefill_chunk`` is the compiled chunk width for prompt ingestion
     (prompts append in ceil(len/chunk) calls — "chunked prefill", so a
     long prompt never monopolizes the loop between decode steps).
+
+    ``decode_impl``: 'kernel' runs the decode step as the fused Pallas
+    program (in-place aliased cache append + split-K attention —
+    ops/pallas_decode.py; the three compiled programs then stop paying
+    any cache round trip), 'xla' the portable append+einsum step, None
+    reads ``DDP_TPU_DECODE_KERNEL`` then defaults to auto (kernel on
+    TPU). Token streams are deterministic within an impl; the two
+    impls agree to float tolerance (exp2 vs exp rounding), so
+    bit-identity guarantees hold per-impl, not across.
     """
 
     def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
-                 prefill_chunk=8, seed=0, dtype=jnp.float32):
+                 prefill_chunk=8, seed=0, dtype=jnp.float32,
+                 decode_impl=None):
         if slots < 1 or t_max < 2:
             raise ValueError(f'need slots >= 1 and t_max >= 2, got '
                              f'{slots}/{t_max}')
+        self.decode_impl = _resolve_decode_impl(decode_impl)
         self.slots = slots
         self.t_max = t_max
         self.vocab = vocab
@@ -97,8 +129,11 @@ class KernelEngine:
 
     def _decode_impl(self, cache, tokens, active, poison):
         q, k, v = self._project(tokens)
-        cache = append_kv_slots(cache, k, v, slot_mask=active)
-        out = decode_attention(q, cache)                   # (S, H, 1, D)
+        # Fused append+attend (one Pallas program on the kernel path —
+        # the cache buffers are aliased in place and, with the jit
+        # donation above, never copied).
+        cache, out = decode_step(q, cache, k, v, slot_mask=active,
+                                 impl=self.decode_impl)    # (S, H, 1, D)
         logits = out.reshape(self.slots, -1) @ self._wo    # (S, vocab)
         logits = jnp.where(poison[:, None], jnp.nan, logits)
         finite = slots_all_finite(logits)

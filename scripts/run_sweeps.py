@@ -173,17 +173,45 @@ SWEEPS = [
       for h in (12, 6, 3)
       for tag, tlen in (('16k', '16384'), ('75k', '75000'))],
     # --- round-5: chained decode (tokens per dispatch amortize the
-    # per-dispatch floor) + batched serving — the GQA-wins records ---
+    # per-dispatch floor) + batched serving — the GQA-wins records.
+    # Pinned to the XLA step now that --decode-impl exists, so these
+    # rows keep measuring what round 5 measured (the baseline the
+    # kernel rows below are judged against). ---
     *[(f'decode_benchmark_128k{suff}_chain{kv}',
        ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
-        '--heads', '8', '--head-dim', '96', '--decode-chain', '32']
+        '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
+        '--decode-impl', 'xla']
        + extra + kvx)
       for suff, extra in (('', []), ('_b8', ['--batch', '8']))
       for kv, kvx in (('', []), ('_kv2', ['--kv-heads', '2']))],
     ('decode_benchmark_128k_chain_kv2_int8',
      ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
       '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
-      '--kv-heads', '2', '--qk-quant', 'int8']),
+      '--kv-heads', '2', '--qk-quant', 'int8', '--decode-impl', 'xla']),
+    # --- round-6: the fused Pallas decode kernel vs those baselines —
+    # same shapes, same chained methodology, only the decode path
+    # differs. The B=8 full-head pair is the acceptance benchmark
+    # (kernel must land ≥1.5× under the 10.34 ms/step XLA row, near
+    # the 4.25+0.9 ms component floor); the int8 pair is the mirror
+    # regression (kernel int8 must be ≤ bf16, where XLA's s8 lowering
+    # lost). TTFT rows ride every decode record now. ---
+    *[(f'decode_benchmark_128k{suff}_chain{kv}_kernel',
+       ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
+        '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
+        '--decode-impl', 'kernel']
+       + extra + kvx)
+      for suff, extra in (('', []), ('_b8', ['--batch', '8']))
+      for kv, kvx in (('', []), ('_kv2', ['--kv-heads', '2']))],
+    ('decode_benchmark_128k_chain_kv2_int8_kernel',
+     ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
+      '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
+      '--kv-heads', '2', '--qk-quant', 'int8',
+      '--decode-impl', 'kernel']),
+    # --- round-6: scheduler-vs-bare on both decode paths ---
+    *[(f'decode_serve_{impl}',
+       ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
+        '--serve-requests', '32', '--decode-impl', impl])
+      for impl in ('xla', 'kernel')],
     # --- round-5: LM capstone training (embed → scanned+remat stack →
     # tied head → chunked cross-entropy, one SPMD program) ---
     ('lm_32k',

@@ -25,6 +25,13 @@ export JAX_PLATFORMS=cpu
 export PYTHONUNBUFFERED=1
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 
+# Run the engine on the FUSED Pallas decode path (interpreted on the
+# CPU mesh — the same program the TPU compiles): the fault cocktail
+# below must hold on the kernel path too — NaN-slot quarantine and
+# eviction churn over the in-place aliased cache, not just the XLA
+# step. DDP_TPU_DECODE_KERNEL=0 re-runs the same soak on the XLA path.
+export DDP_TPU_DECODE_KERNEL="${DDP_TPU_DECODE_KERNEL:-1}"
+
 # The fault cocktail from the soak acceptance bar: a burst that
 # overflows the queue (requests >> slots+queue), one stuck decode step
 # long enough to trip the 0.25 s watchdog, one NaN slot.

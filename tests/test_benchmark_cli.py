@@ -24,8 +24,13 @@ pytestmark = pytest.mark.slow
 
 def _run(tmp_path, name, *bench_args):
     out = tmp_path / f'{name}.json'
+    # Strip backend pins AND the serving knobs (DDP_TPU_DECODE_KERNEL /
+    # DDP_TPU_FAULT_*): the decode-impl assertions below test the
+    # benchmark's own resolution, and an inherited fault plan would
+    # inject faults into the benchmarked scheduler.
     env = {k: v for k, v in os.environ.items()
-           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS', 'PALLAS_AXON_POOL_IPS')}
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS', 'PALLAS_AXON_POOL_IPS')
+           and not k.startswith('DDP_TPU_')}
     env['JAX_PLATFORMS'] = 'cpu'
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
     env['PYTHONPATH'] = _REPO + os.pathsep + env.get('PYTHONPATH', '')
@@ -100,13 +105,44 @@ def test_train_mode(tmp_path):
 
 def test_decode_serve_mode(tmp_path):
     """The serving microbenchmark: scheduler vs bare decode loop on the
-    same engine shape, both rates recorded."""
+    same engine shape, both rates recorded, plus the decode path and
+    the engine-surface TTFT row."""
     rec = _run(tmp_path, 'dserve', '--mode', 'decode-serve',
                '--seq-len', '48', '--serve-requests', '4')
     assert rec['mode'] == 'decode-serve'
     assert rec['completed'] == 4
     assert rec['bare_tokens_per_s'] > 0
     assert rec['sched_tokens_per_s'] > 0
+    assert rec['decode_impl'] == 'xla'        # auto resolves off-TPU
+    assert rec['ttft_ms'] > 0
+
+
+def test_decode_serve_mode_kernel_path(tmp_path):
+    """--decode-impl kernel routes the engine through the fused Pallas
+    step (interpreted on CPU) and records it."""
+    rec = _run(tmp_path, 'dserve_k', '--mode', 'decode-serve',
+               '--seq-len', '48', '--serve-requests', '4',
+               '--decode-impl', 'kernel')
+    assert rec['decode_impl'] == 'kernel'
+    assert rec['completed'] == 4
+    assert rec['sched_tokens_per_s'] > 0
+
+
+def test_decode_mode_kernel_vs_xla_rows(tmp_path):
+    """--mode decode grows kernel-vs-XLA rows: one invocation per path,
+    each recording its decode_impl and the TTFT/prefill columns."""
+    rec_x = _run(tmp_path, 'dec_x', '--mode', 'decode', '--seq-len',
+                 '128', '--heads', '2', '--head-dim', '8',
+                 '--decode-impl', 'xla', '--decode-chain', '2')
+    rec_k = _run(tmp_path, 'dec_k', '--mode', 'decode', '--seq-len',
+                 '128', '--heads', '2', '--head-dim', '8',
+                 '--decode-impl', 'kernel')
+    for rec in (rec_x, rec_k):
+        assert rec['mode'] == 'decode'
+        assert rec['ms_per_step'] > 0
+        assert rec['ttft_ms'] > rec['prefill_ms'] > 0
+    assert rec_x['decode_impl'] == 'xla'
+    assert rec_k['decode_impl'] == 'kernel'
 
 
 def test_train_mode_window(tmp_path):

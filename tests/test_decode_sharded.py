@@ -190,6 +190,73 @@ def test_decode_seq_parallel_caches_compiled_step(mesh):
     assert int(cache.length) == 3
 
 
+def test_module_decode_sharded_kernel_matches_local(mesh):
+    """decode_sharded on the fused Pallas kernel path (decode_impl):
+    each shard runs the kernel over its slab, the owner appends in
+    place, and the pmax/psum merge reproduces the local XLA decode."""
+    dim = 32
+    kw = dict(key_dim=dim, num_heads=4, num_kv_heads=2, causal=True,
+              use_rope=True)
+    local_model = DistributedDotProductAttn(decode_impl='xla', **kw)
+    kernel_model = DistributedDotProductAttn(decode_impl='kernel', **kw)
+    x = jax.random.normal(jax.random.key(3), (B, 8, dim), jnp.float32)
+    params = local_model.init(jax.random.key(1), x, x, x, None)
+    local_cache = local_model.make_decode_cache(B, T_MAX)
+    shard_cache = kernel_model.make_decode_cache(B, T_MAX)
+    for t in range(5):
+        xt = x[:, t:t + 1]
+        local_cache, lout = local_model.apply(
+            params, xt, xt, xt, local_cache, method='decode')
+        shard_cache, sout = decode_seq_parallel(
+            kernel_model, params, mesh, xt, xt, xt, shard_cache)
+        np.testing.assert_allclose(np.asarray(sout), np.asarray(lout),
+                                   atol=2e-5, rtol=1e-5, err_msg=f't={t}')
+    assert int(shard_cache.length) == 5
+    # The sharded slabs, concatenated, hold the local buffers.
+    np.testing.assert_allclose(np.asarray(shard_cache.k),
+                               np.asarray(local_cache.k), atol=2e-6)
+
+
+def test_decode_steps_cache_is_bounded(mesh, monkeypatch):
+    """The compiled-step cache evicts LRU past its cap instead of
+    growing for every (module, mesh, axis) a long-lived host cycles
+    through."""
+    from distributed_dot_product_tpu.models import attention as attn_mod
+    monkeypatch.setattr(attn_mod, '_DECODE_STEPS_CAP', 2)
+    attn_mod._DECODE_STEPS.clear()
+    x = jnp.ones((1, 4, 16), jnp.float32)
+    for offset in (4, 8, 16):        # three distinct hashable modules
+        model = DistributedDotProductAttn(key_dim=16, num_heads=2,
+                                          causal=True, offset=offset)
+        params = model.init(jax.random.key(0), x, x, x, None)
+        cache = model.make_decode_cache(1, 8)
+        xt = x[:, :1]
+        decode_seq_parallel(model, params, mesh, xt, xt, xt, cache)
+    assert len(attn_mod._DECODE_STEPS) <= 2
+
+
+def test_decode_seq_parallel_warns_once_on_unhashable(mesh):
+    """An unhashable module (array-valued field) silently re-traced the
+    whole step EVERY token; now it warns — once."""
+    import warnings as _warnings
+
+    from distributed_dot_product_tpu.models import attention as attn_mod
+    model = DistributedDotProductAttn(
+        key_dim=16, num_heads=2, causal=True, softmax_impl='flash',
+        alibi_slopes=jnp.asarray([0.5, 0.25]))     # unhashable field
+    x = jnp.ones((1, 4, 16), jnp.float32)
+    params = model.init(jax.random.key(0), x, x, x, None)
+    cache = model.make_decode_cache(1, 8)
+    xt = x[:, :1]
+    attn_mod._WARNED_UNHASHABLE = False
+    with pytest.warns(UserWarning, match='unhashable'):
+        cache, _ = decode_seq_parallel(model, params, mesh, xt, xt, xt,
+                                       cache)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter('error')            # a repeat would raise
+        decode_seq_parallel(model, params, mesh, xt, xt, xt, cache)
+
+
 def test_sharded_overflow_advances_length_without_write(mesh):
     """Appending past the GLOBAL capacity writes nowhere; length still
     flags it (the append_kv overflow contract, sharded)."""

@@ -135,6 +135,25 @@ def test_greedy_generate_validates_steps():
         greedy_generate(m, params, toks, steps=8, t_max=8)
 
 
+def test_greedy_generate_exact_capacity_boundary():
+    """Prefill writes n rows and the loop writes steps − 1 more (the
+    first token comes from the prefill logits), so n + steps − 1 ==
+    t_max must GENERATE — the earlier check rejected it off by one —
+    while one more step must raise."""
+    from distributed_dot_product_tpu import greedy_generate
+    m = _model(attn_kwargs=dict(distributed=False))
+    toks = jnp.zeros((1, 4), jnp.int32)
+    params = m.init(jax.random.key(0), toks)
+    out = greedy_generate(m, params, toks, steps=5, t_max=8)  # 4+5-1=8
+    assert out.shape == (1, 5)
+    with pytest.raises(ValueError, match='t_max'):
+        greedy_generate(m, params, toks, steps=6, t_max=8)
+    # The boundary run used every cache row and the capacity-checked
+    # stream equals a roomier run's prefix (no silent tail corruption).
+    roomy = greedy_generate(m, params, toks, steps=5, t_max=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(roomy))
+
+
 def test_lm_dropout_requires_seed():
     mesh = seq_mesh(8)
     m = _model(attn_kwargs=dict(dropout_rate=0.1))

@@ -1,0 +1,283 @@
+# -*- coding: utf-8 -*-
+"""
+Fused Pallas decode kernel (ops/pallas_decode.py) — parity and alias
+safety. The oracle is the existing XLA formulation (``append_kv*`` +
+``decode_attention``), pinned bit-for-tolerance across batch, heads,
+GQA, int8, per-slot lengths, window and ALiBi; the alias tests pin the
+in-place contract — ONE cache block written per step, every other bit
+untouched, and nothing stale after an eviction. On the CPU mesh the
+kernel runs under the Pallas interpreter (the same code path the TPU
+compiles), exactly like the training-kernel suites.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.models.decode import (
+    append_kv, append_kv_slots, decode_kernel_eligible, decode_step,
+    init_cache, init_slot_cache, reset_slot,
+)
+from distributed_dot_product_tpu.ops.pallas_decode import decode_block_k
+
+B, D, T = 3, 8, 16
+LENS = [5, 9, 0]        # staggered slot fills, incl. an empty slot
+
+
+def _operands(h, h_kv, key=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(key), 5)
+    q = jax.random.normal(ks[0], (B, h, 1, D), dtype)
+    kn = jax.random.normal(ks[1], (B, h_kv, 1, D), dtype)
+    vn = jax.random.normal(ks[2], (B, h_kv, 1, D), dtype)
+    kf = jax.random.normal(ks[3], (B, h_kv, T, D), dtype)
+    vf = jax.random.normal(ks[4], (B, h_kv, T, D), dtype)
+    return q, kn, vn, kf, vf
+
+
+def _filled(h_kv, kf, vf, lens=LENS, dtype=jnp.float32):
+    cache = init_slot_cache(B, h_kv, T, D, dtype=dtype)
+    return append_kv_slots(cache, kf, vf,
+                           counts=jnp.asarray(lens, jnp.int32))
+
+
+def _both(q, cache_fn, kn, vn, **kw):
+    cx, ox = decode_step(q, cache_fn(), kn, vn, impl='xla', **kw)
+    ck, ok = decode_step(q, cache_fn(), kn, vn, impl='kernel', **kw)
+    return (cx, ox), (ck, ok)
+
+
+def _assert_cache_match(ck, cx):
+    np.testing.assert_array_equal(np.asarray(ck.length),
+                                  np.asarray(cx.length))
+    for name in ('k', 'v', 'k_q', 'k_scale'):
+        a, b = getattr(ck, name), getattr(cx, name)
+        assert (a is None) == (b is None), name
+        if a is not None:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize('h,h_kv', [(2, 2), (4, 2), (4, 1)])
+@pytest.mark.parametrize('kw', [{}, {'window': 4}])
+def test_kernel_matches_xla_per_slot(h, h_kv, kw):
+    """Per-slot staggered lengths (incl. an empty slot), MHA/GQA/MQA,
+    with and without a sliding window."""
+    q, kn, vn, kf, vf = _operands(h, h_kv)
+    (cx, ox), (ck, ok) = _both(q, lambda: _filled(h_kv, kf, vf),
+                               kn, vn, **kw)
+    _assert_cache_match(ck, cx)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(ox),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_matches_xla_alibi():
+    h = 4
+    q, kn, vn, kf, vf = _operands(h, 2, key=1)
+    slopes = jnp.asarray([2.0 ** -(i + 1) for i in range(h)])
+    (cx, ox), (ck, ok) = _both(q, lambda: _filled(2, kf, vf), kn, vn,
+                               alibi_slopes=slopes)
+    _assert_cache_match(ck, cx)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(ox),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_matches_xla_slot_mask():
+    """Frozen slots append nothing and attend their un-advanced prefix;
+    the kernel and XLA steps agree on buffers, lengths AND outputs."""
+    q, kn, vn, kf, vf = _operands(2, 2, key=2)
+    mask = jnp.asarray([True, False, True])
+    (cx, ox), (ck, ok) = _both(q, lambda: _filled(2, kf, vf), kn, vn,
+                               slot_mask=mask)
+    _assert_cache_match(ck, cx)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(ox),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_matches_xla_scalar_cache_bf16():
+    """Scalar-length cache (one clock for the whole batch), bf16
+    buffers — the greedy-generation configuration."""
+    q, kn, vn, kf, vf = _operands(2, 2, key=3, dtype=jnp.bfloat16)
+
+    def cache_fn():
+        c = init_cache(B, 2, T, D, dtype=jnp.bfloat16)
+        return append_kv(c, kf[:, :, :6], vf[:, :, :6])
+
+    (cx, ox), (ck, ok) = _both(q, cache_fn, kn, vn)
+    _assert_cache_match(ck, cx)
+    np.testing.assert_allclose(np.asarray(ok, dtype=np.float32),
+                               np.asarray(ox, dtype=np.float32),
+                               atol=3e-2, rtol=3e-2)
+    assert int(ck.length) == 7
+
+
+def test_kernel_matches_xla_int8_mirror():
+    """int8-trained decode through the append-time K mirror: the kernel
+    dequantizes in-place-streamed int8 blocks and must reproduce the
+    XLA mirror path's logits — and maintain the mirror bit-identically
+    (rows quantize once, at append)."""
+    q, kn, vn, kf, vf = _operands(4, 2, key=4)
+
+    def cache_fn():
+        c = init_cache(B, 2, T, D, dtype=jnp.float32, qk_quant='int8')
+        return append_kv(c, kf[:, :, :9], vf[:, :, :9])
+
+    (cx, ox), (ck, ok) = _both(q, cache_fn, kn, vn, qk_quant='int8')
+    np.testing.assert_array_equal(np.asarray(ck.k_q),
+                                  np.asarray(cx.k_q))
+    np.testing.assert_allclose(np.asarray(ck.k_scale),
+                               np.asarray(cx.k_scale), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(ox),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_first_token_empty_cache():
+    """Length-0 slots appending their first row attend exactly that row
+    — out = v_new per head group, no NaN from the empty prefix."""
+    q, kn, vn, _, _ = _operands(4, 2, key=5)
+    cache = init_slot_cache(B, 2, T, D, dtype=jnp.float32)
+    ck, ok = decode_step(q, cache, kn, vn, impl='kernel')
+    want = jnp.repeat(vn, 2, axis=1)        # softmax over one column
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(want),
+                               atol=1e-6)
+    assert [int(x) for x in ck.length] == [1, 1, 1]
+
+
+def test_kernel_alias_in_place_and_surgical():
+    """The in-place append contract: exactly one row changes per slot —
+    every other bit of every buffer is IDENTICAL before/after."""
+    q, kn, vn, kf, vf = _operands(2, 2, key=6)
+    before = _filled(2, kf, vf)
+    ck, _ = decode_step(q, before, kn, vn, impl='kernel')
+    bk, bv = np.asarray(before.k), np.asarray(before.v)
+    ak, av = np.asarray(ck.k), np.asarray(ck.v)
+    for i, ln in enumerate(LENS):
+        np.testing.assert_array_equal(ak[i, :, :ln], bk[i, :, :ln])
+        np.testing.assert_array_equal(ak[i, :, ln + 1:],
+                                      bk[i, :, ln + 1:])
+        np.testing.assert_array_equal(ak[i, :, ln],
+                                      np.asarray(kn)[i, :, 0])
+        np.testing.assert_array_equal(av[i, :, ln],
+                                      np.asarray(vn)[i, :, 0])
+
+
+def test_kernel_not_stale_after_eviction():
+    """Evict a filled slot (reset_slot), serve a fresh sequence through
+    fused steps: the attention must see ONLY the new rows (a stale
+    block would poison the new stream bit-visibly)."""
+    q, kn, vn, kf, vf = _operands(2, 2, key=7)
+    cache = _filled(2, kf, vf, lens=[12, 3, 7])
+    cache = reset_slot(cache, 0)
+    only0 = jnp.asarray([True, False, False])
+    # Two fused steps land rows 0 and 1 of the fresh sequence.
+    cache, _ = decode_step(q, cache, kn, vn, slot_mask=only0,
+                           impl='kernel')
+    cache, out = decode_step(q, cache, kn + 1.0, vn + 1.0,
+                             slot_mask=only0, impl='kernel')
+    # Oracle: the same two rows alone in a fresh single-slot cache.
+    solo = init_slot_cache(1, 2, T, D, dtype=jnp.float32)
+    solo, _ = decode_step(q[:1], solo, kn[:1], vn[:1], impl='xla')
+    solo, want = decode_step(q[:1], solo, kn[:1] + 1.0, vn[:1] + 1.0,
+                             impl='xla')
+    np.testing.assert_allclose(np.asarray(out[:1]), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert [int(x) for x in cache.length] == [2, 3, 7]
+    # The evicted slot's tail is still zero — nothing stale survived.
+    assert float(jnp.abs(cache.k[0, :, 2:]).sum()) == 0.0
+
+
+def test_kernel_overflow_contract():
+    """Traced overflow: the fused step writes NOTHING for a full slot
+    while its length still advances (append_kv_slots' contract);
+    concrete overflow raises eagerly naming the slot."""
+    cache = init_slot_cache(2, 2, 4, D, dtype=jnp.float32)
+    cache = cache._replace(length=jnp.asarray([4, 1], jnp.int32))
+    q = jnp.ones((2, 2, 1, D))
+    one = jnp.ones((2, 2, 1, D))
+    with pytest.raises(ValueError, match='slot 0'):
+        decode_step(q, cache, one, one, impl='kernel')
+    out_c, _ = jax.jit(
+        lambda c, q, k, v: decode_step(q, c, k, v, impl='kernel')
+    )(cache, q, one, one)
+    assert [int(x) for x in out_c.length] == [5, 2]
+    assert float(jnp.abs(out_c.k[0]).sum()) == 0.0       # wrote nothing
+    assert float(jnp.abs(out_c.k[1]).sum()) > 0.0        # in-bounds did
+
+
+def test_kernel_eligibility_and_fallback():
+    """The kernel covers the serving hot path; everything else resolves
+    to the XLA step under 'auto' and refuses under 'kernel'."""
+    assert decode_block_k(16) == 16
+    assert decode_block_k(131072) == 1024
+    assert decode_block_k(3 * 1024) == 1024
+    assert decode_block_k(1027) is None              # prime > cap
+    cache = init_slot_cache(B, 2, T, D, dtype=jnp.float32)
+    assert decode_kernel_eligible(cache)
+    assert not decode_kernel_eligible(cache, n=2)
+    assert not decode_kernel_eligible(cache, segment_ids=jnp.zeros(
+        (B, T), jnp.int32))
+    assert not decode_kernel_eligible(cache, qk_quant='int8')  # no mirror
+    q, kn, vn, kf, vf = _operands(2, 2, key=8)
+    seg = jnp.zeros((B, T), jnp.int32)
+    seg_q = jnp.zeros((B, 1), jnp.int32)
+    with pytest.raises(ValueError, match='fused kernel'):
+        decode_step(q, _filled(2, kf, vf), kn, vn, impl='kernel',
+                    segment_ids=seg, seg_q=seg_q)
+    # auto + segments: falls back, matches the explicit XLA step.
+    ca, oa = decode_step(q, _filled(2, kf, vf), kn, vn, impl='auto',
+                         segment_ids=seg, seg_q=seg_q)
+    cx, ox = decode_step(q, _filled(2, kf, vf), kn, vn, impl='xla',
+                         segment_ids=seg, seg_q=seg_q)
+    np.testing.assert_array_equal(np.asarray(oa), np.asarray(ox))
+
+
+def test_module_decode_kernel_matches_xla():
+    """Module surface: projections + GQA + RoPE + fused kernel step ==
+    the XLA step, token by token (decode_impl is the only delta)."""
+    from distributed_dot_product_tpu import DistributedDotProductAttn
+    dim = 32
+    kw = dict(key_dim=dim, num_heads=4, num_kv_heads=2, causal=True,
+              use_rope=True, distributed=False)
+    mx = DistributedDotProductAttn(decode_impl='xla', **kw)
+    mk = DistributedDotProductAttn(decode_impl='kernel', **kw)
+    x = jax.random.normal(jax.random.key(0), (2, 8, dim), jnp.float32)
+    params = mx.init(jax.random.key(1), x, x, x, None)
+    cx = mx.make_decode_cache(2, 8)
+    ck = mk.make_decode_cache(2, 8)
+    for t in range(4):
+        xt = x[:, t:t + 1]
+        cx, ox = mx.apply(params, xt, xt, xt, cx, method='decode')
+        ck, ok = mk.apply(params, xt, xt, xt, ck, method='decode')
+        np.testing.assert_allclose(np.asarray(ok), np.asarray(ox),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f't={t}')
+    np.testing.assert_allclose(np.asarray(ck.k), np.asarray(cx.k),
+                               atol=1e-6)
+
+
+def test_engine_kernel_path_streams():
+    """KernelEngine on the fused kernel path: same slot lifecycle and
+    (to greedy-argmax stability at these magnitudes) the same token
+    streams as the XLA path."""
+    from distributed_dot_product_tpu.serve import KernelEngine
+
+    def drive(impl):
+        eng = KernelEngine(slots=3, t_max=32, vocab=16, heads=2,
+                           head_dim=4, prefill_chunk=4, seed=5,
+                           decode_impl=impl)
+        eng.prefill(0, [1, 2, 3])
+        eng.prefill(1, [4, 5])
+        toks = np.array([3, 5, 0], np.int32)
+        act = np.array([True, True, False])
+        stream = []
+        for _ in range(6):
+            toks, fin = eng.step(toks, act)
+            assert fin.all()
+            stream.append(toks.copy())
+        return eng.lengths(), stream
+
+    lens_x, stream_x = drive('xla')
+    lens_k, stream_k = drive('kernel')
+    np.testing.assert_array_equal(lens_k, lens_x)
+    for a, b in zip(stream_x, stream_k):
+        np.testing.assert_array_equal(a, b)

@@ -36,10 +36,11 @@ def _burst(n, seed):
 
 
 def _run_soak(n_requests, injector, *, seed=11, queue_limit=4,
-              max_new=4, stall_timeout=0.15):
+              max_new=4, stall_timeout=0.15, decode_impl='xla'):
     sched = Scheduler(
         KernelEngine(slots=SLOTS, t_max=T_MAX, vocab=VOCAB, heads=2,
-                     head_dim=4, prefill_chunk=4, seed=5),
+                     head_dim=4, prefill_chunk=4, seed=5,
+                     decode_impl=decode_impl),
         ServeConfig(queue_limit=queue_limit, max_new_tokens=max_new,
                     stall_timeout=stall_timeout, watchdog_poll=0.02,
                     evict_before_reject=False),
@@ -77,14 +78,19 @@ def _audit(n_requests, sched, rejected, results, seed=11):
                                       Readiness.STOPPED)
 
 
-def test_burst_soak_with_fault_cocktail():
+@pytest.mark.parametrize('decode_impl', ['xla', 'kernel'])
+def test_burst_soak_with_fault_cocktail(decode_impl):
     """Stuck step + NaN slot + overflow burst, against a clean
-    reference run of the same seeded traffic."""
+    reference run of the same seeded traffic — on BOTH decode paths
+    (the fused Pallas kernel runs interpreted on the CPU mesh; its
+    in-place aliased cache must survive the quarantine/evict/requeue
+    churn exactly like the XLA step's)."""
     n = 14
-    _, rej0, clean = _run_soak(n, None)
+    _, rej0, clean = _run_soak(n, None, decode_impl=decode_impl)
     plan = ServeFaultPlan(stuck_at_step=3, stuck_seconds=0.5,
                           nan_at_step=5, nan_slot=1)
-    sched, rejected, results = _run_soak(n, ServeFaultInjector(plan))
+    sched, rejected, results = _run_soak(n, ServeFaultInjector(plan),
+                                         decode_impl=decode_impl)
     _audit(n, sched, rejected, results)
     counters = sched.registry.snapshot()['counters']
     assert sched.health.stall_events >= 1, 'stuck step undetected'
