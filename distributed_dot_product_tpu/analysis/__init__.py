@@ -1,0 +1,84 @@
+# -*- coding: utf-8 -*-
+"""
+``graphlint`` — static analysis that mechanically enforces the repo's
+performance and correctness contracts.
+
+Three engines (see ISSUE/README "Static analysis"):
+
+- **Jaxpr linter** (:mod:`.jaxpr_rules` over :mod:`.registry`): traces
+  every registered entrypoint at example abstract shapes and walks the
+  ClosedJaxpr — fp32 accumulation on low-precision dots, surgical
+  (aliased) KV-cache writes + real donation, no cache-shaped upcasts,
+  collectives only on declared mesh axes.
+- **Retrace sentinel** (:mod:`.retrace`): runtime trace-count budgets
+  on jitted decode/serve entrypoints; on by default under pytest.
+- **AST ruleset** (:mod:`.astlint`): pure-``ast`` hazard patterns —
+  host pulls of traced values and traced-bool branching in hot paths,
+  clock reads inside jit, silent broad excepts.
+
+CLI: ``python -m distributed_dot_product_tpu.analysis`` (exit 0 = no
+violations). The tier-1 gate test (tests/test_graphlint.py) asserts a
+clean tree, so a contract break fails CI before it ships.
+
+This ``__init__`` stays import-light (no jax): serving code imports
+:mod:`.retrace` at build time, and pulling the whole linter (which
+imports every layer) along with it would be an import cycle.
+"""
+
+from distributed_dot_product_tpu.analysis.base import (     # noqa: F401
+    RULES, Violation, format_violations,
+)
+from distributed_dot_product_tpu.analysis.retrace import (  # noqa: F401
+    RetraceBudgetExceeded, watch_traces,
+)
+
+__all__ = ['RULES', 'Violation', 'format_violations', 'watch_traces',
+           'RetraceBudgetExceeded', 'run_analysis']
+
+
+def run_analysis(paths=None, rules=None, repo_root=None,
+                 jaxpr=True, ast_rules=True, entrypoints=None):
+    """Run the full analyzer; returns a list of
+    :class:`~distributed_dot_product_tpu.analysis.base.Violation`.
+
+    ``paths``: files/dirs for the AST pass (default: the installed
+    package plus ``scripts/`` and ``tests/`` when resolvable).
+    ``rules``: restrict to these rule ids (default: all).
+    ``entrypoints``: a ``{name: builder}`` mapping for the jaxpr pass
+    (default: the central registry).
+    """
+    import os
+    violations = []
+    if ast_rules:
+        from distributed_dot_product_tpu.analysis import astlint
+        if paths is None:
+            pkg = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            root = os.path.dirname(pkg)
+            paths = [pkg]
+            for extra in ('scripts', 'tests'):
+                p = os.path.join(root, extra)
+                if os.path.isdir(p):
+                    paths.append(p)
+            repo_root = repo_root or root
+        # 'parse-error' is emitted by the AST pass (unconditionally, on
+        # unparseable files) — requesting it must run that pass.
+        ast_rule_set = None if rules is None else \
+            [r for r in rules
+             if r in astlint.AST_RULES or r == 'parse-error']
+        if ast_rule_set is None or ast_rule_set:
+            violations.extend(astlint.lint_paths(
+                paths, repo_root=repo_root, rules=ast_rule_set))
+    if jaxpr:
+        from distributed_dot_product_tpu.analysis import jaxpr_rules
+        jaxpr_rule_set = None if rules is None else \
+            [r for r in rules if r in jaxpr_rules.JAXPR_RULES]
+        if jaxpr_rule_set is None or jaxpr_rule_set:
+            if entrypoints is None:
+                from distributed_dot_product_tpu.analysis.registry import (
+                    default_entrypoints,
+                )
+                entrypoints = default_entrypoints()
+            violations.extend(jaxpr_rules.lint_entrypoints(
+                entrypoints, rules=jaxpr_rule_set))
+    return violations

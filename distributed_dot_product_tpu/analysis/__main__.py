@@ -1,0 +1,99 @@
+# -*- coding: utf-8 -*-
+"""
+``python -m distributed_dot_product_tpu.analysis`` — the graphlint CLI.
+
+Exit status: 0 when clean, 1 when any violation (each rendered as
+``file:line: rule [entrypoint]: message``), 2 on usage errors.
+
+The jaxpr pass traces on a forced 8-virtual-device CPU platform
+(tracing needs devices for meshes but never executes), so the CLI is
+hermetic: same result on a TPU host, a CI runner, or a laptop.
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    from distributed_dot_product_tpu.analysis.base import (
+        RULES, format_violations,
+    )
+    parser = argparse.ArgumentParser(
+        prog='python -m distributed_dot_product_tpu.analysis',
+        description='graphlint: jaxpr/AST static analysis enforcing '
+                    'the repo\'s perf and correctness contracts')
+    parser.add_argument('paths', nargs='*',
+                        help='files/dirs for the AST pass (default: '
+                             'the package + scripts/ + tests/)')
+    parser.add_argument('--rule', action='append', dest='rules',
+                        metavar='ID', choices=sorted(RULES),
+                        help='run only this rule (repeatable)')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text')
+    parser.add_argument('--no-jaxpr', action='store_true',
+                        help='skip the (slower) jaxpr/registry pass')
+    parser.add_argument('--no-ast', action='store_true',
+                        help='skip the AST pass')
+    parser.add_argument('--registry', metavar='MODULE:ATTR',
+                        help='lint this {name: builder} mapping instead '
+                             'of the central registry (the negative-'
+                             'fixture tests drive the CLI through '
+                             'seeded regressions this way)')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule catalog and exit')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f'{rid}:\n    {RULES[rid]}')
+        return 0
+
+    if args.rules:
+        from distributed_dot_product_tpu.analysis.astlint import AST_RULES
+        from distributed_dot_product_tpu.analysis.jaxpr_rules import (
+            JAXPR_RULES,
+        )
+        static = set(AST_RULES) | set(JAXPR_RULES) | {'parse-error'}
+        runtime_only = [r for r in args.rules if r not in static]
+        if runtime_only:
+            parser.error(
+                f'{", ".join(runtime_only)}: enforced at RUNTIME by the '
+                f'retrace sentinel (analysis/retrace.py; on under '
+                f'pytest), not statically — there is nothing for this '
+                f'command to check')
+
+    if not args.no_jaxpr:
+        # Force the hermetic 8-device CPU platform BEFORE jax commits
+        # to a backend (tracing needs mesh devices, never execution).
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        from distributed_dot_product_tpu._compat import (
+            ensure_cpu_devices,
+        )
+        ensure_cpu_devices(8)
+
+    entrypoints = None
+    if args.registry:
+        import importlib
+        modpath, _, attr = args.registry.partition(':')
+        if not attr:
+            parser.error('--registry takes MODULE:ATTR')
+        entrypoints = getattr(importlib.import_module(modpath), attr)
+        if callable(entrypoints):
+            entrypoints = entrypoints()
+
+    from distributed_dot_product_tpu.analysis import run_analysis
+    violations = run_analysis(
+        paths=args.paths or None, rules=args.rules,
+        jaxpr=not args.no_jaxpr, ast_rules=not args.no_ast,
+        entrypoints=entrypoints)
+    print(format_violations(violations, fmt=args.format))
+    return 1 if violations else 0
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # `... | head` closed the pipe: not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,130 @@
+# -*- coding: utf-8 -*-
+"""
+Shared vocabulary of the analysis subsystem: the :class:`Violation`
+record every engine emits, the rule catalog (id → what the rule guards
+and which PR's contract it encodes), and the suppression pragma.
+
+A violation is always anchored: ``file:line`` for AST rules, the
+registered entrypoint name (plus the traced source line when jaxpr
+equation metadata carries one) for jaxpr rules. The CLI and the tier-1
+gate test both render these records, so an analyzer finding is
+actionable from its one-line form.
+
+Suppression: a trailing ``# graphlint: allow[<rule-id>]`` comment on
+the offending line (or the line directly above) waives that rule for
+that site — deliberate exceptions stay visible and greppable in the
+source instead of accumulating in a config file.
+"""
+
+import dataclasses
+import re
+from typing import Optional
+
+__all__ = ['Violation', 'RULES', 'allowed_by_pragma', 'format_violations']
+
+# Rule catalog. Jaxpr rules (J*) trace registered entrypoints and walk
+# the ClosedJaxpr; AST rules (A*) parse source; R* is enforced at
+# runtime by the retrace sentinel (analysis/retrace.py) under pytest.
+RULES = {
+    'f32-accum': (
+        'every dot_general on low-precision (bf16/f16/int8) operands '
+        'must request a wide accumulator via preferred_element_type '
+        '(f32, or i32 for int8) — encodes the fp32-accumulation '
+        'contract of the matmul-heavy paths (PR 3: LM head; the Pallas '
+        'kernels carry it throughout)'),
+    'donation': (
+        'entrypoints declared as donating (KV-cache serving steps) '
+        'must actually alias their donated buffers in the lowered '
+        'module — without donation every token copies the full cache '
+        '(PR 3: in-place KV-cache aliasing)'),
+    'cache-alias': (
+        'cache buffers must flow input→output through surgical writes '
+        'only (dynamic_update_slice / masked select / kernel '
+        'input_output_aliases); a full-shape copy or re-materialization '
+        'degrades the in-place append into a per-token cache copy '
+        '(PR 3: aliased append contract)'),
+    'cache-upcast': (
+        'no convert_element_type may widen a cache-shaped tensor: '
+        'upcasting the KV buffer (e.g. bf16→f32 before a matmul) '
+        'materializes a full-size copy every step — request the wide '
+        'accumulator on the dot instead (PR 3: cache streaming '
+        'contract)'),
+    'collective-axis': (
+        'collectives inside shard_map must name axes that exist on the '
+        "entrypoint's declared mesh — a stray axis name means the "
+        'program is being built against the wrong topology (PR 0/2: '
+        'mesh discipline)'),
+    'trace-error': (
+        'a registered entrypoint failed to trace at its declared '
+        'example shapes — the registration or the entrypoint itself '
+        'regressed'),
+    'host-pull': (
+        'float()/int()/bool()/np.asarray()/.item() on a value produced '
+        'by jnp/lax in ops/ or models/ hot paths forces a device '
+        'readback (or a tracer error) mid-graph'),
+    'traced-bool-branch': (
+        'python `if`/`while` on a traced predicate (jnp.any/all/'
+        'isfinite/...) in ops/ or models/ either crashes under jit or '
+        'silently fixes the branch at trace time — use lax.cond/'
+        'jnp.where'),
+    'clock-in-jit': (
+        'time.time()/perf_counter()/monotonic() inside a jitted '
+        'function reads the clock at TRACE time and bakes the constant '
+        'into the program (PR 2: the health watchdog reads real time '
+        'outside compiled code for exactly this reason)'),
+    'parse-error': (
+        'a scanned file does not parse as python — reported regardless '
+        'of any --rule filter (a broken file can hide any violation)'),
+    'silent-except': (
+        'a broad except (bare / Exception / BaseException) that '
+        'neither re-raises nor logs swallows real failures — log '
+        'through utils.tracing.log_exception or narrow the type '
+        '(PR 1/2: fault paths must stay observable)'),
+    'retrace-budget': (
+        'runtime rule (analysis/retrace.py): a watched decode/serve '
+        'entrypoint may not trace more often than its declared budget '
+        '— automates the round-5 decode_seq_parallel retrace-storm '
+        'finding (ADVICE.md)'),
+}
+
+_PRAGMA = re.compile(r'#\s*graphlint:\s*allow\[([a-z0-9_,\s-]+)\]')
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str                       # id from RULES
+    message: str
+    file: Optional[str] = None      # repo-relative where possible
+    line: Optional[int] = None
+    entrypoint: Optional[str] = None  # registry name (jaxpr rules)
+
+    def render(self):
+        where = f'{self.file}:{self.line}' if self.file else '<registry>'
+        entry = f' [{self.entrypoint}]' if self.entrypoint else ''
+        return f'{where}: {self.rule}{entry}: {self.message}'
+
+
+def allowed_by_pragma(source_lines, lineno, rule):
+    """True when the 1-based ``lineno`` (or the line above) carries a
+    ``# graphlint: allow[rule]`` pragma naming ``rule``."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(source_lines):
+            m = _PRAGMA.search(source_lines[ln - 1])
+            if m and rule in {r.strip() for r in m.group(1).split(',')}:
+                return True
+    return False
+
+
+def format_violations(violations, fmt='text'):
+    """Render a violation list for the CLI: ``text`` (one line each) or
+    ``json`` (a list of plain dicts)."""
+    if fmt == 'json':
+        import json
+        return json.dumps([dataclasses.asdict(v) for v in violations],
+                          indent=2)
+    if not violations:
+        return 'graphlint: no violations'
+    lines = [v.render() for v in violations]
+    lines.append(f'graphlint: {len(violations)} violation'
+                 f'{"s" if len(violations) != 1 else ""}')
+    return '\n'.join(lines)

@@ -1,0 +1,99 @@
+# -*- coding: utf-8 -*-
+"""
+Central entrypoint registry: the single place where every public
+computation of the package declares *example abstract shapes and
+meshes* so the jaxpr linter (analysis/jaxpr_rules.py) can trace it
+without running it.
+
+The shapes live NEXT TO the code they describe: each layer module
+(``ops/functions.py``, ``ops/pallas_attention.py``,
+``models/attention.py``, ``models/decode.py``, ``models/lm.py``,
+``serve/engine.py``, ``train.py``) exposes a ``graphlint_entrypoints()``
+hook returning ``{name: builder}``; this module aggregates them. A new
+public entrypoint ships with its registration in the same diff, and the
+tier-1 gate test (tests/test_graphlint.py) fails if any registered
+entrypoint violates a rule — that is how the contracts survive growth.
+
+Builders are lazy (constructing flax params or meshes costs real work)
+and run on whatever devices are visible; mesh-using entries need >= 2
+devices (the CLI forces an 8-device CPU platform — see
+analysis/__main__.py — and the test suite already runs on one).
+
+Precision convention for examples: entries that trace through
+``flax.linen.Dense`` projections register at f32 — flax Dense emits
+bf16-accumulating dots at bf16 and owning that is a separate project —
+while the raw-op entries (flash kernels, decode steps, the LM head
+einsum) register at bf16/int8, because those are the paths whose
+fp32-accumulation contract this linter enforces.
+"""
+
+import dataclasses
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = ['TraceSpec', 'default_entrypoints', 'LAYER_HOOKS']
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """One traceable entrypoint example.
+
+    ``fn``/``args``: the callable and example arguments (concrete
+    arrays or ShapeDtypeStructs — tracing never executes).
+    ``mesh_axes``: mesh axis names this entrypoint is DECLARED to run
+    over; collectives naming anything else violate ``collective-axis``.
+    ``cache_in``/``cache_out``: identity selectors — given ``args`` /
+    the ``eval_shape`` output, return the cache-buffer leaves, pairwise
+    aligned — driving ``cache-alias`` and ``cache-upcast``.
+    ``expect_donation``: run the ``donation`` rule. ``prejitted``: the
+    fn already carries its jit (lower it directly); otherwise the rule
+    jits with ``donate_argnums``. ``min_donated``: least number of
+    aliased/donor arguments the lowered module must show.
+    """
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    mesh_axes: Tuple[str, ...] = ()
+    cache_in: Optional[Callable] = None
+    cache_out: Optional[Callable] = None
+    expect_donation: bool = False
+    prejitted: bool = False
+    donate_argnums: Tuple[int, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    min_donated: int = 1
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+# (module path, hook name) for every layer that registers entrypoints.
+LAYER_HOOKS = (
+    'distributed_dot_product_tpu.ops.functions',
+    'distributed_dot_product_tpu.ops.pallas_attention',
+    'distributed_dot_product_tpu.models.attention',
+    'distributed_dot_product_tpu.models.decode',
+    'distributed_dot_product_tpu.models.lm',
+    'distributed_dot_product_tpu.serve.engine',
+    'distributed_dot_product_tpu.train',
+)
+
+
+def default_entrypoints():
+    """Aggregate every layer's ``graphlint_entrypoints()`` hook into one
+    ordered ``{name: builder}`` registry. Name collisions are an error —
+    the registry is the namespace the gate test and CLI report against."""
+    import importlib
+    registry = OrderedDict()
+    for modpath in LAYER_HOOKS:
+        mod = importlib.import_module(modpath)
+        hook = getattr(mod, 'graphlint_entrypoints', None)
+        if hook is None:
+            raise AttributeError(
+                f'{modpath} is listed in LAYER_HOOKS but defines no '
+                f'graphlint_entrypoints() hook')
+        for name, builder in hook().items():
+            if name in registry:
+                raise ValueError(f'duplicate entrypoint registration: '
+                                 f'{name!r} (from {modpath})')
+            registry[name] = builder
+    return registry

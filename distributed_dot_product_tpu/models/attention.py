@@ -774,6 +774,14 @@ def make_decode_step(module, mesh, mesh_axis=None, donate=True):
         fn, mesh=mesh,
         in_specs=(P(), P(), P(), P(), cache_spec),
         out_specs=(cache_spec, P()), check_vma=False)
+    # Retrace sentinel (analysis/retrace.py): a per-token serving loop
+    # holds ONE of these steps, so more than budget traces of a single
+    # instance is the round-5 retrace-storm class — raise (under
+    # pytest / when enabled) instead of silently re-compiling. Budget 2:
+    # one real trace plus one weak-type/lowering respin.
+    from distributed_dot_product_tpu.analysis.retrace import watch_traces
+    step = watch_traces(step, name='attention.make_decode_step',
+                        budget=2)
     return jax.jit(step, donate_argnums=(4,) if donate else ())
 
 
@@ -829,3 +837,84 @@ def decode_seq_parallel(module, params, mesh, keys, queries, values,
                 'step once with make_decode_step.', stacklevel=2)
         step = make_decode_step(module, mesh, mesh_axis)
     return step(params, keys, queries, values, cache)
+
+
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): the
+    module-level attention surfaces on a real 2-device mesh — forward
+    and backward through every softmax_impl's comm pattern (all_gather,
+    ring ppermute, ulysses all_to_all) for the collective-axis rule,
+    and the full sequence-sharded decode step (make_decode_step) for
+    the donation + cache-alias rules on the exact callable a serving
+    loop holds. Registered at f32: flax Dense projections emit
+    bf16-accumulating dots at bf16 (tracked separately); the bf16
+    fp32-accumulation contract is enforced on the raw-kernel entries
+    (ops/, models/decode.py)."""
+    import functools
+
+    def _module(softmax_impl, **kw):
+        return DistributedDotProductAttn(
+            key_dim=8, num_heads=2, causal=True, offset=2,
+            softmax_impl=softmax_impl, **kw)
+
+    def _fwd_spec(name, softmax_impl, **kw):
+        import jax
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+        mesh = seq_mesh(2)
+        module = _module(softmax_impl, **kw)
+        x = jnp.zeros((1, 16, 8), jnp.float32)
+        params = module.init(jax.random.key(0), x, x, x, None)
+
+        def fn(p, k, q, v):
+            return apply_seq_parallel(module, p, mesh, k, q, v, None)
+
+        return TraceSpec(name=name, fn=fn, args=(params, x, x, x),
+                         mesh_axes=(SEQ_AXIS,))
+
+    def _bwd_spec(name, softmax_impl, **kw):
+        import jax
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        base = _fwd_spec(name, softmax_impl, **kw)
+
+        def loss(p, k, q, v):
+            return jnp.sum(base.fn(p, k, q, v))
+
+        return base.replace(fn=jax.grad(loss, argnums=(0, 1)))
+
+    def seq_parallel_step():
+        import jax
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+        mesh = seq_mesh(2)
+        module = _module('flash', dtype=jnp.float32)
+        x = jnp.zeros((1, 16, 8), jnp.float32)
+        params = module.init(jax.random.key(0), x, x, x, None)
+        cache = module.make_decode_cache(1, 64)     # global t_max
+        step = make_decode_step(module, mesh)       # jitted + donating
+        tok = jnp.zeros((1, 1, 8), jnp.float32)
+        return TraceSpec(
+            name='decode.seq_parallel_step', fn=step,
+            args=(params, tok, tok, tok, cache),
+            mesh_axes=(SEQ_AXIS,), prejitted=True,
+            cache_in=lambda a: [a[4].k, a[4].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, min_donated=2)
+
+    return {
+        'attention.fwd_flash': functools.partial(
+            _fwd_spec, 'attention.fwd_flash', 'flash'),
+        'attention.bwd_full': functools.partial(
+            _bwd_spec, 'attention.bwd_full', 'full'),
+        'attention.fwd_ring': functools.partial(
+            _fwd_spec, 'attention.fwd_ring', 'online'),
+        'attention.fwd_ulysses': functools.partial(
+            _fwd_spec, 'attention.fwd_ulysses', 'ulysses'),
+        'decode.seq_parallel_step': seq_parallel_step,
+    }

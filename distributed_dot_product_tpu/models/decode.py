@@ -547,6 +547,80 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     return cache, out
 
 
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): the
+    decode steps at the shapes where the contracts bite — bf16 caches
+    (cache-upcast/f32-accum), the int8 mirror through the fused kernel
+    (int32 accumulation + pallas input_output_aliases), and the
+    sequence-sharded slab (collective axes + aliasing across the
+    shard_map boundary). Builders are lazy: the registry only pays for
+    construction when the linter runs."""
+    from functools import partial
+
+    def step_xla_slots():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        b, h, t, d = 2, 2, 32, 8
+        cache = init_slot_cache(b, h, t, d, dtype=jnp.bfloat16)
+        new = jnp.zeros((b, h, 1, d), jnp.bfloat16)
+        return TraceSpec(
+            name='decode.step_xla_slots',
+            fn=partial(decode_step, impl='xla'),
+            args=(new, cache, new, new),
+            cache_in=lambda a: [a[1].k, a[1].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    def step_kernel_int8():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        b, h, t, d = 1, 2, 64, 8
+        cache = init_cache(b, h, t, d, dtype=jnp.bfloat16,
+                           qk_quant='int8')
+        new = jnp.zeros((b, h, 1, d), jnp.bfloat16)
+        return TraceSpec(
+            name='decode.step_kernel_int8',
+            fn=partial(decode_step, impl='kernel', qk_quant='int8',
+                       interpret=True),
+            args=(new, cache, new, new),
+            cache_in=lambda a: [a[1].k, a[1].v, a[1].k_q, a[1].k_scale],
+            cache_out=lambda o: [o[0].k, o[0].v, o[0].k_q,
+                                 o[0].k_scale],
+            expect_donation=True, donate_argnums=(1,), min_donated=4)
+
+    def step_sharded():
+        from jax.sharding import PartitionSpec as P
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+        mesh = seq_mesh(2)
+        b, h, t, d = 1, 2, 64, 8          # t is the GLOBAL capacity
+        cache = init_cache(b, h, t, d, dtype=jnp.bfloat16)
+        new = jnp.zeros((b, h, 1, d), jnp.bfloat16)
+        spec4 = P(None, None, SEQ_AXIS, None)
+        cache_spec = DecodeCache(k=spec4, v=spec4, length=P(),
+                                 k_q=None, k_scale=None)
+        step = jax.shard_map(
+            partial(decode_step, impl='xla', axis_name=SEQ_AXIS),
+            mesh=mesh, in_specs=(P(), cache_spec, P(), P()),
+            out_specs=(cache_spec, P()), check_vma=False)
+        return TraceSpec(
+            name='decode.step_sharded', fn=step,
+            args=(new, cache, new, new), mesh_axes=(SEQ_AXIS,),
+            cache_in=lambda a: [a[1].k, a[1].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    return {
+        'decode.step_xla_slots': step_xla_slots,
+        'decode.step_kernel_int8': step_kernel_int8,
+        'decode.step_sharded': step_sharded,
+    }
+
+
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
                      alibi_slopes=None, segment_ids=None, seg_q=None,
                      qk_quant=None, axis_name=None):
@@ -626,9 +700,19 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')
     else:
-        s = jnp.einsum('bhqd,bhtd->bhqt',
-                       qg.astype(jnp.float32) * scale,
-                       cache.k.astype(jnp.float32))
+        # Stream K at its storage dtype with an f32 ACCUMULATOR
+        # (preferred_element_type) instead of upcasting the buffer:
+        # `cache.k.astype(f32)` would materialize a full-size f32 copy
+        # of the cache every step — twice the bytes of the attention
+        # read itself. bf16→f32 conversion is exact per element, so the
+        # scores match the upcast-first formulation bit for bit on
+        # backends that widen inside the dot. lax.dot_general (not
+        # jnp.einsum) because einsum's dtype promotion would sneak the
+        # same full-buffer convert back in when q and cache dtypes
+        # differ. Enforced by graphlint's cache-upcast/f32-accum rules.
+        s = lax.dot_general(
+            qg, cache.k, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * scale
     s = s.reshape(b, h_kv, group, n, t_max)
 
     # Query row i (0-based within the n new rows) sits at absolute
@@ -677,13 +761,20 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     m_safe = jnp.maximum(m, jnp.float32(-1e30))             # empty rows
     p = jnp.exp(s - m_safe)
     denom = jnp.sum(p, axis=-1, keepdims=True)
+    # Context dots: f32 weights against the V buffer AT ITS STORAGE
+    # DTYPE, f32 accumulation (mixed-dtype dot_general — see the score
+    # dot above). The former p.astype(v.dtype) rounding and the
+    # cache.v.astype(f32) full-buffer upcast are both gone: weights
+    # stay f32 (more accurate) and the cache is never re-materialized.
     if axis_name is None:
         p = p / jnp.where(denom == 0.0, 1.0, denom)
-        out = jnp.einsum('bhgqt,bhtd->bhgqd', p.astype(cache.v.dtype),
-                         cache.v)
+        out = lax.dot_general(
+            p, cache.v, (((4,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32).astype(cache.v.dtype)
         return out.reshape(b, h, n, cache.v.shape[-1])
-    num = jnp.einsum('bhgqt,bhtd->bhgqd', p,
-                     cache.v.astype(jnp.float32))
+    num = lax.dot_general(
+        p, cache.v, (((4,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)
     num = lax.psum(num, axis_name)
     denom = lax.psum(denom, axis_name)        # (…, n, 1): broadcasts
     out = num / jnp.where(denom == 0.0, 1.0, denom)

@@ -273,3 +273,49 @@ def greedy_generate(model, params, prompt, steps, t_max, donate=True):
         caches, tok = step(params, tok, caches)
         out.append(tok)
     return jnp.concatenate(out, axis=1)
+
+
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): the LM
+    head at bf16 — its einsum's explicit fp32 accumulation IS the PR-3
+    contract the f32-accum rule encodes — and the chunked token-mean
+    loss (nll_sum) whose scan must keep its logsumexp math in f32. The
+    loss registers at f32 (flax Dense projections at bf16 accumulate
+    bf16; tracked separately)."""
+
+    def head_bf16():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        model = TransformerLM(
+            vocab_size=32, dim=16, num_heads=2, n_layers=1,
+            dtype=jnp.bfloat16,
+            attn_kwargs={'distributed': False})
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)
+        x = jax.ShapeDtypeStruct((1, 8, 16), jnp.bfloat16)
+
+        def fn(p, h):
+            return model.apply(p, h, method='_head')
+
+        return TraceSpec(name='lm.head_bf16', fn=fn, args=(params, x))
+
+    def loss_f32():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        model = TransformerLM(
+            vocab_size=32, dim=16, num_heads=2, n_layers=1,
+            attn_kwargs={'distributed': False})
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)
+        targets = jax.ShapeDtypeStruct((1, 16), jnp.int32)
+
+        def fn(p, tok, tgt):
+            return model.apply(p, tok, tgt, chunk=4, method='nll_sum')
+
+        return TraceSpec(name='lm.loss_f32', fn=fn,
+                         args=(params, jax.ShapeDtypeStruct(
+                             (1, 16), jnp.int32), targets))
+
+    return {'lm.head_bf16': head_bf16, 'lm.loss_f32': loss_f32}

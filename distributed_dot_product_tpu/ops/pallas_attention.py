@@ -1952,3 +1952,60 @@ def flash_attention(q, k, v, mask=None, *, causal=False, causal_offset=0,
                   pos_q, pos_k, alibi_slopes, dropout_seed, float(scale),
                   bool(causal), bool(interpret), softmax_mode, window,
                   qk_quant, dropout_rate)
+
+
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): the
+    fused flash kernels at bf16 — THE paths whose fp32-accumulation
+    contract the f32-accum rule encodes (every in-kernel dot_general
+    must carry preferred_element_type=f32, int8 scoring i32). The
+    linter descends into the pallas_call jaxprs, so a regression inside
+    a kernel body is caught even though the kernel is one opaque
+    primitive to XLA."""
+    from functools import partial
+
+    def _sds(*shape, dtype='bfloat16'):
+        import jax
+        import jax.numpy as jnp
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+
+    def fwd_bf16():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        q = _sds(1, 2, 16, 8)
+        return TraceSpec(name='ops.flash_fwd_bf16',
+                         fn=partial(flash_attention, causal=True),
+                         args=(q, q, q))
+
+    def bwd_bf16():
+        import jax
+        import jax.numpy as jnp
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal=True)
+            return jnp.sum(out.astype(jnp.float32))
+
+        q = _sds(1, 2, 16, 8)
+        return TraceSpec(name='ops.flash_bwd_bf16',
+                         fn=jax.grad(loss, argnums=(0, 1, 2)),
+                         args=(q, q, q))
+
+    def fwd_int8():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        q = _sds(1, 2, 16, 8)
+        return TraceSpec(name='ops.flash_fwd_int8',
+                         fn=partial(flash_attention, causal=True,
+                                    qk_quant='int8'),
+                         args=(q, q, q))
+
+    return {
+        'ops.flash_fwd_bf16': fwd_bf16,
+        'ops.flash_bwd_bf16': bwd_bf16,
+        'ops.flash_fwd_int8': fwd_int8,
+    }

@@ -112,10 +112,24 @@ class KernelEngine:
         self.cache = init_slot_cache(slots, heads, t_max, head_dim,
                                      dtype=dtype)
         # Donated caches: appends write in place — see models/decode.py's
-        # performance note. One compiled program each for the lifetime.
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(0,))
-        self._reset = jax.jit(reset_slot, donate_argnums=(0,))
+        # performance note. One compiled program each for the lifetime —
+        # and the retrace sentinel (analysis/retrace.py) enforces it:
+        # shapes are fixed at construction, so more than budget traces
+        # of one program means something un-cacheable leaked into the
+        # step (the round-5 retrace-storm class). Budget 2: the real
+        # trace plus one registry lowering / weak-type respin.
+        from distributed_dot_product_tpu.analysis.retrace import (
+            watch_traces,
+        )
+        self._decode = jax.jit(
+            watch_traces(self._decode_impl, 'engine.decode', budget=2),
+            donate_argnums=(0,))
+        self._prefill = jax.jit(
+            watch_traces(self._prefill_impl, 'engine.prefill', budget=2),
+            donate_argnums=(0,))
+        self._reset = jax.jit(
+            watch_traces(reset_slot, 'engine.reset', budget=2),
+            donate_argnums=(0,))
 
     # -- compiled bodies ------------------------------------------------
     def _project(self, tokens):
@@ -193,3 +207,29 @@ class KernelEngine:
 
     def lengths(self):
         return np.asarray(self.cache.length)
+
+
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): the
+    serving engine's batched decode step — the program the continuous-
+    batching scheduler drives per tick — checked for real cache
+    donation/aliasing and surgical per-slot writes on the exact jitted
+    callable the engine holds."""
+
+    def engine_decode():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        eng = KernelEngine(slots=2, t_max=16, decode_impl='xla')
+        tokens = jnp.zeros((2,), jnp.int32)
+        active = jnp.ones((2,), bool)
+        poison = jnp.zeros((2,), bool)
+        return TraceSpec(
+            name='serve.engine_decode', fn=eng._decode,
+            args=(eng.cache, tokens, active, poison),
+            prejitted=True,
+            cache_in=lambda a: [a[0].k, a[0].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, min_donated=2)
+
+    return {'serve.engine_decode': engine_decode}

@@ -219,5 +219,8 @@ class HealthMonitor:
             if self.on_stall is not None:
                 try:
                     self.on_stall()
-                except Exception:
-                    pass    # a broken callback must not kill the watchdog
+                except Exception as e:
+                    # A broken callback must not kill the watchdog —
+                    # but its failure has to stay observable.
+                    tracing.log_exception('health.on_stall_callback', e,
+                                          registry=self.registry)

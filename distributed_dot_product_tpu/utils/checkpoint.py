@@ -49,6 +49,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 
 from distributed_dot_product_tpu.utils.comm import synchronize
+from distributed_dot_product_tpu.utils.tracing import log_exception
 
 __all__ = ['TrainState', 'save', 'restore', 'latest_step', 'wait',
            'gc_old_steps', 'recover_interrupted', 'CheckpointMismatchError']
@@ -104,23 +105,46 @@ def _step_dir(path, step):
     return _root(path) / f'step_{step:09d}'
 
 
-def _is_finalized(path):
-    try:
-        from orbax.checkpoint import utils as ocp_utils
-        return bool(ocp_utils.is_checkpoint_finalized(path))
-    except Exception:
-        # Fallback if the orbax util is missing/renamed: never assume YES —
-        # a crash-truncated directory must not be selected for restore.
-        # Orbax in-progress dirs carry an '.orbax-checkpoint-tmp' suffix,
-        # and a finalized StandardCheckpointer dir contains its metadata
-        # files; require positive evidence of the latter.
-        if '.orbax-checkpoint-tmp' in path.name:
-            return False
+_FINALIZED_UTIL = None          # unresolved; False once known-absent
+
+
+def _resolve_finalized_util():
+    """The orbax is_checkpoint_finalized util, or False — resolved ONCE
+    (a missing/renamed util is a permanent property of the installed
+    orbax, not a per-call anomaly worth a metric per scanned dir)."""
+    global _FINALIZED_UTIL
+    if _FINALIZED_UTIL is None:
         try:
-            entries = {p.name for p in path.iterdir()}
-        except OSError:
-            return False
-        return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
+            from orbax.checkpoint import utils as ocp_utils
+            _FINALIZED_UTIL = ocp_utils.is_checkpoint_finalized
+        except (ImportError, AttributeError):
+            _FINALIZED_UTIL = False
+    return _FINALIZED_UTIL
+
+
+def _is_finalized(path):
+    util = _resolve_finalized_util()
+    if util:
+        try:
+            return bool(util(path))
+        except Exception as e:
+            # A REAL probe failure (the util exists but raised) is
+            # anomalous — unlike a merely-absent util, it is worth a
+            # metric — and the structural fallback below still decides.
+            log_exception('checkpoint.is_finalized_fallback', e)
+    # Fallback when the orbax util is missing/renamed (or its probe
+    # failed): never assume YES — a crash-truncated directory must not
+    # be selected for restore. Orbax in-progress dirs carry an
+    # '.orbax-checkpoint-tmp' suffix, and a finalized
+    # StandardCheckpointer dir contains its metadata files; require
+    # positive evidence of the latter.
+    if '.orbax-checkpoint-tmp' in path.name:
+        return False
+    try:
+        entries = {p.name for p in path.iterdir()}
+    except OSError:
+        return False
+    return bool(entries & {'_CHECKPOINT_METADATA', '_METADATA'})
 
 
 class _RootPending:
@@ -394,7 +418,8 @@ def _tree_summary(tree):
                  for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
         return f'{len(paths)} leaves: ' + ', '.join(paths[:20]) + (
             ', ...' if len(paths) > 20 else '')
-    except Exception:
+    except Exception as e:
+        log_exception('checkpoint.tree_summary', e)
         return str(jax.tree.structure(tree))
 
 
@@ -404,8 +429,10 @@ def _mismatch_message(step_dir, template, err):
         meta = _checkpointer().metadata(step_dir)
         if meta is not None:
             found = _tree_summary(meta)
-    except Exception:
-        pass
+    except Exception as e:
+        # The mismatch diagnostic is best-effort ('unreadable' stands in)
+        # but the metadata failure itself must stay observable.
+        log_exception('checkpoint.mismatch_metadata', e)
     return (
         f'failed to restore checkpoint {step_dir}: the on-disk tree does '
         f'not match the restore template.\n'

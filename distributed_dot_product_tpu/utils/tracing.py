@@ -46,7 +46,10 @@ def device_peak_bytes(device=None):
     device = device or jax.devices()[0]
     try:
         stats = device.memory_stats()
-    except Exception:
+    except (AttributeError, NotImplementedError, RuntimeError, TypeError):
+        # Backend without memory stats (CPU, some PJRT plugins) — the
+        # narrowed set is every "stats unsupported here" shape observed;
+        # anything else (a real runtime fault) propagates.
         return None
     if not stats:
         return None
@@ -85,6 +88,25 @@ def measure(fn):
         return result
 
     return wrapper
+
+
+def log_exception(context, exc, registry=None):
+    """Record a swallowed-but-survivable exception so fault paths stay
+    observable: bumps ``exceptions_swallowed`` (total + per-context)
+    in the metrics registry — a health endpoint or operator sees the
+    count move even when nothing prints — and prints the exception
+    under the ``DISTRIBUTED_DOT_DEBUG`` switch.
+
+    This is the logging half of the ``silent-except`` lint contract
+    (analysis/astlint.py): a broad handler must re-raise, narrow its
+    type, or route through here. ``context`` is a short dotted site
+    name (e.g. ``'health.on_stall_callback'``)."""
+    reg = registry if registry is not None else _DEFAULT_REGISTRY
+    reg.counter('exceptions_swallowed').inc()
+    reg.counter(f'exceptions_swallowed.{context}').inc()
+    if _debug_enabled():
+        print(f'[{DEBUG_ENV_VAR}] swallowed exception in {context}: '
+              f'{type(exc).__name__}: {exc}', flush=True)
 
 
 def log_step(step, loss, grad_norm=None, bad=False, seconds=None,

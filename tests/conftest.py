@@ -43,6 +43,15 @@ jax.config.update('jax_compilation_cache_dir', _CACHE)
 jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)
 jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
 
+# Retrace sentinel (analysis/retrace.py): ON for the whole suite —
+# every decode/serve test runs under its entrypoint's trace-count
+# budget, so a per-token retrace storm (the round-5 decode_seq_parallel
+# finding) fails the offending test loudly instead of showing up as
+# mysterious slowness. Explicit (not just the pytest auto-default) so
+# `pytest -p no:cacheprovider tests/...` behaves identically under any
+# runner that strips PYTEST_CURRENT_TEST.
+os.environ.setdefault('DDP_TPU_RETRACE_SENTINEL', '1')
+
 
 @pytest.fixture(scope='session')
 def devices():
@@ -50,3 +59,14 @@ def devices():
     assert len(devs) >= _N_DEVICES, (
         f'expected >= {_N_DEVICES} CPU devices, got {devs}')
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _retrace_isolation():
+    """Zero every live trace counter between tests: budgets bound ONE
+    test's behavior (compiled steps and their jit caches persist across
+    tests, so carried-over counts would charge later tests for earlier
+    tests' legitimate traces)."""
+    from distributed_dot_product_tpu.analysis import retrace
+    retrace.reset()
+    yield

@@ -1,0 +1,5 @@
+# Deliberate-violation fixtures for tests/test_graphlint.py. This tree
+# is EXCLUDED from the analyzer's default scan (astlint.iter_python_files
+# skips 'graphlint_fixtures'; ruff excludes it in pyproject) — each file
+# seeds exactly the regression its rule must catch, and the tests assert
+# the analyzer reports it with file:line.

@@ -1,0 +1,18 @@
+"""Seeded clock-in-jit regression: a wall-clock read inside a jitted
+function (bakes a constant into the compiled program)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped_step(x):
+    t = time.time()              # VIOLATION: clock-in-jit (line 11)
+    return x + jnp.float32(t)
+
+
+def fine_host_timing(fn, x):
+    start = time.perf_counter()  # outside jit: NOT flagged
+    out = fn(x)
+    return out, time.perf_counter() - start

@@ -1,0 +1,150 @@
+# -*- coding: utf-8 -*-
+"""Seeded jaxpr-rule regressions: TraceSpec builders that each break
+exactly ONE contract the jaxpr linter enforces. tests/test_graphlint.py
+lints them and asserts the expected rule id fires (and that file:line
+points here)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_dot_product_tpu.analysis.registry import TraceSpec
+from distributed_dot_product_tpu.models.decode import (
+    append_kv, decode_attention, init_cache,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+
+
+def _sds(*shape, dtype=jnp.bfloat16):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _cache_and_new():
+    cache = init_cache(1, 2, 32, 8, dtype=jnp.bfloat16)
+    new = jnp.zeros((1, 2, 1, 8), jnp.bfloat16)
+    return cache, new
+
+
+def bad_f32_accum():
+    """bf16 dot_general WITHOUT preferred_element_type → bf16 accum."""
+
+    def fn(a, b):
+        return lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+
+    return TraceSpec(name='neg.f32_accum', fn=fn,
+                     args=(_sds(16, 8), _sds(8, 16)))
+
+
+def bad_cache_rematerialize():
+    """The appended cache K buffer is re-materialized by arithmetic
+    (`k * 1`) on the way out — the in-place append contract is broken
+    even though the VALUES are identical."""
+
+    def fn(cache, k_new, v_new):
+        cache = append_kv(cache, k_new, v_new)
+        return cache._replace(k=cache.k * jnp.bfloat16(1))
+
+    cache, new = _cache_and_new()
+    return TraceSpec(
+        name='neg.cache_rematerialize', fn=fn, args=(cache, new, new),
+        cache_in=lambda a: [a[0].k, a[0].v],
+        cache_out=lambda o: [o.k, o.v])
+
+
+def bad_full_shape_dus():
+    """A dynamic_update_slice whose update is the FULL buffer shape —
+    the degenerate 'append' that rewrites the whole cache per step."""
+
+    def fn(cache, k_new, v_new):
+        zeros = (jnp.zeros((), jnp.int32),) * 4
+        full = jnp.broadcast_to(k_new, cache.k.shape)
+        return cache._replace(
+            k=lax.dynamic_update_slice(cache.k, full, zeros))
+
+    cache, new = _cache_and_new()
+    return TraceSpec(
+        name='neg.full_shape_dus', fn=fn, args=(cache, new, new),
+        cache_in=lambda a: [a[0].k],
+        cache_out=lambda o: [o.k])
+
+
+def bad_cache_upcast():
+    """The pre-fix decode_attention formulation: upcast the whole K/V
+    buffers to f32 before the dots (full-size copies per step)."""
+
+    def fn(q, cache):
+        s = jnp.einsum('bhqd,bhtd->bhqt', q.astype(jnp.float32),
+                       cache.k.astype(jnp.float32))
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum('bhqt,bhtd->bhqd', p,
+                          cache.v.astype(jnp.float32))
+
+    cache, new = _cache_and_new()
+    return TraceSpec(
+        name='neg.cache_upcast', fn=fn, args=(new, cache),
+        cache_in=lambda a: [a[1].k, a[1].v],
+        cache_out=lambda o: [o, o])      # unused by the upcast rule
+
+
+def bad_missing_donation():
+    """The real decode step — but registered WITHOUT donate_argnums, as
+    if someone dropped the donation from the serving jit: the lowered
+    module then aliases nothing and every step copies the cache."""
+    from distributed_dot_product_tpu.models.decode import decode_step
+
+    cache, new = _cache_and_new()
+    return TraceSpec(
+        name='neg.missing_donation',
+        fn=partial(decode_step, impl='xla'),
+        args=(new, cache, new, new),
+        expect_donation=True, donate_argnums=(), min_donated=2)
+
+
+def bad_collective_axis():
+    """Program built over mesh axis 'seq' while the registration
+    declares the mesh as ('data',) — topology drift."""
+    mesh = seq_mesh(2)
+
+    def body(q, cache):
+        out = decode_attention(q, cache, axis_name=SEQ_AXIS)
+        return out
+
+    cache = init_cache(1, 2, 32, 8, dtype=jnp.bfloat16)
+    new = jnp.zeros((1, 2, 1, 8), jnp.bfloat16)
+    spec4 = P(None, None, SEQ_AXIS, None)
+    cache_spec = type(cache)(k=spec4, v=spec4, length=P(),
+                             k_q=None, k_scale=None)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(), cache_spec),
+                       out_specs=P(), check_vma=False)
+    return TraceSpec(name='neg.collective_axis', fn=fn,
+                     args=(new, cache), mesh_axes=('data',))
+
+
+def bad_trace_error():
+    """A registration whose entrypoint no longer traces at its declared
+    shapes (here: a shape assertion that fires) — reported as
+    trace-error, not a crash of the whole run."""
+
+    def fn(x):
+        raise ValueError('entrypoint regressed')
+
+    return TraceSpec(name='neg.trace_error', fn=fn, args=(_sds(4, 4),))
+
+
+ALL = {
+    'neg.f32_accum': (bad_f32_accum, 'f32-accum'),
+    'neg.cache_rematerialize': (bad_cache_rematerialize, 'cache-alias'),
+    'neg.full_shape_dus': (bad_full_shape_dus, 'cache-alias'),
+    'neg.cache_upcast': (bad_cache_upcast, 'cache-upcast'),
+    'neg.missing_donation': (bad_missing_donation, 'donation'),
+    'neg.collective_axis': (bad_collective_axis, 'collective-axis'),
+    'neg.trace_error': (bad_trace_error, 'trace-error'),
+}
+
+
+# CLI-shaped view ({name: builder}) for --registry MODULE:ATTR runs.
+REGISTRY = {name: builder for name, (builder, _rule) in ALL.items()}

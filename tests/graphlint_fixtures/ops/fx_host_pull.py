@@ -1,0 +1,17 @@
+"""Seeded host-pull regressions: host conversions of jnp-derived values
+in a hot-path ('ops/') module."""
+import jax.numpy as jnp
+
+
+def pulls_float(x):
+    total = jnp.sum(x)
+    return float(total)          # VIOLATION: host-pull (line 8)
+
+
+def pulls_item(x):
+    return (x * 2).item()        # VIOLATION: host-pull (line 12)
+
+
+def fine_static_config(scale):
+    # float() on a static kwarg is NOT flagged (no jnp derivation).
+    return jnp.float32(float(scale))

@@ -1,0 +1,16 @@
+"""Seeded traced-bool-branch regression: python `if` on a traced
+predicate in a hot-path module."""
+import jax.numpy as jnp
+
+
+def branches_on_traced(x):
+    if jnp.any(x > 0):           # VIOLATION: traced-bool-branch (line 7)
+        return x * 2
+    return x
+
+
+def fine_identity_check(mask):
+    m = jnp.asarray(mask)
+    if m is not None:            # identity check: NOT flagged
+        return m
+    return None
