@@ -27,11 +27,14 @@ import os
 import jax
 import jax.numpy as jnp
 
+from distributed_dot_product_tpu.obs import spans as obs_spans
+from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.ops.functions import (
     distributed_matmul_all_global, distributed_matmul_nt_global,
     distributed_matmul_tn_global,
 )
 from distributed_dot_product_tpu.parallel.mesh import seq_mesh, shard_seq
+from distributed_dot_product_tpu.utils import tracing
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 from distributed_dot_product_tpu.utils.tracing import (
     device_peak_bytes, time_fn,
@@ -132,6 +135,13 @@ def parse_args():
     parser.add_argument('--scale', type=int, default=1,
                         help='T = 75000 // scale')
     parser.add_argument('--file', default='benchmark_results.json')
+    parser.add_argument('--metrics-out', default=None,
+                        help='write an observability snapshot JSON for '
+                             'this run: the metrics-registry snapshot '
+                             '(serve counters/histograms when mode '
+                             'drives the scheduler) plus the phase-span '
+                             'tree (compile vs measure wall time). '
+                             'Enables span collection for the run.')
     parser.add_argument('--dtype', choices=['f32', 'bf16'], default='f32')
     parser.add_argument('--impl', choices=['allgather', 'ring'],
                         default='allgather')
@@ -293,8 +303,10 @@ def run_attn(args):
     # AOT-compile once: the executable feeds both the timing loop and the
     # memory analysis (a second .lower().compile() would double the
     # per-config cost — compiles dominate the sweep).
-    timed = _summed(fn).lower(q, k, v).compile()
-    best, mean = time_fn(timed, q, k, v, iters=args.iters)
+    with span('benchmark.compile', mode='attn'):
+        timed = _summed(fn).lower(q, k, v).compile()
+    with span('benchmark.measure', mode='attn'):
+        best, mean = time_fn(timed, q, k, v, iters=args.iters)
     peak = device_peak_bytes()
     record = {
         'mode': 'attn', 'attn_impl': args.attn_impl, 'scale': args.scale,
@@ -418,8 +430,11 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
     step = make_train_step(model, optimizer, mesh, donate=False)
 
     batch = (x, x, x, mask, target, seg)
-    compiled = step.lower(params, opt_state, batch).compile()
-    best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
+    with span('benchmark.compile', mode='train'):
+        compiled = step.lower(params, opt_state, batch).compile()
+    with span('benchmark.measure', mode='train'):
+        best, mean = time_fn(compiled, params, opt_state, batch,
+                             iters=iters)
     # Attended (query, key) pairs: full square, causal lower triangle, or
     # the sliding-window band (row i attends min(i+1, window) keys).
     if causal and window is not None:
@@ -500,8 +515,11 @@ def measure_lm_step(*, seq_len, n_layers=8, vocab=32768, dtype='bf16',
     step = make_lm_train_step(model, optimizer, mesh, donate=False)
 
     batch = (tokens, targets)
-    compiled = step.lower(params, opt_state, batch).compile()
-    best, mean = time_fn(compiled, params, opt_state, batch, iters=iters)
+    with span('benchmark.compile', mode='lm'):
+        compiled = step.lower(params, opt_state, batch).compile()
+    with span('benchmark.measure', mode='lm'):
+        best, mean = time_fn(compiled, params, opt_state, batch,
+                             iters=iters)
     if causal and window is not None:
         w = min(window, t)
         pairs = w * (w + 1) / 2.0 + (t - w) * float(w)
@@ -710,9 +728,11 @@ def run_decode(args):
     # thousand steps on the tunneled backend (observed 0.59 → 0.23
     # ms/token across three back-to-back measurements), so the recorded
     # number is the WARM steady state.
-    time_fn(timed, params, tok, iters=2, max_inner=16384)
-    best, mean = time_fn(timed, params, tok, iters=args.iters,
-                         max_inner=16384)
+    with span('benchmark.warmup', mode='decode'):
+        time_fn(timed, params, tok, iters=2, max_inner=16384)
+    with span('benchmark.measure', mode='decode'):
+        best, mean = time_fn(timed, params, tok, iters=args.iters,
+                             max_inner=16384)
     if best * 1e3 < 1e-3:
         # A sample window that fell under the measured sync overhead
         # clamps to ~0 — a 17 ns "token" is not a measurement. Fall back
@@ -742,8 +762,9 @@ def run_decode(args):
             return out[:, -1:]            # tiny residue forces the pass
 
         prefill_jit = jax.jit(prefill_fn)
-        prefill_time, _ = time_fn(prefill_jit, params, prompt,
-                                  iters=max(2, args.iters // 2))
+        with span('benchmark.ttft', mode='decode'):
+            prefill_time, _ = time_fn(prefill_jit, params, prompt,
+                                      iters=max(2, args.iters // 2))
     # Bytes the attention actually streams per step: V at the cache
     # dtype plus K at the cache dtype — or the 1-byte int8 mirror (and
     # its small per-row scales) when qk_quant carries one, so the GB/s
@@ -879,11 +900,18 @@ def run_decode_serve(args):
     cfg = ServeConfig(queue_limit=max(8, n_requests),
                       max_new_tokens=max_new, watchdog=False,
                       degrade_watermark=1.1)      # measure undegraded
-    sched = Scheduler(eng, cfg, registry=MetricsRegistry())
+    # --metrics-out: route the serve metrics (TTFT/queue-wait/per-token
+    # histograms, counters) into the process registry the snapshot
+    # serializes; otherwise keep them isolated from other runs.
+    sched = Scheduler(eng, cfg,
+                      registry=(tracing.get_registry()
+                                if getattr(args, 'metrics_out', None)
+                                else MetricsRegistry()))
     t0 = _time.perf_counter()
-    for i, p in enumerate(prompts):
-        sched.submit(p, request_id=f'b{i}')
-    results = sched.run_until_idle()
+    with span('benchmark.scheduler_burst', mode='decode-serve'):
+        for i, p in enumerate(prompts):
+            sched.submit(p, request_id=f'b{i}')
+        results = sched.run_until_idle()
     sched_s = _time.perf_counter() - t0
     sched.close()
     n_tok = sum(len(r.tokens) for r in results.values())
@@ -986,14 +1014,16 @@ def run(args):
             l, r, **kw)
     # AOT-compile once (see run_attn): one executable for profile, timing
     # and memory analysis.
-    fn = _summed(fn).lower(gleft, gright).compile()
+    with span('benchmark.compile', mode=args.mode):
+        fn = _summed(fn).lower(gleft, gright).compile()
 
     if args.profile_dir:
         jax.block_until_ready(fn(gleft, gright))  # warm outside trace
         with jax.profiler.trace(args.profile_dir):
             jax.block_until_ready(fn(gleft, gright))
 
-    best, mean = time_fn(fn, gleft, gright, iters=args.iters)
+    with span('benchmark.measure', mode=args.mode):
+        best, mean = time_fn(fn, gleft, gright, iters=args.iters)
     peak = device_peak_bytes()
     record.update(
         dist_time=best, dist_time_mean=mean,
@@ -1012,6 +1042,23 @@ def run(args):
     return record
 
 
+def _write_metrics_out(args, record):
+    """One observability artifact per run: the metrics-registry
+    snapshot (histograms carry reservoir percentiles + lifetime
+    totals), the phase-span summary/tree, and the result record —
+    enough to answer "where did this run's wall time go" offline."""
+    payload = {
+        'mode': args.mode,
+        'record': record,
+        'metrics': tracing.metrics(),
+        'spans': obs_spans.get_collector().summary(),
+        'span_tree': obs_spans.get_collector().render().splitlines(),
+    }
+    with open(args.metrics_out, 'w') as f:
+        json.dump(payload, f, indent=2, default=str)
+    print(f'metrics snapshot written to {args.metrics_out}')
+
+
 def main():
     args = parse_args()
     if args.multihost:
@@ -1020,7 +1067,14 @@ def main():
                   num_processes=args.num_processes,
                   process_id=args.process_id)
         comm.synchronize()
-    return run(args)
+    if args.metrics_out:
+        # Spans on for the run, mirrored into the process registry so
+        # the snapshot carries span.<phase>.seconds histograms too.
+        obs_spans.enable(True, registry=tracing.get_registry())
+    record = run(args)
+    if args.metrics_out:
+        _write_metrics_out(args, record)
+    return record
 
 
 if __name__ == '__main__':

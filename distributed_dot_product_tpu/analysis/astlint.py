@@ -65,6 +65,27 @@ def _is_jax_call(node):
             and _root_name(node.func) in _JAX_ROOTS)
 
 
+# The obs spans layer (obs/spans.py): roots its calls may appear under.
+_SPAN_ROOTS = {'obs', 'spans', 'obs_spans'}
+_SPAN_NAMES = {'span', 'spanned'}
+
+
+def _is_span_call(node):
+    """``span(...)`` / ``spanned(...)`` / ``obs.span(...)`` /
+    ``spans.span(...)`` — the obs layer's clock-reading context
+    managers. A bare name matches only the exact identifiers (so a
+    regex ``m.span()`` attribute on a non-obs object never fires: its
+    root is the match object, not an obs module)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _SPAN_NAMES
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _SPAN_NAMES and _root_name(fn) in _SPAN_ROOTS
+    return False
+
+
 def _is_jnp_predicate_call(node):
     return (isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
@@ -188,6 +209,15 @@ class _FunctionChecker(ast.NodeVisitor):
                            f'function reads the clock at TRACE time '
                            f'and bakes a constant into the compiled '
                            f'program — time outside the jit boundary')
+        if self.in_jit and _is_span_call(node):
+            # The obs layer's spans read the host clock: inside a
+            # jitted function they time the TRACE, not the execution,
+            # and the recorded span silently describes compilation.
+            # Spans wrap host-side dispatch — never traced code.
+            self._emit('clock-in-jit', node,
+                       'obs span inside a jitted function reads the '
+                       'host clock at TRACE time — wrap the dispatch '
+                       'of the compiled step, not its traced body')
         self.generic_visit(node)
 
     def visit_If(self, node):

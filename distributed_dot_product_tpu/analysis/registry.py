@@ -75,6 +75,7 @@ LAYER_HOOKS = (
     'distributed_dot_product_tpu.models.lm',
     'distributed_dot_product_tpu.serve.engine',
     'distributed_dot_product_tpu.train',
+    'distributed_dot_product_tpu.obs',
 )
 
 

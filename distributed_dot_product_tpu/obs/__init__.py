@@ -1,0 +1,88 @@
+# -*- coding: utf-8 -*-
+"""
+Unified observability layer: spans, the structured event log, request
+timelines, and the Prometheus exporter.
+
+Grown from the reference's ``measure`` decorator (reference
+functions.py:24-41) and the in-process ``MetricsRegistry``
+(utils/tracing.py) into a real subsystem — see each submodule:
+
+- :mod:`~distributed_dot_product_tpu.obs.spans` — nestable host-side
+  wall-time spans with a zero-overhead disabled path.
+- :mod:`~distributed_dot_product_tpu.obs.events` — append-only
+  schema-versioned JSONL event log (serve/train/health/fault lifecycle
+  vocabulary), crash-safe flushing, size-based rotation.
+- :mod:`~distributed_dot_product_tpu.obs.timeline` — per-request
+  lifecycle reconstruction over the event log.
+- :mod:`~distributed_dot_product_tpu.obs.exporter` — Prometheus-text
+  rendering of the metrics registry plus the optional ``/metrics`` +
+  ``/healthz`` HTTP thread (off by default).
+
+CLI: ``python -m distributed_dot_product_tpu.obs validate <log.jsonl>``
+schema-checks a log offline; ``... timeline <log.jsonl> <request-id>``
+prints one request's reconstructed lifecycle (scripts/ci.sh and
+scripts/smoke_serve.sh drive both).
+"""
+
+from distributed_dot_product_tpu.obs.events import (  # noqa: F401
+    EVENT_SCHEMA, SCHEMA_VERSION, EventLog, activate, emit, get_active,
+    open_from_env, read_events, set_active, validate_file,
+)
+from distributed_dot_product_tpu.obs.exporter import (  # noqa: F401
+    MetricsServer, render_prometheus,
+)
+from distributed_dot_product_tpu.obs.spans import (  # noqa: F401
+    SpanCollector, SpanRecord, collecting, enable, enabled,
+    get_collector, span, spanned,
+)
+from distributed_dot_product_tpu.obs.timeline import (  # noqa: F401
+    Timeline, reconstruct, timeline,
+)
+
+__all__ = [
+    'EVENT_SCHEMA', 'SCHEMA_VERSION', 'EventLog', 'activate', 'emit',
+    'get_active', 'open_from_env', 'read_events', 'set_active',
+    'validate_file', 'MetricsServer', 'render_prometheus',
+    'SpanCollector', 'SpanRecord', 'collecting', 'enable', 'enabled',
+    'get_collector', 'span', 'spanned', 'Timeline', 'reconstruct',
+    'timeline',
+]
+
+
+def graphlint_entrypoints():
+    """Static-analysis registration hook (analysis/registry.py): trace
+    the serving engine's decode program THROUGH a host-side span — the
+    supported composition — and require the cache-alias / precision
+    contracts to hold unchanged. A span that leaked ops or constants
+    into the traced program (the clock-in-jit hazard the AST rule
+    rejects in jitted bodies) would surface here as a rule violation or
+    a jaxpr diff against the engine's own entry."""
+
+    def spanned_decode():
+        import jax.numpy as jnp
+
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.obs.spans import span
+        from distributed_dot_product_tpu.serve.engine import KernelEngine
+
+        eng = KernelEngine(slots=2, t_max=16, decode_impl='xla')
+        tokens = jnp.zeros((2,), jnp.int32)
+        active = jnp.ones((2,), bool)
+        poison = jnp.zeros((2,), bool)
+
+        def dispatch(cache, tokens, active, poison):
+            # The span wraps the dispatch from the HOST side; the traced
+            # body below it must come out identical to the unspanned
+            # engine entry (serve.engine_decode).
+            with span('obs.decode_dispatch'):
+                return eng._decode_impl(cache, tokens, active, poison)
+
+        return TraceSpec(
+            name='obs.spanned_decode', fn=dispatch,
+            args=(eng.cache, tokens, active, poison),
+            cache_in=lambda a: [a[0].k, a[0].v],
+            cache_out=lambda o: [o[0].k, o[0].v])
+
+    return {'obs.spanned_decode': spanned_decode}

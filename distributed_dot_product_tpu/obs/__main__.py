@@ -1,0 +1,91 @@
+# -*- coding: utf-8 -*-
+"""
+Offline event-log tooling::
+
+    python -m distributed_dot_product_tpu.obs validate LOG [LOG...]
+        [--require event[,event...]] [--timelines]
+    python -m distributed_dot_product_tpu.obs timeline LOG REQUEST_ID
+
+``validate`` schema-checks every record of each log's rotated set
+against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
+(exit 1 on any violation). ``--require`` additionally demands that the
+named events appear at least once — how scripts/smoke_serve.sh asserts
+the injected fault cocktail actually landed in the log. ``--timelines``
+reconstructs every request and fails on incomplete lifecycles.
+
+``timeline`` prints one request's reconstructed lifecycle.
+
+Runs on plain files — no devices touched, safe in any CI stage.
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+from distributed_dot_product_tpu.obs.events import validate_file
+from distributed_dot_product_tpu.obs.timeline import reconstruct, timeline
+
+
+def _cmd_validate(args):
+    rc = 0
+    for path in args.logs:
+        records, errors = validate_file(path)
+        counts = collections.Counter(r.get('event') for r in records)
+        for err in errors:
+            print(f'{path}: SCHEMA: {err}')
+            rc = 1
+        missing = [ev for ev in args.require if not counts.get(ev)]
+        for ev in missing:
+            print(f'{path}: REQUIRED event never recorded: {ev}')
+            rc = 1
+        if args.timelines:
+            for rid, tl in sorted(reconstruct(records).items()):
+                for err in tl.errors:
+                    print(f'{path}: TIMELINE {rid}: {err}')
+                    rc = 1
+        summary = ' '.join(f'{ev}={n}' for ev, n in sorted(counts.items()))
+        print(f'{path}: {len(records)} events '
+              f'({"OK" if rc == 0 else "INVALID"}) {summary}')
+    return rc
+
+
+def _cmd_timeline(args):
+    tl = timeline(args.request_id, args.log)
+    print(json.dumps({
+        'request_id': tl.request_id, 'status': tl.status,
+        'reason': tl.reason, 'complete': tl.complete,
+        'errors': tl.errors, 'phases': tl.phases(),
+        'admits': tl.admits, 'quarantines': tl.quarantines,
+        'tokens': tl.tokens,
+        'events': [(r['seq'], r['event']) for r in tl.events],
+    }, indent=2, default=str))
+    return 0 if tl.complete else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m distributed_dot_product_tpu.obs',
+        description=__doc__)
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    v = sub.add_parser('validate', help='schema-check JSONL event logs')
+    v.add_argument('logs', nargs='+')
+    v.add_argument('--require', default='',
+                   type=lambda s: [e for e in s.split(',') if e],
+                   help='comma-separated events that must appear')
+    v.add_argument('--timelines', action='store_true',
+                   help='also require every request lifecycle complete')
+    v.set_defaults(fn=_cmd_validate)
+
+    t = sub.add_parser('timeline', help='print one request lifecycle')
+    t.add_argument('log')
+    t.add_argument('request_id')
+    t.set_defaults(fn=_cmd_timeline)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

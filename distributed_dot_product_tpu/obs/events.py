@@ -1,0 +1,357 @@
+# -*- coding: utf-8 -*-
+"""
+Append-only, schema-versioned JSONL event log — the durable record of
+everything the serving and training loops DO, next to the metrics that
+record what they COST.
+
+Design:
+
+- **One line per event**, JSON, schema-versioned: every record carries
+  ``{"schema": 1, "seq": N, "ts": <unix>, "event": "<name>", ...}``.
+  ``seq`` is a per-log monotonic counter, the authoritative order (and
+  the tiebreak for equal timestamps); ``ts`` comes from an injectable
+  wall clock.
+- **Closed vocabulary**: :data:`EVENT_SCHEMA` names every event and its
+  required fields. Emitting an unknown event or dropping a required
+  field raises immediately — the log is an audited contract, not a
+  printf stream, and ``python -m distributed_dot_product_tpu.obs
+  validate`` re-checks the same schema offline (scripts/ci.sh runs it
+  over the smoke-serve run).
+- **Crash-safe flushing**: each emit writes one complete line and
+  flushes the stream, so a crash loses at most the event being written
+  mid-line (a torn tail line is detected, not silently absorbed, by the
+  readers). ``fsync=True`` additionally fsyncs per emit for logs that
+  must survive power loss.
+- **Size-based rotation**: past ``rotate_bytes`` the file rotates
+  through ``path.1 .. path.<keep_rotations>`` (newest = ``.1``);
+  :func:`read_events` reassembles the rotated set in order.
+
+The *active log* is a process-wide slot (:func:`set_active` /
+:func:`activate`): the serving scheduler, the health monitor, the fault
+injectors, and ``utils.tracing.log_step`` / ``log_exception`` all emit
+through :func:`emit`, which no-ops when no log is active — so wiring
+observability into a run is one ``with activate(EventLog(path)):``.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ['SCHEMA_VERSION', 'EVENT_SCHEMA', 'EventLog', 'emit',
+           'get_active', 'set_active', 'activate', 'open_from_env',
+           'read_events', 'validate_record', 'validate_file',
+           'ENV_VAR']
+
+SCHEMA_VERSION = 1
+
+ENV_VAR = 'DDP_TPU_EVENT_LOG'
+
+# The complete lifecycle vocabulary: event name -> required fields
+# (beyond the envelope fields schema/seq/ts/event). Extra fields are
+# allowed; missing required fields or unknown names raise at emit AND
+# fail offline validation.
+EVENT_SCHEMA = {
+    # -- serving lifecycle (serve/scheduler.py, serve/admission.py) ----
+    'serve.admit': ('request_id', 'slot'),
+    'serve.reject': ('request_id', 'reason'),
+    'serve.evict': ('request_id', 'slot'),
+    'serve.prefill': ('request_id', 'slot', 'pos'),
+    'serve.decode': ('request_id', 'slot', 'token_index'),
+    'serve.retire': ('request_id', 'status'),
+    'serve.quarantine': ('request_id', 'slot', 'requeued'),
+    # -- training driver (train_loop.py via utils.tracing.log_step) ----
+    'train.step': ('step', 'loss'),
+    'train.bad_step': ('step',),
+    'train.checkpoint_save': ('step', 'seconds'),
+    'train.restore': ('step',),
+    'train.rollback': ('step',),
+    # -- health surface (serve/health.py) ------------------------------
+    'health.liveness': ('state',),
+    'health.readiness': ('state',),
+    # -- fault injection (utils/faults.py) -----------------------------
+    'fault.inject': ('kind',),
+    # -- swallowed exceptions (utils.tracing.log_exception) ------------
+    'exception': ('context', 'type'),
+}
+
+
+def validate_record(rec):
+    """Schema-check one decoded record; returns a list of error strings
+    (empty = valid). Shared by :meth:`EventLog.emit` and the offline
+    validator CLI, so the write-side and read-side contracts cannot
+    drift apart."""
+    errors = []
+    if not isinstance(rec, dict):
+        return [f'record is not an object: {rec!r}']
+    schema = rec.get('schema')
+    if schema != SCHEMA_VERSION:
+        errors.append(f'unknown schema version {schema!r} '
+                      f'(expected {SCHEMA_VERSION})')
+    event = rec.get('event')
+    if event not in EVENT_SCHEMA:
+        errors.append(f'unknown event {event!r}')
+        return errors
+    for field in ('seq', 'ts'):
+        if field not in rec:
+            errors.append(f'{event}: missing envelope field {field!r}')
+    for field in EVENT_SCHEMA[event]:
+        if field not in rec:
+            errors.append(f'{event}: missing required field {field!r}')
+    return errors
+
+
+def _json_safe(value):
+    """Strict-JSON field values: non-finite floats become the strings
+    ``'nan'``/``'inf'``/``'-inf'`` (bare ``NaN`` tokens are Python-only
+    — jq / Go / BigQuery consumers reject them, and the bad-step
+    records a fault log exists for are exactly the NaN-bearing ones).
+    Containers are sanitized recursively."""
+    if isinstance(value, float):
+        if value != value:
+            return 'nan'
+        if value in (float('inf'), float('-inf')):
+            return 'inf' if value > 0 else '-inf'
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _json_safe(v) for k, v in value.items()}
+    return value
+
+
+class EventLog:
+    """Append-only JSONL event sink (see module docstring).
+
+    ``clock`` is injectable (virtual-time tests); ``ts`` is a wall
+    timestamp for operators — ``seq`` is the ordering contract.
+    """
+
+    def __init__(self, path, *, rotate_bytes=16 * 2 ** 20,
+                 keep_rotations=3, fsync=False, clock=time.time):
+        self.path = os.fspath(path)
+        self.rotate_bytes = int(rotate_bytes)
+        self.keep_rotations = int(keep_rotations)
+        self.fsync = fsync
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._rotations = 0
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        # Reopening an existing log continues its seq series: seq is
+        # the authoritative order, so a second run appending to the
+        # same file must not restart at 0 (read_events sorts by seq —
+        # duplicated values would interleave the two runs' records).
+        self._seq = self._resume_seq()
+        self._fh = open(self.path, 'a', encoding='utf-8')
+        self._size = self._fh.tell()
+
+    def _resume_seq(self):
+        if not os.path.exists(self.path):
+            return 0
+        # A crash-torn tail has no trailing newline; appending onto it
+        # would merge the next record into the torn fragment MID-file,
+        # where readers rightly refuse it. Drop the fragment (it was
+        # never a complete record) before appending.
+        with open(self.path, 'rb+') as f:
+            data = f.read()
+            if data and not data.endswith(b'\n'):
+                last_nl = data.rfind(b'\n')
+                f.truncate(last_nl + 1 if last_nl >= 0 else 0)
+        last = -1
+        with open(self.path, encoding='utf-8') as f:
+            for line in f:
+                try:
+                    seq = json.loads(line).get('seq')
+                except json.JSONDecodeError:
+                    continue        # complete-but-corrupt line
+                if isinstance(seq, int):
+                    last = max(last, seq)
+        return last + 1
+
+    # -- write side -----------------------------------------------------
+    def emit(self, event, **fields):
+        """Append one schema-validated event; returns the full record
+        (envelope included) for callers that also want it in-process."""
+        rec = {'schema': SCHEMA_VERSION, 'seq': None,
+               'ts': self.clock(), 'event': event}
+        rec.update({k: _json_safe(v) for k, v in fields.items()})
+        with self._lock:
+            rec['seq'] = self._seq
+            errors = validate_record(rec)
+            if errors:
+                raise ValueError(
+                    f'invalid event {event!r}: ' + '; '.join(errors))
+            line = json.dumps(rec, separators=(',', ':'),
+                              allow_nan=False, default=str)
+            self._seq += 1
+            self._fh.write(line + '\n')
+            # Flush per line: a crash loses at most the line being
+            # written, and readers (smoke audits tailing a live run)
+            # always see complete records.
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._size += len(line) + 1
+            if self._size >= self.rotate_bytes:
+                self._rotate_locked()
+        return rec
+
+    def _rotate_locked(self):
+        self._fh.close()
+        oldest = f'{self.path}.{self.keep_rotations}'
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep_rotations - 1, 0, -1):
+            src = f'{self.path}.{i}'
+            if os.path.exists(src):
+                os.replace(src, f'{self.path}.{i + 1}')
+        os.replace(self.path, f'{self.path}.1')
+        self._fh = open(self.path, 'a', encoding='utf-8')
+        self._size = 0
+        self._rotations += 1
+
+    @property
+    def rotations(self):
+        return self._rotations
+
+    def files(self):
+        """Existing log files, oldest first (rotated set then the live
+        file) — the read order that makes ``seq`` non-decreasing."""
+        out = [f'{self.path}.{i}'
+               for i in range(self.keep_rotations, 0, -1)
+               if os.path.exists(f'{self.path}.{i}')]
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+    def flush(self):
+        with self._lock:
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+
+    def close(self):
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# -- the process-wide active log ----------------------------------------
+
+_ACTIVE: Optional[EventLog] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def get_active() -> Optional[EventLog]:
+    return _ACTIVE
+
+
+def set_active(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Install ``log`` as the process-wide sink; returns the previous
+    one (for restoration)."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        prev, _ACTIVE = _ACTIVE, log
+    return prev
+
+
+@contextlib.contextmanager
+def activate(log: EventLog):
+    """Scoped :func:`set_active` (the normal way to wire a run)."""
+    prev = set_active(log)
+    try:
+        yield log
+    finally:
+        set_active(prev)
+
+
+def emit(event, _log: Optional[EventLog] = None, **fields):
+    """Emit through ``_log``, or the active log, or nowhere (no-op when
+    neither exists) — the call sites sprinkled through serve/train/fault
+    code pay one None-check when logging is off."""
+    log = _log if _log is not None else _ACTIVE
+    if log is None:
+        return None
+    return log.emit(event, **fields)
+
+
+def open_from_env(environ=None) -> Optional[EventLog]:
+    """An :class:`EventLog` at ``$DDP_TPU_EVENT_LOG``, or None when the
+    knob is unset — how shell drivers (scripts/smoke_serve.sh) attach a
+    log without touching python."""
+    env = os.environ if environ is None else environ
+    path = env.get(ENV_VAR)
+    return EventLog(path) if path else None
+
+
+# -- read side ------------------------------------------------------------
+
+def _log_files(path):
+    """Rotated set for ``path`` (oldest first), accepting either the
+    live file or a directory-less prefix."""
+    path = os.fspath(path)
+    rotated = []
+    i = 1
+    while os.path.exists(f'{path}.{i}'):
+        rotated.append(f'{path}.{i}')
+        i += 1
+    out = list(reversed(rotated))
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_events(source):
+    """Decode every event from ``source`` — an :class:`EventLog`, a path
+    (its rotated set is reassembled), or an iterable of already-decoded
+    records. Returns records sorted by ``seq``. A torn tail line (crash
+    mid-write) is tolerated on the LAST line of the newest file only;
+    anywhere else it raises."""
+    if isinstance(source, EventLog):
+        files = source.files()
+    elif isinstance(source, (str, os.PathLike)):
+        files = _log_files(source)
+    else:
+        return sorted(source, key=lambda r: r.get('seq', 0))
+    records = []
+    for fi, fname in enumerate(files):
+        with open(fname, encoding='utf-8') as f:
+            lines = f.read().splitlines()
+        for li, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                last = (fi == len(files) - 1 and li == len(lines) - 1)
+                if not last:
+                    raise ValueError(
+                        f'{fname}:{li + 1}: corrupt event line '
+                        f'(not the crash-torn tail): {line[:80]!r}')
+    return sorted(records, key=lambda r: r.get('seq', 0))
+
+
+def validate_file(path):
+    """Offline schema validation over a log's rotated set: returns
+    ``(records, errors)`` where ``errors`` is a list of strings (empty
+    = the log is schema-clean)."""
+    errors = []
+    try:
+        records = read_events(path)
+    except ValueError as e:
+        return [], [str(e)]
+    for rec in records:
+        for err in validate_record(rec):
+            errors.append(f'seq={rec.get("seq")}: {err}')
+    return records, errors

@@ -1,0 +1,219 @@
+# -*- coding: utf-8 -*-
+"""
+Prometheus-text exporter for the in-process metrics registry, plus an
+optional stdlib HTTP endpoint serving ``/metrics`` and ``/healthz``.
+
+No external metrics dependency exists in the image, so this renders the
+`Prometheus exposition format (0.0.4)` by hand from
+``MetricsRegistry`` state:
+
+- counters  → ``<ns>_<name>_total`` (``# TYPE counter``)
+- gauges    → ``<ns>_<name>`` (``# TYPE gauge``)
+- histograms → a summary family: ``{quantile="0.5"|"0.99"}`` lines from
+  the aged reservoir (CURRENT behavior — what an alert wants) plus the
+  Prometheus-mandated cumulative ``_count``/``_sum`` from the lifetime
+  totals (``Histogram.summary()``'s ``total_count``/``total_sum``).
+
+Dotted registry names are sanitized (``serve.queue_depth`` →
+``ddp_serve_queue_depth``); labeled metrics (``registry.counter(name,
+labels={...})``) render with escaped label values per the exposition
+rules (backslash, double-quote, newline).
+
+The server is **off by default** — construct and :meth:`~MetricsServer.
+start` it explicitly::
+
+    srv = MetricsServer(registry, health=monitor, port=9100).start()
+    ...  # curl localhost:9100/metrics ; curl localhost:9100/healthz
+    srv.stop()
+
+``/healthz`` returns the :class:`~distributed_dot_product_tpu.serve.
+health.HealthMonitor` snapshot, status 200 while readiness is
+``ready``/``degraded`` (degraded still serves) and 503 otherwise — the
+shape a load-balancer probe consumes.
+"""
+
+import http.server
+import json
+import math
+import re
+import threading
+from typing import Optional
+
+from distributed_dot_product_tpu.utils import tracing
+
+__all__ = ['render_prometheus', 'escape_label_value', 'MetricsServer']
+
+_NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _metric_name(namespace, name):
+    base = _NAME_SANITIZE.sub('_', name)
+    return f'{namespace}_{base}' if namespace else base
+
+
+def escape_label_value(value):
+    """Escape a label value per the exposition format: backslash,
+    double-quote and newline."""
+    return (str(value).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _labels_str(labels, extra=()):
+    items = list(labels.items()) + list(extra)
+    if not items:
+        return ''
+    body = ','.join(f'{k}="{escape_label_value(v)}"' for k, v in items)
+    return '{' + body + '}'
+
+
+def _fmt(value):
+    v = float(value)
+    if math.isnan(v):
+        return 'NaN'
+    if math.isinf(v):
+        return '+Inf' if v > 0 else '-Inf'
+    return repr(v) if not v.is_integer() else str(int(v))
+
+
+def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
+                      *, namespace='ddp') -> str:
+    """Render ``registry`` (default: the process registry) as Prometheus
+    exposition text. Reads are snapshot-consistent per metric (each
+    counter/gauge read is atomic, each histogram summary is computed
+    under its own lock), so concurrent writers never produce torn
+    values — only values at least as fresh as the render's start."""
+    registry = registry or tracing.get_registry()
+    lines = []
+    typed = set()
+
+    def _head(kind, fam, comment):
+        if fam not in typed:
+            typed.add(fam)
+            lines.append(f'# HELP {fam} {comment}')
+            lines.append(f'# TYPE {fam} {kind}')
+
+    for kind, name, labels, value in registry.iter_metrics():
+        if kind == 'counter':
+            fam = _metric_name(namespace, name) + '_total'
+            _head('counter', fam, f'counter {name}')
+            lines.append(f'{fam}{_labels_str(labels)} {_fmt(value)}')
+        elif kind == 'gauge':
+            fam = _metric_name(namespace, name)
+            _head('gauge', fam, f'gauge {name}')
+            lines.append(f'{fam}{_labels_str(labels)} {_fmt(value)}')
+        else:   # histogram summary: value is Histogram.summary()
+            fam = _metric_name(namespace, name)
+            _head('summary', fam, f'histogram {name} '
+                                  f'(quantiles over the aged reservoir)')
+            for q, key in (('0.5', 'p50'), ('0.99', 'p99')):
+                lines.append(
+                    f'{fam}{_labels_str(labels, [("quantile", q)])} '
+                    f'{_fmt(value[key])}')
+            lines.append(f'{fam}_count{_labels_str(labels)} '
+                         f'{_fmt(value["total_count"])}')
+            lines.append(f'{fam}_sum{_labels_str(labels)} '
+                         f'{_fmt(value["total_sum"])}')
+    return '\n'.join(lines) + '\n' if lines else ''
+
+
+_HEALTHY = ('ready', 'degraded')
+
+
+class _ObsHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    # Exporter endpoints hold references, not state:
+    registry = None
+    health = None
+    namespace = 'ddp'
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = 'ddp-obs/1'
+
+    def _send(self, code, body, content_type):
+        data = body.encode('utf-8')
+        self.send_response(code)
+        self.send_header('Content-Type', content_type)
+        self.send_header('Content-Length', str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):     # noqa: N802 (stdlib API name)
+        path = self.path.split('?', 1)[0].rstrip('/') or '/'
+        if path == '/metrics':
+            body = render_prometheus(self.server.registry,
+                                     namespace=self.server.namespace)
+            self._send(200, body,
+                       'text/plain; version=0.0.4; charset=utf-8')
+        elif path == '/healthz':
+            health = self.server.health
+            if health is None:
+                self._send(200, json.dumps({'status': 'ok',
+                                            'health': None}) + '\n',
+                           'application/json')
+                return
+            snap = health.snapshot()
+            ok = (snap['readiness'] in _HEALTHY
+                  and snap['liveness'] == 'alive')
+            self._send(200 if ok else 503,
+                       json.dumps(snap, default=str) + '\n',
+                       'application/json')
+        else:
+            self._send(404, 'not found\n', 'text/plain')
+
+    def log_message(self, fmt, *args):
+        # Probes hit /healthz every few seconds — stay silent.
+        pass
+
+
+class MetricsServer:
+    """Background ``/metrics`` + ``/healthz`` endpoint. OFF by default:
+    nothing binds a port until :meth:`start`. ``port=0`` picks an
+    ephemeral port (read it back from ``.port`` — how tests avoid
+    collisions)."""
+
+    def __init__(self, registry=None, *, health=None,
+                 host='127.0.0.1', port=0, namespace='ddp'):
+        self.registry = registry or tracing.get_registry()
+        self.health = health
+        self.host = host
+        self.port = port
+        self.namespace = namespace
+        self._server: Optional[_ObsHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._server is not None:
+            return self
+        srv = _ObsHTTPServer((self.host, self.port), _Handler)
+        srv.registry = self.registry
+        srv.health = self.health
+        srv.namespace = self.namespace
+        self.port = srv.server_address[1]
+        self._server = srv
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        name='obs-metrics-server',
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self):
+        return f'http://{self.host}:{self.port}'
+
+    def stop(self):
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

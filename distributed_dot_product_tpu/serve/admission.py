@@ -34,6 +34,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from distributed_dot_product_tpu.obs import events as obs_events
+
 __all__ = ['RejectReason', 'RejectedError', 'Request', 'RequestResult',
            'AdmissionController']
 
@@ -74,6 +76,10 @@ class Request:
     degraded: bool = False
     cancelled: bool = False
     admit_index: Optional[int] = None   # admission order, fault-stable
+    # -- timeline anchors (scheduler clock; observability) --------------
+    queued_since: Optional[float] = None    # last enqueue time
+    admitted_at: Optional[float] = None     # last slot assignment
+    first_token_at: Optional[float] = None  # TTFT anchor
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -106,7 +112,7 @@ class AdmissionController:
 
     def __init__(self, *, queue_limit, t_max, max_new_tokens,
                  degrade_watermark=0.75, degraded_max_new_tokens=None,
-                 clock=time.monotonic, registry=None):
+                 clock=time.monotonic, registry=None, event_log=None):
         if queue_limit < 1:
             raise ValueError(f'queue_limit must be >= 1, got {queue_limit}')
         self.queue_limit = queue_limit
@@ -116,6 +122,7 @@ class AdmissionController:
         self.degraded_max_new_tokens = (degraded_max_new_tokens
                                         or max(1, max_new_tokens // 4))
         self.clock = clock
+        self.event_log = event_log
         self._queue = collections.deque()
         if registry is not None:
             self._c_admit = registry.counter('serve.admitted')
@@ -145,9 +152,21 @@ class AdmissionController:
         if self._g_depth is not None:
             self._g_depth.set(len(self._queue))
 
-    def _reject(self, reason: RejectReason, message: str):
+    def _emit(self, event, **fields):
+        log = (self.event_log if self.event_log is not None
+               else obs_events.get_active())
+        if log is not None:
+            log.emit(event, **fields)
+
+    def _reject(self, reason: RejectReason, message: str,
+                request_id=None):
         if reason in self._c_reject:
             self._c_reject[reason].inc()
+        if request_id is not None:
+            # Submit-time shed: the request's entire recorded lifecycle
+            # is this one typed event.
+            self._emit('serve.reject', request_id=request_id,
+                       reason=reason.value, queued=False)
         raise RejectedError(reason, message)
 
     def reject_count(self, reason: RejectReason):
@@ -163,13 +182,14 @@ class AdmissionController:
         if request.deadline is not None and request.deadline <= now:
             self._reject(RejectReason.DEADLINE_EXCEEDED,
                          f'request {request.id}: deadline already passed '
-                         f'at submit')
+                         f'at submit', request_id=request.id)
         room = self.t_max - len(request.prompt)
         if len(request.prompt) < 1 or room < 1:
             self._reject(RejectReason.PROMPT_TOO_LONG,
                          f'request {request.id}: prompt of '
                          f'{len(request.prompt)} tokens leaves no room '
-                         f'to generate in a t_max={self.t_max} cache')
+                         f'to generate in a t_max={self.t_max} cache',
+                         request_id=request.id)
         request.max_new_tokens = max(1, min(request.max_new_tokens,
                                             self.max_new_tokens, room))
 
@@ -189,7 +209,8 @@ class AdmissionController:
         if self.full:
             self._reject(RejectReason.QUEUE_FULL,
                          f'request {request.id}: queue at limit '
-                         f'{self.queue_limit}')
+                         f'{self.queue_limit}', request_id=request.id)
+        request.queued_since = self.clock()
         self._queue.append(request)
         if self._c_admit is not None:
             self._c_admit.inc()
@@ -199,6 +220,7 @@ class AdmissionController:
         """Requeue already-admitted work (NaN-quarantine retry) at the
         FRONT, bypassing the bound: admitted work is never dropped by
         capacity — that would convert a fault into a silent loss."""
+        request.queued_since = self.clock()
         self._queue.appendleft(request)
         self._update_depth()
 

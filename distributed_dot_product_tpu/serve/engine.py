@@ -51,6 +51,8 @@ from distributed_dot_product_tpu.models.decode import (
     append_kv_slots, decode_step, init_slot_cache, reset_slot,
     slots_all_finite,
 )
+from distributed_dot_product_tpu.obs import spans as obs_spans
+from distributed_dot_product_tpu.obs.spans import span
 
 __all__ = ['KernelEngine']
 
@@ -176,30 +178,45 @@ class KernelEngine:
         return append_kv_slots(cache, k, v, slot_mask=sel, counts=counts)
 
     # -- host surface (numpy in, numpy out) -----------------------------
-    def step(self, tokens, active, poison=None):
+    def step(self, tokens, active, poison=None, request_ids=None):
         """One decode step for all slots. ``tokens (S,) int`` — each
         ACTIVE slot's input token (its previous output, or the last
         prompt token right after prefill); inactive entries ignored.
-        Returns ``(next_tokens (S,), finite (S,))`` numpy arrays."""
+        Returns ``(next_tokens (S,), finite (S,))`` numpy arrays.
+
+        ``request_ids`` (optional, per-slot) is observability-only: it
+        labels the host-side span so a profiler/span tree ties a decode
+        dispatch back to the requests it served — it never reaches the
+        compiled program (strings can't; the program is id-oblivious by
+        design)."""
         poison = (np.zeros(self.slots, bool) if poison is None
                   else np.asarray(poison, bool))
-        self.cache, tok, finite = self._decode(
-            self.cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(active, bool), jnp.asarray(poison))
-        return np.asarray(tok), np.asarray(finite)
+        # Span attrs are built ONLY when spans are on: this is the
+        # per-token hot path, and the disabled default must not pay a
+        # per-step tuple build for labels nobody will read.
+        ids = (tuple(r for r in (request_ids or ()) if r)
+               if obs_spans.enabled() else ())
+        with span('engine.decode_step', requests=ids):
+            self.cache, tok, finite = self._decode(
+                self.cache, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(active, bool), jnp.asarray(poison))
+            return np.asarray(tok), np.asarray(finite)
 
-    def prefill(self, slot, tokens):
+    def prefill(self, slot, tokens, request_id=None):
         """Append one prompt chunk (``len(tokens) <= prefill_chunk``)
         into ``slot``. Pads to the compiled chunk width; padded rows
-        never land (counts mask)."""
+        never land (counts mask). ``request_id`` labels the span only
+        (see :meth:`step`)."""
         n = len(tokens)
         if n > self.prefill_chunk:
             raise ValueError(f'chunk of {n} exceeds prefill_chunk='
                              f'{self.prefill_chunk}')
         buf = np.zeros(self.prefill_chunk, np.int32)
         buf[:n] = np.asarray(tokens, np.int32)
-        self.cache = self._prefill(self.cache, jnp.int32(slot),
-                                   jnp.asarray(buf), jnp.int32(n))
+        with span('engine.prefill', slot=int(slot),
+                  request=request_id or ''):
+            self.cache = self._prefill(self.cache, jnp.int32(slot),
+                                       jnp.asarray(buf), jnp.int32(n))
 
     def reset(self, slot):
         """Evict ``slot`` (zero rows + length); other slots untouched."""

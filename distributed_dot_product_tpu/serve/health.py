@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from distributed_dot_product_tpu.obs import events as obs_events
 from distributed_dot_product_tpu.utils import tracing
 
 __all__ = ['Liveness', 'Readiness', 'HealthMonitor']
@@ -76,7 +77,7 @@ class HealthMonitor:
 
     def __init__(self, *, stall_timeout=2.0, poll_interval=None,
                  registry: Optional[tracing.MetricsRegistry] = None,
-                 on_stall: Optional[Callable] = None):
+                 on_stall: Optional[Callable] = None, event_log=None):
         if stall_timeout <= 0:
             raise ValueError(f'stall_timeout must be > 0, '
                              f'got {stall_timeout}')
@@ -84,6 +85,7 @@ class HealthMonitor:
         self.poll_interval = poll_interval or min(0.05, stall_timeout / 4)
         self.registry = registry or tracing.get_registry()
         self.on_stall = on_stall
+        self.event_log = event_log
         self._lock = threading.Lock()
         self._last_beat: Optional[float] = None
         self._liveness = Liveness.ALIVE
@@ -123,12 +125,21 @@ class HealthMonitor:
         self.stop()
         return False
 
+    def _emit(self, event, **fields):
+        """Transition → the explicit event log, else the active one.
+        NEVER called while holding ``self._lock`` (the log does I/O)."""
+        log = (self.event_log if self.event_log is not None
+               else obs_events.get_active())
+        if log is not None:
+            log.emit(event, **fields)
+
     # -- heartbeat / state ---------------------------------------------
     def beat(self):
         """Scheduler tick heartbeat. Recovers liveness after a stall —
         readiness stays NOT_READY until the scheduler re-asserts it
         (the next readiness update), so recovery is an explicit
         transition, not a silent flag flip."""
+        recovered = False
         with self._lock:
             self._last_beat = time.monotonic()
             if self._liveness is Liveness.STALLED:
@@ -138,6 +149,10 @@ class HealthMonitor:
                 self._transitions.append(
                     (self._last_beat, 'liveness', Liveness.ALIVE.value,
                      'heartbeat resumed'))
+                recovered = True
+        if recovered:
+            self._emit('health.liveness', state=Liveness.ALIVE.value,
+                       reason='heartbeat resumed')
 
     def set_readiness(self, state: Readiness, reason=''):
         with self._lock:
@@ -147,6 +162,7 @@ class HealthMonitor:
             self._g_ready.set(_READINESS_CODE[state])
             self._transitions.append(
                 (time.monotonic(), 'readiness', state.value, reason))
+        self._emit('health.readiness', state=state.value, reason=reason)
 
     @property
     def liveness(self) -> Liveness:
@@ -215,6 +231,8 @@ class HealthMonitor:
                     (time.monotonic(), 'liveness', Liveness.STALLED.value,
                      f'no heartbeat for {age:.2f}s '
                      f'(timeout {self.stall_timeout:.2f}s)'))
+            self._emit('health.liveness', state=Liveness.STALLED.value,
+                       reason=f'no heartbeat for {age:.2f}s')
             self.set_readiness(Readiness.NOT_READY, 'watchdog stall')
             if self.on_stall is not None:
                 try:

@@ -48,6 +48,9 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs import spans as obs_spans
+from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.serve.admission import (
     AdmissionController, RejectReason, Request, RequestResult,
 )
@@ -99,6 +102,7 @@ class _Slot:
     input_token: int = 0
     produced: int = 0
     last_progress: float = 0.0
+    last_token_at: Optional[float] = None   # per-token latency anchor
 
 
 class Scheduler:
@@ -127,18 +131,22 @@ class Scheduler:
                  fault_injector=None, clock=time.monotonic,
                  registry: Optional[tracing.MetricsRegistry] = None,
                  health: Optional[HealthMonitor] = None,
-                 on_tick: Optional[Callable] = None):
+                 on_tick: Optional[Callable] = None, event_log=None):
         self.engine = engine
         self.cfg = config or ServeConfig()
         self.clock = clock
         self.on_tick = on_tick
         self.registry = registry or tracing.get_registry()
+        # Observability event sink: an explicit EventLog, or (when None)
+        # whatever log is ACTIVE at emit time (obs/events.py) — so
+        # `with obs.activate(log):` instruments an existing scheduler.
+        self.event_log = event_log
         self.admission = AdmissionController(
             queue_limit=self.cfg.queue_limit, t_max=engine.t_max,
             max_new_tokens=self.cfg.max_new_tokens,
             degrade_watermark=self.cfg.degrade_watermark,
             degraded_max_new_tokens=self.cfg.degraded_max_new_tokens,
-            clock=clock, registry=self.registry)
+            clock=clock, registry=self.registry, event_log=event_log)
         # None = "consult the env knobs" (a shell faults a real run);
         # False = explicitly unfaulted even when knobs are set (the
         # clean reference run a fault-isolation audit compares against).
@@ -147,9 +155,15 @@ class Scheduler:
             fault_injector = (faults_lib.ServeFaultInjector(plan)
                               if plan.any() else None)
         self.injector = fault_injector or None
+        if self.injector is not None and event_log is not None \
+                and getattr(self.injector, 'event_log', None) is None:
+            # Injections land in the same stream as the lifecycle they
+            # disrupt (the injector alone can't know the sink).
+            self.injector.event_log = event_log
         self.health = health or HealthMonitor(
             stall_timeout=self.cfg.stall_timeout,
-            poll_interval=self.cfg.watchdog_poll, registry=self.registry)
+            poll_interval=self.cfg.watchdog_poll, registry=self.registry,
+            event_log=event_log)
         if self.cfg.watchdog:
             self.health.start()
         self._slots = [_Slot(i) for i in range(engine.slots)]
@@ -164,6 +178,22 @@ class Scheduler:
                     'decode_steps', 'tokens_generated')}
         self._g_active = reg.gauge('serve.active_slots')
         self._h_step = reg.histogram('serve.step_seconds')
+        # Request-timeline histograms: the latency decomposition a
+        # continuous-batching server is judged by. All measured on the
+        # scheduler's own clock and ALSO stamped into the event log, so
+        # `obs.timeline(request_id)` reconstructs the same numbers.
+        self._h_queue = reg.histogram('serve.queue_wait_seconds')
+        self._h_ttft = reg.histogram('serve.ttft_seconds')
+        self._h_token = reg.histogram('serve.token_seconds')
+        self._h_request = reg.histogram('serve.request_seconds')
+
+    def _emit(self, event, **fields):
+        """Into the explicit event log, else the active one, else
+        nowhere (one None-check when observability is off)."""
+        log = (self.event_log if self.event_log is not None
+               else obs_events.get_active())
+        if log is not None:
+            log.emit(event, **fields)
 
     # -- submission surface --------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, deadline=None,
@@ -208,16 +238,32 @@ class Scheduler:
     # -- scheduling internals ------------------------------------------
     def _finalize_request(self, req: Request, status,
                           reason: Optional[RejectReason] = None):
+        finished_at = self.clock()
+        total = max(0.0, finished_at - req.submitted_at)
+        self._h_request.observe(total)
+        if status == 'rejected':
+            # Shed while queued: the timeline ends in a typed reject,
+            # never a retire (it never held a slot).
+            self._emit('serve.reject', request_id=req.id,
+                       reason=reason.value if reason else None,
+                       queued=True)
+        else:
+            self._emit('serve.retire', request_id=req.id, status=status,
+                       reason=reason.value if reason else None,
+                       tokens=len(req.tokens), total_seconds=total)
         self.results[req.id] = RequestResult(
             id=req.id, status=status, tokens=list(req.tokens),
             prompt_len=len(req.prompt), reason=reason,
             requeues=req.requeues, degraded=req.degraded,
-            finished_at=self.clock())
+            finished_at=finished_at)
 
     def _finish(self, slot: _Slot, status,
                 reason: Optional[RejectReason] = None):
         """Retire a slot's request with a terminal status and free the
         slot (rows zeroed — the next sequence starts clean)."""
+        if status == 'evicted':
+            self._emit('serve.evict', request_id=slot.request.id,
+                       slot=slot.index)
         self._finalize_request(slot.request, status, reason)
         if status in self._c:
             self._c[status].inc()
@@ -241,9 +287,15 @@ class Scheduler:
         slot.request = None
         slot.produced = 0
         slot.prefill_pos = 0
-        if req.requeues < self.cfg.max_requeues:
+        requeued = req.requeues < self.cfg.max_requeues
+        self._emit('serve.quarantine', request_id=req.id,
+                   slot=slot.index, requeued=requeued)
+        if requeued:
             req.requeues += 1
             req.tokens = []
+            # The retry regenerates the stream from scratch: its first
+            # token is a fresh TTFT observation, not a token gap.
+            req.first_token_at = None
             self._c['requeued'].inc()
             self.admission.push_front(req)
         else:
@@ -289,7 +341,21 @@ class Scheduler:
             slot.request = req
             slot.produced = 0
             slot.prefill_pos = 0
-            slot.last_progress = self.clock()
+            slot.last_token_at = None
+            now = self.clock()
+            slot.last_progress = now
+            # Queue wait: submit (or quarantine-requeue) → slot. Stamped
+            # into the admit event so the timeline reconstruction and
+            # the histogram agree by construction.
+            queued_since = (req.queued_since if req.queued_since
+                            is not None else req.submitted_at)
+            wait = max(0.0, now - queued_since)
+            req.admitted_at = now
+            self._h_queue.observe(wait)
+            self._emit('serve.admit', request_id=req.id,
+                       slot=slot.index, queue_wait=wait,
+                       prompt_len=len(req.prompt),
+                       requeues=req.requeues)
             if len(req.prompt) == 1:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
@@ -331,9 +397,12 @@ class Scheduler:
                       len(req.prompt) - 1)
             if end > slot.prefill_pos:
                 self.engine.prefill(slot.index,
-                                    req.prompt[slot.prefill_pos:end])
+                                    req.prompt[slot.prefill_pos:end],
+                                    request_id=req.id)
                 slot.prefill_pos = end
                 slot.last_progress = now
+                self._emit('serve.prefill', request_id=req.id,
+                           slot=slot.index, pos=end)
             if slot.prefill_pos >= len(req.prompt) - 1:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
@@ -348,8 +417,16 @@ class Scheduler:
                       if self.injector is not None else None)
             tokens_in = np.array([s.input_token for s in self._slots],
                                  np.int32)
+            # Request-id labels only materialize when spans are on —
+            # the disabled default must stay allocation-free per step.
+            request_ids = ([s.request.id if s.request is not None
+                            else None for s in self._slots]
+                           if obs_spans.enabled() else None)
             t0 = time.perf_counter()
-            toks, finite = self.engine.step(tokens_in, active, poison)
+            with span('serve.decode_step', step=self._step_idx):
+                toks, finite = self.engine.step(tokens_in, active,
+                                                poison,
+                                                request_ids=request_ids)
             self._h_step.observe(time.perf_counter() - t0)
             self.health.beat()   # the step returned: not stuck
             self._c['decode_steps'].inc()
@@ -367,6 +444,23 @@ class Scheduler:
                 slot.input_token = tok
                 slot.last_progress = now
                 self._c['tokens_generated'].inc()
+                # Timeline observations, stamped into the decode event:
+                # TTFT on the stream's first token, inter-token gap on
+                # the rest (both on the scheduler clock).
+                token_fields = dict(request_id=req.id, slot=slot.index,
+                                    token_index=slot.produced - 1,
+                                    token=tok)
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    ttft = max(0.0, now - req.submitted_at)
+                    self._h_ttft.observe(ttft)
+                    token_fields['ttft'] = ttft
+                elif slot.last_token_at is not None:
+                    gap = max(0.0, now - slot.last_token_at)
+                    self._h_token.observe(gap)
+                    token_fields['gap'] = gap
+                slot.last_token_at = now
+                self._emit('serve.decode', **token_fields)
                 if req.cancelled or (
                         self.injector is not None
                         and self.injector.should_abandon(

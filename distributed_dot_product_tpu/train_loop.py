@@ -52,8 +52,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.utils import checkpoint as ckpt
 from distributed_dot_product_tpu.utils import faults as faults_lib
+from distributed_dot_product_tpu.utils import tracing
 from distributed_dot_product_tpu.utils.checkpoint import TrainState
 from distributed_dot_product_tpu.utils.tracing import log_step
 
@@ -92,6 +95,11 @@ class TrainLoopConfig:
     final_save: bool = True
     log_every: int = 0
     history_limit: Optional[int] = 100_000
+    # Observability: when set, the driver publishes a
+    # ``train.tokens_per_s`` gauge (tokens_per_step / measured step
+    # seconds) next to its step/checkpoint histograms — the honest
+    # end-to-end throughput headline for LM training.
+    tokens_per_step: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -192,7 +200,8 @@ def _resume(cfg: TrainLoopConfig, state: TrainState
 def run_training(step_fn: Callable, state: TrainState,
                  batch_fn: Callable, config: TrainLoopConfig, *,
                  on_step: Optional[Callable] = None,
-                 fault_injector=None) -> TrainLoopResult:
+                 fault_injector=None,
+                 registry=None) -> TrainLoopResult:
     """Run the training loop to ``config.num_steps``, surviving
     preemption, NaN/Inf divergence, checkpoint corruption, and transient
     checkpoint I/O failures. See the module docstring for semantics.
@@ -212,8 +221,22 @@ def run_training(step_fn: Callable, state: TrainState,
     .faults.FaultInjector` to wire into both seams (tests); when None,
     the ``DDP_TPU_FAULT_*`` env knobs are consulted so a shell can fault
     a real run.
+
+    ``registry``: metrics sink (default: the process registry). The
+    driver publishes ``train.step_seconds`` and
+    ``train.checkpoint_save_seconds`` histograms, a ``train.tokens_per_s``
+    gauge (when ``config.tokens_per_step`` is set), emits per-step spans
+    (obs/spans.py), and records restore/rollback/checkpoint lifecycle
+    events into the active event log (obs/events.py).
     """
     cfg = config
+    reg = registry or tracing.get_registry()
+    h_step = reg.histogram('train.step_seconds')
+    h_ckpt = reg.histogram('train.checkpoint_save_seconds')
+    # Registered only when configured: an unconditional gauge would
+    # export a permanent 0 that dashboards read as throughput collapse.
+    g_tps = (reg.gauge('train.tokens_per_s') if cfg.tokens_per_step
+             else None)
     if getattr(step_fn, '_ddp_donates', False):
         raise ValueError(
             'run_training needs a non-donating step: it saves and rolls '
@@ -229,6 +252,7 @@ def run_training(step_fn: Callable, state: TrainState,
     params, opt_state = state.params, state.opt_state
     step_i = int(state.step)
     if resumed_from is not None:
+        obs_events.emit('train.restore', step=resumed_from)
         log_step(step_i, float('nan'), force=bool(cfg.log_every),
                  extra=f'[resumed from checkpoint step {resumed_from} '
                        f'under {cfg.ckpt_dir}]')
@@ -264,8 +288,18 @@ def run_training(step_fn: Callable, state: TrainState,
 
     def _do_save(step_now, blocking):
         nonlocal last_saved
-        _save_with_retry(
-            cfg, TrainState(step_now, params, opt_state), blocking=blocking)
+        t0 = time.perf_counter()
+        with span('train.checkpoint_save', step=step_now,
+                  blocking=blocking):
+            _save_with_retry(
+                cfg, TrainState(step_now, params, opt_state),
+                blocking=blocking)
+        seconds = time.perf_counter() - t0
+        # Blocking saves charge the full write; async ones charge the
+        # dispatch — both are the stall the LOOP actually saw.
+        h_ckpt.observe(seconds)
+        obs_events.emit('train.checkpoint_save', step=step_now,
+                        seconds=seconds, blocking=blocking)
         if blocking and cfg.keep_last:
             ckpt.gc_old_steps(cfg.ckpt_dir, cfg.keep_last)
         last_saved = step_now
@@ -304,6 +338,10 @@ def run_training(step_fn: Callable, state: TrainState,
             gnorm = float('nan')
         losses[idx] = loss
         grad_norms[idx] = gnorm
+        seconds = time.perf_counter() - t0
+        h_step.observe(seconds)
+        if g_tps is not None and seconds > 0:
+            g_tps.set(cfg.tokens_per_step / seconds)
         if cfg.history_limit:
             while len(losses) > cfg.history_limit:
                 oldest = next(iter(losses))
@@ -312,7 +350,7 @@ def run_training(step_fn: Callable, state: TrainState,
         force_log = bool(cfg.log_every) and (
             idx % cfg.log_every == 0 or bad)
         log_step(idx, loss, grad_norm=gnorm, bad=bad,
-                 seconds=time.perf_counter() - t0, force=force_log)
+                 seconds=seconds, force=force_log)
         if on_step is not None:
             on_step(idx, {'loss': loss, 'bad_step': bad,
                           'grad_norm': gnorm})
@@ -343,6 +381,8 @@ def run_training(step_fn: Callable, state: TrainState,
                 else:   # no checkpoint yet: the initial state IS it
                     params, opt_state = state0.params, state0.opt_state
                     step_i = int(state0.step)
+                obs_events.emit('train.rollback', step=step_i,
+                                after_bad_steps=cfg.max_bad_steps)
                 log_step(step_i, loss, force=bool(cfg.log_every),
                          extra=f'[rolled back to step {step_i} after '
                                f'{cfg.max_bad_steps} consecutive bad '
@@ -382,8 +422,12 @@ def run_training(step_fn: Callable, state: TrainState,
                     break   # preemption landed while building the batch
                 cur = step_i
                 t0 = time.perf_counter()
-                new_params, new_opt_state, rec = step_fn(
-                    params, opt_state, batch, dropout_seed=cur)
+                # Span around the HOST dispatch of the compiled step
+                # (the device executes async; the record readback in
+                # _process is where the wall time lands).
+                with span('train.step', step=cur):
+                    new_params, new_opt_state, rec = step_fn(
+                        params, opt_state, batch, dropout_seed=cur)
                 step_i = cur + 1
                 if inflight is not None:
                     prev, inflight = inflight, None

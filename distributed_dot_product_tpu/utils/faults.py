@@ -44,6 +44,7 @@ from typing import FrozenSet, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_dot_product_tpu.obs import events as obs_events
 from distributed_dot_product_tpu.utils import checkpoint as _ckpt
 
 __all__ = ['FaultPlan', 'FaultInjector', 'SimulatedCrash', 'plan_from_env',
@@ -191,6 +192,7 @@ class FaultInjector:
         if p.sigterm_at_step is not None and step == p.sigterm_at_step \
                 and not self._sigterm_fired:
             self._sigterm_fired = True
+            obs_events.emit('fault.inject', kind='sigterm', step=step)
             # A REAL signal through the OS, not a direct handler call —
             # the driver's installed handler (and only it) must catch it.
             os.kill(os.getpid(), signal.SIGTERM)
@@ -202,6 +204,7 @@ class FaultInjector:
             if step in self._nan_fired:
                 return False
             self._nan_fired.add(step)
+        obs_events.emit('fault.inject', kind='nan_batch', step=step)
         return True
 
     # -- checkpoint save seam ------------------------------------------
@@ -218,10 +221,14 @@ class FaultInjector:
                 target_dir.name + '.orbax-checkpoint-tmp-0')
             partial.mkdir(parents=True, exist_ok=True)
             (partial / 'partial_write').write_text('simulated crash')
+            obs_events.emit('fault.inject', kind='crash_in_save',
+                            step=_step_of(target_dir))
             raise SimulatedCrash(
                 f'simulated crash mid-save of {target_dir}')
         if self._io_errors_left > 0:
             self._io_errors_left -= 1
+            obs_events.emit('fault.inject', kind='io_error',
+                            step=_step_of(target_dir))
             raise OSError(
                 f'injected transient checkpoint I/O failure '
                 f'({self._io_errors_left} more to come)')
@@ -334,6 +341,10 @@ class ServeFaultInjector:
         self._nan_fired = False
         self._abandon_fired = False
         self.stalls_injected = 0
+        # Observability sink: the scheduler points this at its own
+        # event log so injections land in the same stream as the
+        # lifecycle they disrupt; None falls back to the active log.
+        self.event_log = None
 
     def on_decode_step(self, step):
         p = self.plan
@@ -341,6 +352,9 @@ class ServeFaultInjector:
                 and not (p.fire_once and self._stuck_fired):
             self._stuck_fired = True
             self.stalls_injected += 1
+            obs_events.emit('fault.inject', _log=self.event_log,
+                            kind='stuck_step', step=step,
+                            seconds=p.stuck_seconds)
             time.sleep(p.stuck_seconds)
 
     def poison_slots(self, step, n_slots):
@@ -363,6 +377,8 @@ class ServeFaultInjector:
         if not 0 <= p.nan_slot < n_slots:
             raise ValueError(f'nan_slot {p.nan_slot} out of range for '
                              f'{n_slots} slots')
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='nan_slot', step=step, slot=p.nan_slot)
         return [i == p.nan_slot for i in range(n_slots)]
 
     def should_abandon(self, admit_index, tokens_done):
@@ -372,4 +388,7 @@ class ServeFaultInjector:
                 or (p.fire_once and self._abandon_fired):
             return False
         self._abandon_fired = True
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='abandon', admit_index=admit_index,
+                        tokens_done=tokens_done)
         return True

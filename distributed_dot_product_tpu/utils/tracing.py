@@ -70,21 +70,35 @@ def measure(fn):
     def wrapper(*args, **kwargs):
         if not _debug_enabled():
             return fn(*args, **kwargs)
+        peak_before = device_peak_bytes()
         start = time.perf_counter()
         result = fn(*args, **kwargs)
         traced = ''
         try:
             hard_sync(result)
-        except jax.errors.ConcretizationTypeError:
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError):
             # Tracer under jit/shard_map: only trace time is observable.
             # (Real runtime errors — OOM, RPC failures — propagate.)
+            # Both types named: on jax 0.4.x TracerArrayConversionError
+            # is NOT a ConcretizationTypeError subclass, and the sync
+            # probe's np.asarray raises it.
             traced = ' (traced)'
         elapsed = time.perf_counter() - start
         shapes = [_shape_of(a) for a in args if _shape_of(a) is not None]
-        peak = device_peak_bytes()
-        peak_s = f'{peak / 2 ** 30:.3f} GiB' if peak is not None else 'n/a'
+        # Peak-memory DELTA across the call (before/after readings of
+        # the monotonic peak), matching the reference semantics
+        # (reference functions.py:28 reports max-memory growth per
+        # call) — an absolute peak says nothing about THIS op once any
+        # larger op has run in the process.
+        peak_after = device_peak_bytes()
+        if peak_before is None or peak_after is None:
+            peak_s = 'n/a'
+        else:
+            delta = peak_after - peak_before
+            peak_s = f'+{delta / 2 ** 30:.3f} GiB'
         print(f'[{DEBUG_ENV_VAR}] {fn.__name__}: {elapsed * 1000:.3f} ms'
-              f'{traced} shapes={shapes} peak_mem={peak_s}')
+              f'{traced} shapes={shapes} peak_mem_delta={peak_s}')
         return result
 
     return wrapper
@@ -100,13 +114,29 @@ def log_exception(context, exc, registry=None):
     This is the logging half of the ``silent-except`` lint contract
     (analysis/astlint.py): a broad handler must re-raise, narrow its
     type, or route through here. ``context`` is a short dotted site
-    name (e.g. ``'health.on_stall_callback'``)."""
+    name (e.g. ``'health.on_stall_callback'``).
+
+    When an observability event log is active (obs/events.py), the
+    exception also lands there as an ``exception`` event — swallowed
+    failures share the durable JSONL stream with the serve/train
+    lifecycle they interrupted."""
     reg = registry if registry is not None else _DEFAULT_REGISTRY
     reg.counter('exceptions_swallowed').inc()
     reg.counter(f'exceptions_swallowed.{context}').inc()
+    _emit_event('exception', context=context,
+                type=type(exc).__name__, message=str(exc))
     if _debug_enabled():
         print(f'[{DEBUG_ENV_VAR}] swallowed exception in {context}: '
               f'{type(exc).__name__}: {exc}', flush=True)
+
+
+def _emit_event(event, **fields):
+    """Route into the active observability event log, if any. Lazy
+    import: utils.tracing is imported by nearly everything, so it must
+    not pull the obs package (and its jax import) at module load."""
+    from distributed_dot_product_tpu.obs import events as _events
+    if _events.get_active() is not None:
+        _events.emit(event, **fields)
 
 
 def log_step(step, loss, grad_norm=None, bad=False, seconds=None,
@@ -115,7 +145,18 @@ def log_step(step, loss, grad_norm=None, bad=False, seconds=None,
     ``DISTRIBUTED_DOT_DEBUG`` switch as :func:`measure` (``force=True``
     prints unconditionally — the driver uses it for its periodic log
     cadence). The resilient train loop feeds its per-step
-    ``{loss, bad_step, grad_norm}`` records through here."""
+    ``{loss, bad_step, grad_norm}`` records through here.
+
+    Independently of the print gate, every record is routed into the
+    active observability event log (obs/events.py) when one exists —
+    training history lands in the same durable JSONL stream as the
+    serving lifecycle (``train.step`` + ``train.bad_step``)."""
+    _emit_event('train.step', step=int(step), loss=float(loss),
+                grad_norm=(None if grad_norm is None
+                           else float(grad_norm)),
+                bad=bool(bad), seconds=seconds, extra=extra or None)
+    if bad:
+        _emit_event('train.bad_step', step=int(step), loss=float(loss))
     if not (force or _debug_enabled()):
         return
     parts = [f'step {step}: loss={loss:.6f}']
@@ -298,27 +339,69 @@ class Histogram:
             (p / 100.0) * (len(vals) - 1)))))
         return vals[idx]
 
+    @property
+    def total_count(self):
+        """Lifetime observation count (never ages out)."""
+        return self._count
+
+    @property
+    def total_sum(self):
+        """Lifetime observation sum (never ages out)."""
+        return self._sum
+
     def summary(self):
+        """Reservoir-local ``count``/``mean``/``p50``/``p99``/``max``
+        — ALL five describe the same aged window, so they are mutually
+        consistent (a lifetime mean next to reservoir percentiles would
+        describe two different distributions once anything has aged
+        out) — plus the lifetime ``total_count``/``total_sum`` the
+        Prometheus exporter needs for its cumulative _count/_sum
+        series."""
         with self._lock:
             vals = sorted(self._values)
             count, total = self._count, self._sum
         if not vals:
-            return {'count': count, 'mean': float('nan'),
+            return {'count': 0, 'mean': float('nan'),
                     'p50': float('nan'), 'p99': float('nan'),
-                    'max': float('nan')}
+                    'max': float('nan'),
+                    'total_count': count, 'total_sum': total}
 
         def _pct(p):
             return vals[min(len(vals) - 1,
                             max(0, int(round((p / 100.0)
                                              * (len(vals) - 1)))))]
 
-        return {'count': count, 'mean': total / max(count, 1),
-                'p50': _pct(50), 'p99': _pct(99), 'max': vals[-1]}
+        return {'count': len(vals), 'mean': sum(vals) / len(vals),
+                'p50': _pct(50), 'p99': _pct(99), 'max': vals[-1],
+                'total_count': count, 'total_sum': total}
+
+
+def _metric_key(name, labels):
+    """Internal storage key: the bare name, or ``(name, ((k, v), ...))``
+    with sorted stringified label pairs for labeled metrics."""
+    if not labels:
+        return name
+    return (name, tuple(sorted((str(k), str(v))
+                               for k, v in labels.items())))
+
+
+def _flat_name(key):
+    """Display/JSON form of a storage key: ``name`` or
+    ``name{k=v,...}``."""
+    if isinstance(key, str):
+        return key
+    name, items = key
+    return name + '{' + ','.join(f'{k}={v}' for k, v in items) + '}'
 
 
 class MetricsRegistry:
     """Named metric store with one-call :meth:`snapshot`. Get-or-create
-    accessors, so call sites never coordinate registration order."""
+    accessors, so call sites never coordinate registration order.
+
+    ``labels`` (optional dict on every accessor) keys a separate series
+    per label set under one family name — the Prometheus exporter
+    (obs/exporter.py) renders them as real labels with value escaping;
+    :meth:`snapshot` flattens them to ``name{k=v,...}`` strings."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -326,32 +409,52 @@ class MetricsRegistry:
         self._gauges = {}
         self._histograms = {}
 
-    def counter(self, name) -> Counter:
+    def counter(self, name, labels=None) -> Counter:
         with self._lock:
-            return self._counters.setdefault(name, Counter())
+            return self._counters.setdefault(
+                _metric_key(name, labels), Counter())
 
-    def gauge(self, name) -> Gauge:
+    def gauge(self, name, labels=None) -> Gauge:
         with self._lock:
-            return self._gauges.setdefault(name, Gauge())
+            return self._gauges.setdefault(
+                _metric_key(name, labels), Gauge())
 
-    def histogram(self, name, maxlen=4096) -> Histogram:
+    def histogram(self, name, maxlen=4096, labels=None) -> Histogram:
         with self._lock:
-            return self._histograms.setdefault(name, Histogram(maxlen))
+            return self._histograms.setdefault(
+                _metric_key(name, labels), Histogram(maxlen))
 
-    def snapshot(self):
-        """Plain-dict view: ``{'counters': {name: int}, 'gauges':
-        {name: float}, 'histograms': {name: {count, mean, p50, p99,
-        max}}}`` — JSON-serializable, safe to hand to a health
-        endpoint."""
+    def iter_metrics(self):
+        """Structured iteration for exporters: yields ``(kind, name,
+        labels_dict, value)`` with ``value`` the counter/gauge value or
+        the histogram :meth:`~Histogram.summary` dict. Metric names are
+        iterated from a snapshot of the key tables; each value read is
+        atomic (counters/gauges) or lock-consistent (histograms), so a
+        concurrent writer can never produce a torn read."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
-        return {
-            'counters': {k: c.value for k, c in counters.items()},
-            'gauges': {k: g.value for k, g in gauges.items()},
-            'histograms': {k: h.summary() for k, h in histograms.items()},
-        }
+        for table, kind in ((counters, 'counter'), (gauges, 'gauge'),
+                            (histograms, 'histogram')):
+            for key in sorted(table, key=_flat_name):
+                name = key if isinstance(key, str) else key[0]
+                labels = {} if isinstance(key, str) else dict(key[1])
+                value = (table[key].summary() if kind == 'histogram'
+                         else table[key].value)
+                yield kind, name, labels, value
+
+    def snapshot(self):
+        """Plain-dict view: ``{'counters': {name: int}, 'gauges':
+        {name: float}, 'histograms': {name: {count, mean, p50, p99,
+        max, total_count, total_sum}}}`` — JSON-serializable, safe to
+        hand to a health endpoint. Labeled series flatten to
+        ``name{k=v,...}`` keys."""
+        out = {'counters': {}, 'gauges': {}, 'histograms': {}}
+        for kind, name, labels, value in self.iter_metrics():
+            key = _flat_name(_metric_key(name, labels))
+            out[kind + 's'][key] = value
+        return out
 
     def reset(self):
         with self._lock:

@@ -32,6 +32,7 @@ Faulted soak (what smoke_serve.sh does):
 """
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -41,6 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
+from distributed_dot_product_tpu import obs  # noqa: E402
 from distributed_dot_product_tpu.serve import (  # noqa: E402
     KernelEngine, Readiness, RejectedError, Scheduler, ServeConfig,
 )
@@ -134,6 +136,12 @@ def main(argv=None):
     p.add_argument('--check-identical', action='store_true',
                    help='rerun fault-free and require completed '
                         'streams to match bit for bit')
+    p.add_argument('--event-log',
+                   default=os.environ.get(obs.events.ENV_VAR),
+                   help='write the JSONL observability event log here '
+                        '(default: $DDP_TPU_EVENT_LOG); the audit then '
+                        'additionally requires every request timeline '
+                        'to be reconstructable from the log alone')
     args = p.parse_args(argv)
 
     plan = faults_lib.serve_plan_from_env()
@@ -144,9 +152,18 @@ def main(argv=None):
     if injector is not None:
         print(f'faults armed: {plan}')
 
-    sched, registry, submitted, rejected, results, wall = run_burst(
-        args, fault_injector=injector,
-        deadline_every=args.deadline_every)
+    # The event log captures the FAULTED run only: the --check-identical
+    # clean rerun resubmits the same request ids, and logging both would
+    # double every timeline.
+    event_log = obs.EventLog(args.event_log) if args.event_log else None
+    log_ctx = (obs.activate(event_log) if event_log is not None
+               else contextlib.nullcontext())
+    with log_ctx:
+        sched, registry, submitted, rejected, results, wall = run_burst(
+            args, fault_injector=injector,
+            deadline_every=args.deadline_every)
+    if event_log is not None:
+        event_log.close()
 
     snap = registry.snapshot()
     counters = {k: v for k, v in snap['counters'].items() if v}
@@ -160,6 +177,12 @@ def main(argv=None):
     print(f'counters: {counters}')
     print(f'step latency: p50={lat["p50"] * 1e3:.2f}ms '
           f'p99={lat["p99"] * 1e3:.2f}ms over {lat["count"]} steps')
+    ttft = snap['histograms']['serve.ttft_seconds']
+    queue_wait = snap['histograms']['serve.queue_wait_seconds']
+    if ttft['count']:
+        print(f'request latency: ttft p50={ttft["p50"] * 1e3:.2f}ms '
+              f'p99={ttft["p99"] * 1e3:.2f}ms, queue wait '
+              f'p50={queue_wait["p50"] * 1e3:.2f}ms')
     print(f'throughput: {n_tokens} tokens in {wall:.2f}s '
           f'({n_tokens / max(wall, 1e-9):,.0f} tok/s)')
 
@@ -195,7 +218,30 @@ def main(argv=None):
                 != Readiness.READY.value):
         failures.append(f'readiness not restored to ready before stop: '
                         f'{ready_line}')
-    # 3. Fault isolation: completed streams identical to a clean run.
+    # 3. Event-log reconstruction: every submitted request's complete
+    #    lifecycle (admit→…→retire, or reject/evict with reason) must
+    #    be rebuildable from the JSONL alone — the observability
+    #    layer's acceptance contract.
+    if args.event_log:
+        _, schema_errors = obs.validate_file(args.event_log)
+        for err in schema_errors:
+            failures.append(f'event-log schema: {err}')
+        timelines = obs.reconstruct(args.event_log)
+        unreconstructed = 0
+        for rid, _ in submitted:
+            tl = timelines.get(rid)
+            if tl is None:
+                failures.append(f'{rid}: absent from the event log')
+                unreconstructed += 1
+            elif not tl.complete:
+                failures.append(f'{rid}: incomplete timeline: '
+                                + '; '.join(tl.errors))
+                unreconstructed += 1
+        ok = not unreconstructed and not schema_errors
+        print(f'event-log timeline audit: {"ok" if ok else "FAILED"} '
+              f'({len(submitted) - unreconstructed}/{len(submitted)} '
+              f'requests reconstructed from {args.event_log})')
+    # 4. Fault isolation: completed streams identical to a clean run.
     if args.check_identical:
         _, _, _, rej0, clean, _ = run_burst(args, fault_injector=False,
                                             deadline_every=0)

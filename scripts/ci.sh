@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/3] ruff (generic hygiene) ==='
+echo '=== [1/4] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,15 +27,25 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/3] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/4] graphlint (jaxpr/domain contracts) ==='
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/3] tier-1 tests ==='
+echo '=== [3/4] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider || rc=1
+fi
+
+echo '=== [4/4] smoke serve + event-log schema validation ==='
+# Drives the real serving process through the fault cocktail and then
+# schema-validates + timeline-reconstructs its JSONL event log (the
+# obs validate CLI runs inside smoke_serve.sh over the run's log).
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping smoke-serve stage'
+else
+    scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
 exit $rc

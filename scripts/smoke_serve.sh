@@ -42,7 +42,12 @@ export DDP_TPU_FAULT_NAN_DECODE_STEP=7
 export DDP_TPU_FAULT_NAN_DECODE_SLOT=1
 
 OUT="$(mktemp /tmp/ddp_tpu_smoke_serve.XXXXXX)"
-trap 'rm -f "$OUT"' EXIT
+# Observability event log: the run writes its full serve/health/fault
+# lifecycle here, and the audit below must be able to reconstruct the
+# whole fault cocktail from this file ALONE.
+EVENT_LOG="$(mktemp /tmp/ddp_tpu_smoke_events.XXXXXX.jsonl)"
+export DDP_TPU_EVENT_LOG="$EVENT_LOG"
+trap 'rm -f "$OUT" "$EVENT_LOG" "$EVENT_LOG".[0-9]*' EXIT
 
 echo "== serving soak: burst=$REQUESTS queue_limit=$QUEUE_LIMIT" \
      "+ stuck step + NaN slot"
@@ -62,4 +67,21 @@ grep -q 'bit-identity check against clean rerun: ok' "$OUT" || {
     echo "== smoke_serve FAILED: fault isolation not verified" >&2; exit 1; }
 grep -q 'readiness restored' "$OUT" || {
     echo "== smoke_serve FAILED: readiness not restored" >&2; exit 1; }
-echo "== smoke_serve OK: faults injected, recovered, streams intact"
+grep -q 'event-log timeline audit: ok' "$OUT" || {
+    echo "== smoke_serve FAILED: request timelines not reconstructable" \
+         "from the event log" >&2; exit 1; }
+
+# The fault cocktail must be FULLY reconstructable from the JSONL event
+# log alone: schema-valid records, complete per-request timelines, and
+# every injected fault class + the watchdog's health transitions
+# actually present in the durable stream.
+if ! python -m distributed_dot_product_tpu.obs validate "$EVENT_LOG" \
+        --timelines \
+        --require fault.inject,serve.admit,serve.reject,serve.decode,serve.retire,serve.quarantine,health.liveness,health.readiness
+then
+    echo "== smoke_serve FAILED: event log does not reconstruct the" \
+         "fault cocktail" >&2
+    exit 1
+fi
+echo "== smoke_serve OK: faults injected, recovered, streams intact," \
+     "event log reconstructs the cocktail"

@@ -1,0 +1,23 @@
+"""Seeded span-in-jit regression: an obs span inside a jitted function
+(reads the host clock at TRACE time — the recorded span describes
+compilation, not execution). Spans wrap host-side dispatch only."""
+import jax
+
+from distributed_dot_product_tpu.obs import span, spanned
+
+
+@jax.jit
+def spanned_step(x):
+    with span('step'):           # VIOLATION: clock-in-jit
+        return x * 2
+
+
+@jax.jit
+def decorated_body(x):
+    y = spanned('inner')(lambda v: v + 1)(x)   # VIOLATION
+    return y
+
+
+def fine_host_span(step, x):
+    with span('dispatch'):       # outside jit: NOT flagged
+        return step(x)

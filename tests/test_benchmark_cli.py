@@ -150,3 +150,26 @@ def test_train_mode_window(tmp_path):
                'flash', '--seq-len', '64', '--no-mask', '--causal',
                '--window', '16')
     assert rec['window'] == 16 and rec['step_gflops_per_chip'] > 0
+
+
+def test_metrics_out_snapshot(tmp_path):
+    """--metrics-out writes the observability artifact: the metrics
+    snapshot (serve histograms when the mode drives the scheduler,
+    span-mirror histograms always) plus the phase-span summary."""
+    mpath = tmp_path / 'metrics.json'
+    rec = _run(tmp_path, 'dserve_m', '--mode', 'decode-serve',
+               '--seq-len', '48', '--serve-requests', '4',
+               '--metrics-out', str(mpath))
+    assert rec['completed'] == 4
+    with open(mpath) as f:
+        payload = json.load(f)
+    assert payload['mode'] == 'decode-serve'
+    assert payload['record']['completed'] == 4
+    # Phase spans were collected and mirrored into histograms.
+    assert payload['spans']['benchmark.scheduler_burst']['count'] == 1
+    assert payload['metrics']['histograms'][
+        'span.benchmark.scheduler_burst.seconds']['total_count'] == 1
+    # The scheduler's request-latency decomposition is in the snapshot.
+    hists = payload['metrics']['histograms']
+    assert hists['serve.ttft_seconds']['total_count'] > 0
+    assert hists['serve.queue_wait_seconds']['total_count'] > 0

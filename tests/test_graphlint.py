@@ -75,7 +75,7 @@ def test_registry_covers_every_layer(devices):
         'attention.fwd_ulysses', 'decode.seq_parallel_step',
         'decode.step_xla_slots', 'decode.step_kernel_int8',
         'decode.step_sharded', 'lm.head_bf16', 'lm.loss_f32',
-        'serve.engine_decode', 'train.lm_step',
+        'serve.engine_decode', 'train.lm_step', 'obs.spanned_decode',
     }
     assert expected <= names, f'missing: {expected - names}'
 
@@ -94,6 +94,7 @@ def _expected_lines(path):
     (os.path.join('ops', 'fx_host_pull.py'), 'host-pull'),
     (os.path.join('ops', 'fx_traced_bool.py'), 'traced-bool-branch'),
     ('fx_clock_in_jit.py', 'clock-in-jit'),
+    ('fx_span_in_jit.py', 'clock-in-jit'),
     ('fx_silent_except.py', 'silent-except'),
 ])
 def test_ast_rule_catches_fixture(fixture, rule):

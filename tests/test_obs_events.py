@@ -1,0 +1,256 @@
+# -*- coding: utf-8 -*-
+"""
+Event log (obs/events.py): schema enforcement, crash-safe flushing,
+rotation, the active-log routing from ``log_step``/``log_exception``
+and the fault injectors, and the training driver's lifecycle events.
+"""
+
+import json
+import threading
+
+import pytest
+
+from distributed_dot_product_tpu.obs import events
+from distributed_dot_product_tpu.obs.events import (
+    EventLog, read_events, validate_file, validate_record,
+)
+from distributed_dot_product_tpu.utils.tracing import (
+    MetricsRegistry, log_exception, log_step,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _no_active_log():
+    """Tests control the active log explicitly; never leak one."""
+    prev = events.set_active(None)
+    yield
+    events.set_active(prev)
+
+
+def _log(tmp_path, **kw):
+    return EventLog(tmp_path / 'events.jsonl', **kw)
+
+
+def test_emit_envelope_and_readback(tmp_path):
+    with _log(tmp_path) as log:
+        rec = log.emit('serve.admit', request_id='r0', slot=1,
+                       queue_wait=0.25)
+    (got,) = read_events(tmp_path / 'events.jsonl')
+    assert got == rec
+    assert got['schema'] == events.SCHEMA_VERSION
+    assert got['seq'] == 0 and got['event'] == 'serve.admit'
+    assert validate_record(got) == []
+
+
+def test_unknown_event_and_missing_field_raise(tmp_path):
+    with _log(tmp_path) as log:
+        with pytest.raises(ValueError, match='unknown event'):
+            log.emit('serve.frobnicate', request_id='r0')
+        with pytest.raises(ValueError, match='required field'):
+            log.emit('serve.admit', request_id='r0')   # no slot
+        # Failed emits consume no seq and write no line.
+        log.emit('serve.admit', request_id='r0', slot=0)
+    (got,) = read_events(tmp_path / 'events.jsonl')
+    assert got['seq'] == 0
+
+
+def test_crash_safe_flush_visible_before_close(tmp_path):
+    log = _log(tmp_path)
+    log.emit('health.readiness', state='ready')
+    # No close(): the line must already be durable in the file.
+    (got,) = read_events(tmp_path / 'events.jsonl')
+    assert got['state'] == 'ready'
+    log.close()
+
+
+def test_torn_tail_line_tolerated_elsewhere_rejected(tmp_path):
+    path = tmp_path / 'events.jsonl'
+    with EventLog(path) as log:
+        log.emit('health.readiness', state='ready')
+        log.emit('health.readiness', state='degraded')
+    with open(path, 'a') as f:
+        f.write('{"schema": 1, "seq": 2, "ev')   # crash mid-write
+    recs = read_events(path)
+    assert [r['state'] for r in recs] == ['ready', 'degraded']
+    # The same torn line mid-file is corruption, not a crash tail.
+    lines = open(path).read().splitlines()
+    lines.insert(1, '{"torn')
+    path.write_text('\n'.join(lines) + '\n')
+    with pytest.raises(ValueError, match='corrupt event line'):
+        read_events(path)
+
+
+def test_rotation_keeps_order_and_bounds_files(tmp_path):
+    path = tmp_path / 'events.jsonl'
+    log = EventLog(path, rotate_bytes=600, keep_rotations=2)
+    n = 40
+    for i in range(n):
+        log.emit('train.step', step=i, loss=0.5)
+    log.close()
+    assert log.rotations >= 2
+    files = log.files()
+    assert len(files) <= 3          # live + keep_rotations
+    recs = read_events(path)
+    seqs = [r['seq'] for r in recs]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == n - 1        # newest records always survive
+    # Oldest were dropped by the bound — that is the rotation contract.
+    assert len(recs) < n
+
+
+def test_reopen_continues_seq_series(tmp_path):
+    """A second run appending to the same log must continue seq, not
+    restart at 0 — read_events sorts by seq, so duplicated values would
+    interleave the two runs' records (and corrupt reused-request-id
+    timelines)."""
+    path = tmp_path / 'events.jsonl'
+    with EventLog(path) as log:
+        log.emit('health.readiness', state='ready')
+        log.emit('health.readiness', state='stopped')
+    with open(path, 'a') as f:
+        f.write('{"torn')                      # crash tail survives too
+    with EventLog(path) as log2:
+        rec = log2.emit('health.readiness', state='ready')
+    assert rec['seq'] == 2
+    assert [r['seq'] for r in read_events(path)] == [0, 1, 2]
+
+
+def test_non_finite_floats_serialize_as_strict_json(tmp_path):
+    """NaN losses (the bad-step records a fault log exists for) must
+    not produce bare NaN tokens — spec-compliant JSONL consumers
+    reject those lines."""
+    path = tmp_path / 'events.jsonl'
+    with EventLog(path) as log:
+        log.emit('train.step', step=1, loss=float('nan'), bad=True)
+        log.emit('train.step', step=2, loss=float('inf'),
+                 extra=[float('-inf'), {'x': float('nan')}])
+    raw = path.read_text()
+    assert 'NaN' not in raw and 'Infinity' not in raw
+    # Strict parsers accept every line.
+    recs = [json.loads(line, parse_constant=lambda c: pytest.fail(
+        f'non-strict JSON constant {c}')) for line in raw.splitlines()]
+    assert recs[0]['loss'] == 'nan'
+    assert recs[1]['loss'] == 'inf'
+    assert recs[1]['extra'] == ['-inf', {'x': 'nan'}]
+
+
+def test_validate_file_reports_schema_violations(tmp_path):
+    path = tmp_path / 'events.jsonl'
+    with EventLog(path) as log:
+        log.emit('serve.retire', request_id='r0', status='completed',
+                 tokens=3)
+    with open(path, 'a') as f:
+        f.write(json.dumps({'schema': 99, 'seq': 1, 'ts': 0,
+                            'event': 'serve.admit'}) + '\n')
+    _, errors = validate_file(path)
+    assert any('unknown schema version' in e for e in errors)
+    assert any('missing required field' in e for e in errors)
+
+
+def test_emit_helper_noop_without_active_log(tmp_path):
+    assert events.emit('health.readiness', state='ready') is None
+    with events.activate(_log(tmp_path)) as log:
+        events.emit('health.readiness', state='ready')
+    assert len(read_events(log)) == 1
+
+
+def test_open_from_env(tmp_path):
+    path = tmp_path / 'env.jsonl'
+    assert events.open_from_env({}) is None
+    log = events.open_from_env({events.ENV_VAR: str(path)})
+    log.emit('health.liveness', state='alive')
+    log.close()
+    assert len(read_events(path)) == 1
+
+
+def test_log_step_and_log_exception_route_through_active_log(tmp_path):
+    """The tracing seams share the JSONL stream: per-step training
+    history and swallowed exceptions land as typed events, independent
+    of the debug print gate."""
+    with events.activate(_log(tmp_path)) as log:
+        log_step(3, 0.5, grad_norm=1.25, seconds=0.01)
+        log_step(4, float('nan'), bad=True)
+        log_exception('unit.site', ValueError('boom'),
+                      registry=MetricsRegistry())
+    recs = read_events(log)
+    by_event = {}
+    for r in recs:
+        by_event.setdefault(r['event'], []).append(r)
+    assert by_event['train.step'][0]['step'] == 3
+    assert by_event['train.step'][0]['grad_norm'] == 1.25
+    assert by_event['train.step'][1]['bad'] is True
+    assert by_event['train.bad_step'][0]['step'] == 4
+    (exc,) = by_event['exception']
+    assert exc['context'] == 'unit.site' and exc['type'] == 'ValueError'
+
+
+def test_serve_fault_injector_emits_fault_events(tmp_path):
+    from distributed_dot_product_tpu.utils.faults import (
+        ServeFaultInjector, ServeFaultPlan,
+    )
+    plan = ServeFaultPlan(stuck_at_step=1, stuck_seconds=0.0,
+                          nan_at_step=2, nan_slot=1,
+                          abandon_request=0, abandon_after_tokens=1)
+    inj = ServeFaultInjector(plan)
+    with events.activate(_log(tmp_path)) as log:
+        inj.on_decode_step(0)               # not armed: no event
+        inj.on_decode_step(1)               # stall
+        assert inj.poison_slots(2, 4) == [False, True, False, False]
+        assert inj.should_abandon(0, 1)
+    kinds = [r['kind'] for r in read_events(log)
+             if r['event'] == 'fault.inject']
+    assert kinds == ['stuck_step', 'nan_slot', 'abandon']
+
+
+def test_train_loop_emits_lifecycle_events_and_metrics(tmp_path):
+    """run_training end to end with an event log + registry: per-step
+    records, a NaN bad step, checkpoint saves and the restore on a
+    second run all land in the stream; the step/checkpoint histograms
+    and tokens/s gauge fill."""
+    import jax.numpy as jnp
+
+    from distributed_dot_product_tpu.train_loop import (
+        TrainLoopConfig, run_training,
+    )
+    from distributed_dot_product_tpu.utils.checkpoint import TrainState
+
+    def step_fn(params, opt_state, batch, dropout_seed=0):
+        loss = jnp.mean(batch) + params['w']
+        bad = ~jnp.isfinite(loss)
+        return params, opt_state, {'loss': jnp.where(bad, loss, loss),
+                                   'bad_step': bad,
+                                   'grad_norm': jnp.float32(1.0)}
+
+    def batch_fn(step):
+        if step == 1:
+            return jnp.full((2,), jnp.nan)
+        return jnp.ones((2,)) * step
+
+    def fresh_state():
+        return TrainState(0, {'w': jnp.float32(0.0)},
+                          {'m': jnp.float32(0.0)})
+
+    reg = MetricsRegistry()
+    cfg = TrainLoopConfig(num_steps=3, ckpt_dir=str(tmp_path / 'ckpt'),
+                          ckpt_every=2, max_bad_steps=5,
+                          async_saves=False, tokens_per_step=128)
+    with events.activate(_log(tmp_path)) as log:
+        result = run_training(step_fn, fresh_state(), batch_fn, cfg,
+                              registry=reg)
+        assert result.exit_code == 0
+        # Second run resumes from the final checkpoint -> restore event.
+        run_training(step_fn, fresh_state(), batch_fn, cfg,
+                     registry=reg)
+    recs = read_events(log)
+    kinds = [r['event'] for r in recs]
+    assert kinds.count('train.step') >= 3
+    assert 'train.bad_step' in kinds
+    assert 'train.checkpoint_save' in kinds
+    assert 'train.restore' in kinds
+    snap = reg.snapshot()
+    assert snap['histograms']['train.step_seconds']['total_count'] >= 3
+    assert snap['histograms']['train.checkpoint_save_seconds'][
+        'total_count'] >= 1
+    assert snap['gauges']['train.tokens_per_s'] > 0

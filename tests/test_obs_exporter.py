@@ -1,0 +1,179 @@
+# -*- coding: utf-8 -*-
+"""
+Prometheus exporter (obs/exporter.py): exposition-format validity,
+label escaping, concurrent rendering against live writer threads (the
+scheduler/watchdog shape), and the /metrics + /healthz endpoint.
+"""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_dot_product_tpu.obs.exporter import (
+    MetricsServer, escape_label_value, render_prometheus,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+# One exposition line: name, optional {labels}, value. Label values are
+# quoted strings with \\ \" \n escapes only.
+_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\})?'
+    r' (NaN|[+-]?Inf|[-+0-9.eE]+)$')
+
+
+def _assert_valid_exposition(text):
+    for line in text.rstrip('\n').split('\n'):
+        if not line:
+            continue      # the empty document (no metrics yet)
+        if line.startswith('#'):
+            assert re.match(r'^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ',
+                            line), line
+        else:
+            assert _LINE.match(line), f'invalid exposition line: {line!r}'
+
+
+def test_render_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.counter('serve.admitted').inc(5)
+    reg.gauge('serve.queue_depth').set(3)
+    h = reg.histogram('serve.step_seconds')
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    assert 'ddp_serve_admitted_total 5' in text
+    assert 'ddp_serve_queue_depth 3' in text
+    assert 'ddp_serve_step_seconds{quantile="0.5"} 0.2' in text
+    assert 'ddp_serve_step_seconds_count 3' in text
+    assert re.search(r'ddp_serve_step_seconds_sum 0\.6\d*', text)
+    assert '# TYPE ddp_serve_step_seconds summary' in text
+
+
+def test_label_escaping_round_trip():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    reg = MetricsRegistry()
+    reg.counter('serve.rejected',
+                labels={'reason': 'queue "full"\nline'}).inc()
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    assert ('ddp_serve_rejected_total'
+            '{reason="queue \\"full\\"\\nline"} 1') in text
+
+
+def test_histogram_empty_renders_nan_quantiles():
+    reg = MetricsRegistry()
+    reg.histogram('empty.h')
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    assert 'ddp_empty_h{quantile="0.5"} NaN' in text
+    assert 'ddp_empty_h_count 0' in text
+
+
+def test_concurrent_export_no_torn_reads():
+    """Writer threads (counters + histograms, the scheduler/watchdog
+    write pattern) hammer the registry while a reader renders: every
+    render is valid exposition text, counter values are monotonic
+    across renders, and the final render shows the exact totals."""
+    reg = MetricsRegistry()
+    n_writers, n_incs = 4, 300
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        c = reg.counter('unit.work')
+        labeled = reg.counter('unit.by_thread', labels={'t': str(i)})
+        h = reg.histogram('unit.latency')
+        for k in range(n_incs):
+            c.inc()
+            labeled.inc()
+            h.observe(k * 1e-4)
+
+    def reader():
+        last = 0
+        while not stop.is_set():
+            text = render_prometheus(reg)
+            try:
+                _assert_valid_exposition(text)
+            except AssertionError as e:
+                errors.append(e)
+                return
+            m = re.search(r'^ddp_unit_work_total (\d+)$', text,
+                          re.MULTILINE)
+            if m:
+                value = int(m.group(1))
+                if value < last:
+                    errors.append(
+                        AssertionError(f'counter went backwards: '
+                                       f'{value} < {last}'))
+                    return
+                last = value
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_writers)]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    assert not errors, errors[0]
+    final = render_prometheus(reg)
+    assert f'ddp_unit_work_total {n_writers * n_incs}' in final
+    for i in range(n_writers):
+        assert f'ddp_unit_by_thread_total{{t="{i}"}} {n_incs}' in final
+    assert (f'ddp_unit_latency_count {n_writers * n_incs}'
+            in final)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_metrics_and_healthz_endpoints():
+    from distributed_dot_product_tpu.serve.health import (
+        HealthMonitor, Readiness,
+    )
+    reg = MetricsRegistry()
+    reg.counter('serve.admitted').inc(2)
+    mon = HealthMonitor(stall_timeout=5.0, registry=reg)
+    with MetricsServer(reg, health=mon) as srv:
+        # STARTING: not yet safe for traffic.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/healthz')
+        assert exc.value.code == 503
+        mon.beat()
+        mon.set_readiness(Readiness.READY, 'serving')
+        code, body = _get(srv.url + '/healthz')
+        assert code == 200
+        snap = json.loads(body)
+        assert snap['readiness'] == 'ready'
+        assert snap['metrics']['counters']['serve.admitted'] == 2
+        code, text = _get(srv.url + '/metrics')
+        assert code == 200
+        _assert_valid_exposition(text)
+        assert 'ddp_serve_admitted_total 2' in text
+        # DEGRADED still serves traffic.
+        mon.set_readiness(Readiness.DEGRADED, 'pressure')
+        code, _ = _get(srv.url + '/healthz')
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/nope')
+        assert exc.value.code == 404
+
+
+def test_server_without_health_monitor_is_ok():
+    reg = MetricsRegistry()
+    with MetricsServer(reg) as srv:
+        code, body = _get(srv.url + '/healthz')
+        assert code == 200 and json.loads(body)['health'] is None

@@ -1,0 +1,178 @@
+# -*- coding: utf-8 -*-
+"""
+Span layer (obs/spans.py): nesting, thread isolation, the zero-overhead
+disabled path, decorator semantics, and the metrics-registry mirror.
+"""
+
+import threading
+
+import pytest
+
+from distributed_dot_product_tpu.obs import spans
+from distributed_dot_product_tpu.obs.spans import (
+    SpanCollector, collecting, span, spanned,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_collector():
+    """Each test starts disabled with an empty buffer and leaves no
+    global enablement behind."""
+    col = spans.get_collector()
+    prev = (col.enabled, col.registry)
+    col.enabled = False
+    col.registry = None
+    col.clear()
+    yield col
+    col.enabled, col.registry = prev
+    col.clear()
+
+
+def test_disabled_span_is_shared_null_object():
+    """The disabled path allocates nothing: every span() call returns
+    the SAME null context manager (no clock read, no record)."""
+    a, b = span('x'), span('y', attr=1)
+    assert a is b
+    with a:
+        pass
+    assert spans.get_collector().records() == []
+
+
+def test_nesting_builds_paths_and_depths():
+    with collecting() as col:
+        with span('outer'):
+            with span('inner'):
+                pass
+            with span('inner2'):
+                pass
+    recs = {r.name: r for r in col.records()}
+    assert recs['inner'].path == 'outer/inner'
+    assert recs['inner'].depth == 1
+    assert recs['inner2'].path == 'outer/inner2'
+    assert recs['outer'].path == 'outer'
+    assert recs['outer'].depth == 0
+    # Children finish before the parent; durations nest.
+    assert recs['outer'].seconds >= recs['inner'].seconds
+    assert all(r.ok for r in col.records())
+
+
+def test_span_records_exception_and_propagates():
+    with collecting() as col:
+        with pytest.raises(ValueError):
+            with span('boom'):
+                raise ValueError('x')
+    (rec,) = col.records()
+    assert rec.name == 'boom' and not rec.ok
+    # The stack unwound: a following span is top-level again.
+    with collecting() as col2:
+        with span('after'):
+            pass
+    assert col2.records()[-1].depth == 0
+
+
+def test_attrs_recorded():
+    with collecting() as col:
+        with span('s', step=3, kind='decode'):
+            pass
+    (rec,) = col.records()
+    assert dict(rec.attrs) == {'step': 3, 'kind': 'decode'}
+
+
+def test_decorator_rechecks_enablement_per_call():
+    calls = []
+
+    @spanned('unit.work')
+    def work(x):
+        calls.append(x)
+        return x * 2
+
+    assert work(2) == 4                       # disabled: plain call
+    assert spans.get_collector().records() == []
+    with collecting() as col:
+        assert work(3) == 6                   # enabled later: recorded
+    assert [r.name for r in col.records()] == ['unit.work']
+    assert calls == [2, 3]
+
+
+def test_decorator_default_name_is_qualname():
+    @spanned()
+    def some_phase():
+        return 1
+
+    with collecting() as col:
+        some_phase()
+    (rec,) = col.records()
+    assert 'some_phase' in rec.name
+
+
+def test_thread_isolated_nesting():
+    """Two threads nesting concurrently never see each other's stack:
+    every recorded path is one of the two legal per-thread shapes."""
+    errors = []
+
+    def worker(tag):
+        try:
+            for _ in range(50):
+                with span(f'{tag}.outer'):
+                    with span(f'{tag}.inner'):
+                        pass
+        # Collected and re-asserted on the main thread — not swallowed.
+        except Exception as e:   # graphlint: allow[silent-except]
+            errors.append(e)
+
+    with collecting() as col:
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in ('a', 'b')]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    for rec in col.records():
+        tag = rec.name.split('.')[0]
+        assert rec.path in (f'{tag}.outer', f'{tag}.outer/{tag}.inner')
+
+
+def test_registry_mirror_histograms():
+    reg = MetricsRegistry()
+    with collecting(registry=reg):
+        for _ in range(3):
+            with span('phase.compile'):
+                pass
+    snap = reg.snapshot()['histograms']
+    assert snap['span.phase.compile.seconds']['total_count'] == 3
+
+
+def test_collector_summary_and_render():
+    col = SpanCollector()
+    col.enabled = True
+    # Use a private collector via the record API (not the global).
+    from distributed_dot_product_tpu.obs.spans import _LiveSpan
+    with _LiveSpan('a', {}, col):
+        with _LiveSpan('b', {}, col):
+            pass
+    summary = col.summary()
+    assert summary['a']['count'] == 1 and summary['b']['count'] == 1
+    text = col.render()
+    assert 'b:' in text and text.splitlines()[0].startswith('  ')
+
+
+def test_engine_step_spans_carry_request_ids(devices):
+    """The request-id threading contract: engine.step's span names the
+    requests it served (observability only — never reaches the compiled
+    program)."""
+    import numpy as np
+
+    from distributed_dot_product_tpu.serve.engine import KernelEngine
+
+    eng = KernelEngine(slots=2, t_max=8, decode_impl='xla')
+    with collecting() as col:
+        eng.step(np.zeros(2, np.int32), np.ones(2, bool),
+                 request_ids=['r1', None])
+        eng.prefill(0, np.asarray([1], np.int32), request_id='r1')
+    by_name = {r.name: r for r in col.records()}
+    assert dict(by_name['engine.decode_step'].attrs)['requests'] == ('r1',)
+    assert dict(by_name['engine.prefill'].attrs)['request'] == 'r1'

@@ -1,0 +1,243 @@
+# -*- coding: utf-8 -*-
+"""
+The observability layer's acceptance scenario (tier-1): drive the
+scheduler through the existing fault cocktail (stuck step + NaN slot +
+queue-overflow burst) with an event log attached, then
+
+- reconstruct EVERY submitted request's complete timeline
+  (admit→…→retire, or reject/evict with a reason) from the JSONL event
+  log ALONE;
+- require the /metrics endpoint (and the rendered exposition text) to
+  expose nonzero TTFT, queue-wait and per-token latency histograms;
+- require the injected faults and health transitions to be present in
+  the same durable stream.
+
+Plus timeline-unit cases for the lifecycle validator itself.
+"""
+
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs.events import EventLog, validate_file
+from distributed_dot_product_tpu.obs.exporter import (
+    MetricsServer, render_prometheus,
+)
+from distributed_dot_product_tpu.obs.timeline import reconstruct, timeline
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, RejectedError, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+SLOTS, T_MAX, VOCAB = 3, 32, 16
+
+
+def _burst(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return [(f'r{i:03d}',
+             rng.integers(0, VOCAB,
+                          size=int(rng.integers(1, 7))).astype(np.int32))
+            for i in range(n)]
+
+
+def _run_cocktail(log, n=14):
+    """The test_serve_soak fault cocktail, instrumented: stuck step
+    (watchdog), NaN slot (quarantine), burst > queue (typed shed)."""
+    plan = ServeFaultPlan(stuck_at_step=3, stuck_seconds=0.4,
+                          nan_at_step=5, nan_slot=1)
+    registry = MetricsRegistry()
+    sched = Scheduler(
+        KernelEngine(slots=SLOTS, t_max=T_MAX, vocab=VOCAB, heads=2,
+                     head_dim=4, prefill_chunk=4, seed=5,
+                     decode_impl='xla'),
+        ServeConfig(queue_limit=4, max_new_tokens=4, stall_timeout=0.12,
+                    watchdog_poll=0.02, evict_before_reject=False),
+        fault_injector=ServeFaultInjector(plan), registry=registry,
+        event_log=log)
+    rejected = {}
+    for i, (rid, prompt) in enumerate(_burst(n)):
+        try:
+            sched.submit(prompt, request_id=rid)
+        except RejectedError as e:
+            rejected[rid] = e.reason
+        if i % 3 == 2:
+            sched.step()
+    results = sched.run_until_idle()
+    sched.close()
+    return sched, registry, rejected, results
+
+
+def test_fault_cocktail_fully_reconstructable_from_event_log(tmp_path,
+                                                             devices):
+    n = 14
+    log = EventLog(tmp_path / 'serve.jsonl')
+    sched, registry, rejected, results = _run_cocktail(log, n)
+    log.close()
+
+    # Schema-clean log.
+    records, errors = validate_file(log.path)
+    assert errors == [], errors
+    assert records, 'no events recorded'
+
+    # EVERY submitted request reconstructs, complete, from JSONL alone.
+    timelines = reconstruct(log.path)
+    for rid, _ in _burst(n):
+        tl = timelines.get(rid)
+        assert tl is not None, f'{rid}: absent from the event log'
+        assert tl.complete, f'{rid}: {tl.errors}'
+        if rid in rejected:
+            assert tl.status == 'rejected'
+            assert tl.reason == rejected[rid].value
+        else:
+            # Admitted: the log agrees with the in-process result.
+            assert tl.status == results[rid].status
+            assert tl.tokens >= len(results[rid].tokens)
+            assert tl.queue_wait is not None
+    # The injected faults are in the same durable stream.
+    kinds = {r.get('kind') for r in records
+             if r['event'] == 'fault.inject'}
+    assert {'stuck_step', 'nan_slot'} <= kinds
+    assert any(r['event'] == 'serve.quarantine' for r in records)
+    states = [r['state'] for r in records
+              if r['event'] == 'health.liveness']
+    assert 'stalled' in states and 'alive' in states
+
+    # The quarantined request's timeline shows the full recovery arc.
+    (qrid,) = {r['request_id'] for r in records
+               if r['event'] == 'serve.quarantine'}
+    qtl = timelines[qrid]
+    assert qtl.quarantines == 1 and qtl.admits == 2
+    assert qtl.status == 'completed'
+
+    # Latency histograms: nonzero ttft / queue-wait / per-token.
+    snap = registry.snapshot()['histograms']
+    for name in ('serve.ttft_seconds', 'serve.queue_wait_seconds',
+                 'serve.token_seconds'):
+        h = snap[name]
+        assert h['total_count'] > 0, name
+        assert h['total_sum'] > 0, name
+
+    # ...and they are exposed over /metrics as valid families.
+    with MetricsServer(registry, health=sched.health) as srv:
+        with urllib.request.urlopen(srv.url + '/metrics',
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+    for fam in ('ddp_serve_ttft_seconds', 'ddp_serve_queue_wait_seconds',
+                'ddp_serve_token_seconds'):
+        m = re.search(rf'^{fam}_sum ([0-9.eE+-]+)$', text, re.MULTILINE)
+        assert m is not None, f'{fam} missing from /metrics'
+        assert float(m.group(1)) > 0, f'{fam} empty'
+    assert render_prometheus(registry) == text
+
+
+def test_timeline_helper_on_missing_request(tmp_path):
+    log = EventLog(tmp_path / 'x.jsonl')
+    log.emit('serve.admit', request_id='r0', slot=0)
+    log.close()
+    tl = timeline('never-submitted', log.path)
+    assert not tl.complete and tl.errors == ['no events recorded']
+
+
+def test_timeline_validator_rejects_broken_lifecycles():
+    def tl_of(recs):
+        for i, r in enumerate(recs):
+            r.setdefault('seq', i)
+            r.setdefault('ts', float(i))
+            r.setdefault('schema', 1)
+        return reconstruct(recs)
+
+    # Decode without an admit.
+    tls = tl_of([{'event': 'serve.decode', 'request_id': 'a', 'slot': 0,
+                  'token_index': 0},
+                 {'event': 'serve.retire', 'request_id': 'a',
+                  'status': 'completed'}])
+    assert not tls['a'].complete
+    assert any('without a slot' in e for e in tls['a'].errors)
+
+    # No terminal event.
+    tls = tl_of([{'event': 'serve.admit', 'request_id': 'b', 'slot': 0}])
+    assert not tls['b'].complete
+    assert any('no terminal event' in e for e in tls['b'].errors)
+
+    # Retire(evicted) demands a serve.evict record.
+    tls = tl_of([{'event': 'serve.admit', 'request_id': 'c', 'slot': 0},
+                 {'event': 'serve.retire', 'request_id': 'c',
+                  'status': 'evicted'}])
+    assert any('serve.evict' in e for e in tls['c'].errors)
+
+    # The clean arc passes, including quarantine + readmit.
+    tls = tl_of([
+        {'event': 'serve.admit', 'request_id': 'd', 'slot': 0,
+         'queue_wait': 0.1},
+        {'event': 'serve.decode', 'request_id': 'd', 'slot': 0,
+         'token_index': 0, 'ttft': 0.5},
+        {'event': 'serve.quarantine', 'request_id': 'd', 'slot': 0,
+         'requeued': True},
+        {'event': 'serve.admit', 'request_id': 'd', 'slot': 1,
+         'queue_wait': 0.2},
+        {'event': 'serve.decode', 'request_id': 'd', 'slot': 1,
+         'token_index': 0, 'ttft': 0.9},
+        {'event': 'serve.decode', 'request_id': 'd', 'slot': 1,
+         'token_index': 1, 'gap': 0.01},
+        {'event': 'serve.retire', 'request_id': 'd',
+         'status': 'completed', 'total_seconds': 1.0},
+    ])
+    tl = tls['d']
+    assert tl.complete, tl.errors
+    assert tl.admits == 2 and tl.quarantines == 1 and tl.tokens == 3
+    assert tl.queue_wait == 0.1 and tl.ttft == 0.5
+    assert tl.token_gaps == [0.01]
+    assert tl.phases()['total'] == 1.0
+
+
+def test_eviction_timeline_reconstructs(tmp_path, devices):
+    """Eviction path: the ladder frees the longest-idle slot; the log
+    must show evict + retire(evicted) for the victim."""
+    log = EventLog(tmp_path / 'evict.jsonl')
+    registry = MetricsRegistry()
+    sched = Scheduler(
+        KernelEngine(slots=1, t_max=T_MAX, vocab=VOCAB, heads=2,
+                     head_dim=4, prefill_chunk=4, seed=5,
+                     decode_impl='xla'),
+        ServeConfig(queue_limit=1, max_new_tokens=6, watchdog=False,
+                    evict_before_reject=True, min_evict_idle=0.0),
+        fault_injector=False, registry=registry, event_log=log)
+    sched.submit(np.array([1, 2], np.int32), request_id='victim')
+    sched.step()                     # victim occupies the slot
+    sched.submit(np.array([3], np.int32), request_id='queued')
+    sched.submit(np.array([4], np.int32), request_id='usurper')
+    sched.run_until_idle()
+    sched.close()
+    log.close()
+    tls = reconstruct(log.path)
+    assert tls['victim'].status == 'evicted'
+    assert tls['victim'].complete, tls['victim'].errors
+    for rid in ('queued', 'usurper'):
+        assert tls[rid].complete and tls[rid].status == 'completed'
+
+
+def test_scheduler_uses_active_log_when_none_passed(tmp_path, devices):
+    """`with obs.activate(log):` instruments a scheduler constructed
+    without an explicit event_log — the integration serve_lm.py and
+    smoke_serve.sh rely on."""
+    log = EventLog(tmp_path / 'active.jsonl')
+    with obs_events.activate(log):
+        sched = Scheduler(
+            KernelEngine(slots=1, t_max=16, vocab=VOCAB, heads=2,
+                         head_dim=4, seed=5, decode_impl='xla'),
+            ServeConfig(queue_limit=2, max_new_tokens=2,
+                        watchdog=False),
+            fault_injector=False, registry=MetricsRegistry())
+        sched.submit(np.array([1], np.int32), request_id='r')
+        sched.run_until_idle()
+        sched.close()
+    log.close()
+    assert reconstruct(log.path)['r'].complete
