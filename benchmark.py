@@ -318,6 +318,7 @@ def run_attn(args):
         'dist_gflops_per_chip': flops / world / best / 1e9,
         'dist_peak_bytes_per_chip': peak,
         'dist_memory_analysis': _memory_analysis(timed),
+        'perf_model': _perf_model(timed, best),
     }
     gq = '' if h_kv == h else f'/kv{h_kv}'
     print(f"attn[{args.attn_impl}] T={t} H={h}{gq} d={d} {world}-device: "
@@ -325,6 +326,18 @@ def run_attn(args):
           + (f", peak {peak / 2**30:.2f} GiB)" if peak else ")"))
     _append_record(args.file, record)
     return record
+
+
+def _perf_model(compiled, measured_seconds=None):
+    """Compiler-counted model-vs-measured columns for a timed program
+    (obs/perf.py): XLA's own FLOP/byte accounting, arithmetic
+    intensity, the compute-vs-bandwidth roofline class, and — when a
+    measured time is passed — achieved GFLOP/s / GB/s over the
+    compiler-counted work plus the fraction of roofline reached. None
+    on backends without cost analysis; every record stays
+    self-explaining without it."""
+    from distributed_dot_product_tpu.obs.perf import program_model
+    return program_model(compiled, measured_seconds=measured_seconds)
 
 
 def _memory_analysis(compiled):
@@ -465,6 +478,7 @@ def measure_train_step(*, seq_len, attn_impl='flash', dtype='bf16',
         'step_time': best, 'step_time_mean': mean,
         'step_gflops_per_chip': flops / world / best / 1e9,
         'memory_analysis': _memory_analysis(compiled),
+        'perf_model': _perf_model(compiled, best),
     }
 
 
@@ -543,6 +557,7 @@ def measure_lm_step(*, seq_len, n_layers=8, vocab=32768, dtype='bf16',
         'tokens_per_s': t / best,
         'step_gflops_per_chip': 3.0 * fwd / world / best / 1e9,
         'memory_analysis': _memory_analysis(compiled),
+        'perf_model': _perf_model(compiled, best),
     }
 
 
@@ -684,9 +699,9 @@ def run_decode(args):
     # without donation an MHA 131K-cache step pays ~1 ms of pure copy.
     chain = max(1, args.decode_chain)
     if chain == 1:
-        step = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
-                                                    method='decode'),
-                       donate_argnums=(2,))
+        jitted = jax.jit(lambda p, xt, c: model.apply(p, xt, xt, xt, c,
+                                                      method='decode'),
+                         donate_argnums=(2,))
     else:
         # Chained decode: `chain` tokens per dispatch via lax.scan — the
         # per-dispatch overhead (~0.14 ms on the tunneled chip) divides
@@ -702,7 +717,13 @@ def run_decode(args):
             c, outs = jax.lax.scan(body, c, None, length=chain)
             return c, outs
 
-        step = jax.jit(chained, donate_argnums=(2,))
+        jitted = jax.jit(chained, donate_argnums=(2,))
+    # AOT-compile the step once (the same executable feeds the timing
+    # loop and the cost/roofline model — a jit dispatch would hide the
+    # compiled object the model needs). Donation declared on the jit
+    # carries through to the compiled callable.
+    with span('benchmark.compile', mode='decode'):
+        step = jitted.lower(params, tok, cache).compile()
     cache_box = [cache]
 
     def timed(p, xt):
@@ -800,6 +821,12 @@ def run_decode(args):
                        else prefill_time * 1e3),
         'ttft_ms': (None if prefill_time is None
                     else (prefill_time + step_time) * 1e3),
+        # Model-vs-measured over ONE dispatch (= `chain` decode steps):
+        # the compiler-counted bytes next to the analytic cache_gb_per_s
+        # column, and the roofline class (decode should read
+        # bandwidth-bound — if it ever flips, the step stopped
+        # streaming the cache).
+        'perf_model': _perf_model(step, best),
     }
     gq = '' if h_kv == h else f'/kv{h_kv}'
     bc = '' if (b == 1 and chain == 1) else f' B={b} chain={chain}'
@@ -876,6 +903,21 @@ def run_decode_serve(args):
     n_steps = n_rounds * steps_per_seq
     bare_tps = slots * n_steps / bare_s
 
+    # Cost/roofline model of the decode program both measurements
+    # drive (the engine's one compiled step): AOT-lower the exact
+    # jitted callable the engine holds, measured time = the bare
+    # loop's per-step wall time.
+    with span('benchmark.compile', mode='decode-serve'):
+        try:
+            step_model = _perf_model(
+                eng._decode.lower(
+                    eng.cache, jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(active), jnp.zeros(slots, bool)
+                ).compile(),
+                bare_s / n_steps)
+        except Exception:
+            step_model = None   # model is additive, never fatal
+
     # Time-to-first-token through the engine surface: chunked prefill
     # of one prompt + the first decode step, host-clocked on warm
     # compiled programs — what a request admitted to an idle slot waits
@@ -937,6 +979,7 @@ def run_decode_serve(args):
         'ttft_ms': ttft_s * 1e3,
         'completed': sum(r.status == 'completed'
                          for r in results.values()),
+        'perf_model': step_model,
     }
     print(f"decode-serve[{impl_resolved}] slots={slots} t_max={t_max} "
           f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
@@ -1030,6 +1073,7 @@ def run(args):
         dist_gflops_per_chip=flops / world / best / 1e9,
         dist_peak_bytes_per_chip=peak,
         dist_memory_analysis=_memory_analysis(fn),
+        perf_model=_perf_model(fn, best),
     )
     print(f"dist {world}-device {args.mode} offset={args.offset} "
           f"impl={args.impl}: {best:.4f}s "
@@ -1047,12 +1091,22 @@ def _write_metrics_out(args, record):
     snapshot (histograms carry reservoir percentiles + lifetime
     totals), the phase-span summary/tree, and the result record —
     enough to answer "where did this run's wall time go" offline."""
+    from distributed_dot_product_tpu.obs.devmon import (
+        device_stats_snapshot,
+    )
     payload = {
         'mode': args.mode,
         'record': record,
+        # Cost/roofline model duplicated at top level so the artifact
+        # is self-explaining even when the record nests it deep.
+        'perf_model': record.get('perf_model'),
         'metrics': tracing.metrics(),
         'spans': obs_spans.get_collector().summary(),
         'span_tree': obs_spans.get_collector().render().splitlines(),
+        # memory_stats() of every visible device at artifact-write time
+        # (None per device on backends without stats — e.g. this CPU
+        # mesh; real on TPU, where it answers "how full was the chip").
+        'devices': device_stats_snapshot(),
     }
     with open(args.metrics_out, 'w') as f:
         json.dump(payload, f, indent=2, default=str)

@@ -74,13 +74,13 @@ def main(argv=None):
 
     entrypoints = None
     if args.registry:
-        import importlib
-        modpath, _, attr = args.registry.partition(':')
-        if not attr:
-            parser.error('--registry takes MODULE:ATTR')
-        entrypoints = getattr(importlib.import_module(modpath), attr)
-        if callable(entrypoints):
-            entrypoints = entrypoints()
+        from distributed_dot_product_tpu.analysis.registry import (
+            resolve_registry_arg,
+        )
+        try:
+            entrypoints = resolve_registry_arg(args.registry)
+        except ValueError as e:
+            parser.error(str(e))
 
     from distributed_dot_product_tpu.analysis import run_analysis
     violations = run_analysis(

@@ -31,7 +31,8 @@ import dataclasses
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
-__all__ = ['TraceSpec', 'default_entrypoints', 'LAYER_HOOKS']
+__all__ = ['TraceSpec', 'default_entrypoints', 'resolve_registry_arg',
+           'LAYER_HOOKS']
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +78,19 @@ LAYER_HOOKS = (
     'distributed_dot_product_tpu.train',
     'distributed_dot_product_tpu.obs',
 )
+
+
+def resolve_registry_arg(arg):
+    """``MODULE:ATTR`` → a ``{name: builder}`` mapping (callables are
+    called) — the shared ``--registry`` escape hatch of the graphlint
+    and perf CLIs, in one place so the contract cannot drift. Raises
+    ValueError on a malformed argument."""
+    import importlib
+    modpath, _, attr = arg.partition(':')
+    if not attr:
+        raise ValueError('--registry takes MODULE:ATTR')
+    obj = getattr(importlib.import_module(modpath), attr)
+    return obj() if callable(obj) else obj
 
 
 def default_entrypoints():

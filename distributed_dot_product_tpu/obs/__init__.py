@@ -16,14 +16,25 @@ functions.py:24-41) and the in-process ``MetricsRegistry``
   lifecycle reconstruction over the event log.
 - :mod:`~distributed_dot_product_tpu.obs.exporter` — Prometheus-text
   rendering of the metrics registry plus the optional ``/metrics`` +
-  ``/healthz`` HTTP thread (off by default).
+  ``/healthz`` + ``/profile`` HTTP thread (off by default).
+- :mod:`~distributed_dot_product_tpu.obs.perf` — compiled-program
+  cost/roofline accounting over the analysis registry and the
+  perf-regression gate (``python -m distributed_dot_product_tpu.obs.
+  perf {snapshot,check,report}``; scripts/ci.sh stage [5/5]).
+- :mod:`~distributed_dot_product_tpu.obs.devmon` — live device-memory
+  telemetry gauges and guarded on-demand ``jax.profiler`` captures.
 
 CLI: ``python -m distributed_dot_product_tpu.obs validate <log.jsonl>``
-schema-checks a log offline; ``... timeline <log.jsonl> <request-id>``
-prints one request's reconstructed lifecycle (scripts/ci.sh and
-scripts/smoke_serve.sh drive both).
+schema-checks a log offline; ``... stats <log.jsonl>`` summarizes it
+operationally; ``... timeline <log.jsonl> <request-id>`` prints one
+request's reconstructed lifecycle (scripts/ci.sh and
+scripts/smoke_serve.sh drive them).
 """
 
+from distributed_dot_product_tpu.obs.devmon import (  # noqa: F401
+    CaptureInFlight, DeviceMonitor, ProfileCapture,
+    device_stats_snapshot,
+)
 from distributed_dot_product_tpu.obs.events import (  # noqa: F401
     EVENT_SCHEMA, SCHEMA_VERSION, EventLog, activate, emit, get_active,
     open_from_env, read_events, set_active, validate_file,
@@ -45,7 +56,8 @@ __all__ = [
     'validate_file', 'MetricsServer', 'render_prometheus',
     'SpanCollector', 'SpanRecord', 'collecting', 'enable', 'enabled',
     'get_collector', 'span', 'spanned', 'Timeline', 'reconstruct',
-    'timeline',
+    'timeline', 'CaptureInFlight', 'DeviceMonitor', 'ProfileCapture',
+    'device_stats_snapshot',
 ]
 
 

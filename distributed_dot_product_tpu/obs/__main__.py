@@ -4,7 +4,9 @@ Offline event-log tooling::
 
     python -m distributed_dot_product_tpu.obs validate LOG [LOG...]
         [--require event[,event...]] [--timelines]
+    python -m distributed_dot_product_tpu.obs stats LOG [LOG...] [--json]
     python -m distributed_dot_product_tpu.obs timeline LOG REQUEST_ID
+        [--json]
 
 ``validate`` schema-checks every record of each log's rotated set
 against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
@@ -13,7 +15,14 @@ named events appear at least once — how scripts/smoke_serve.sh asserts
 the injected fault cocktail actually landed in the log. ``--timelines``
 reconstructs every request and fails on incomplete lifecycles.
 
-``timeline`` prints one request's reconstructed lifecycle.
+``stats`` summarizes a log operationally: per-event-type counts, the
+wall-clock span and sustained events/sec, and the rotated-file
+accounting (which files exist, their sizes and record counts) —
+``--json`` emits the same as one machine-readable object.
+
+``timeline`` prints one request's reconstructed lifecycle; ``--json``
+switches to compact machine-readable output with the FULL event
+records (the default renders ``(seq, event)`` pairs for humans).
 
 Runs on plain files — no devices touched, safe in any CI stage.
 """
@@ -21,9 +30,12 @@ Runs on plain files — no devices touched, safe in any CI stage.
 import argparse
 import collections
 import json
+import os
 import sys
 
-from distributed_dot_product_tpu.obs.events import validate_file
+from distributed_dot_product_tpu.obs.events import (
+    _log_files, read_events, validate_file,
+)
 from distributed_dot_product_tpu.obs.timeline import reconstruct, timeline
 
 
@@ -50,16 +62,82 @@ def _cmd_validate(args):
     return rc
 
 
+def _cmd_stats(args):
+    rc = 0
+    reports = []
+    for path in args.logs:
+        if not _log_files(path):
+            print(f'{path}: no such log (nor rotated set)',
+                  file=sys.stderr)
+            rc = 1
+            continue
+        try:
+            records = read_events(path)
+        except (ValueError, OSError) as e:
+            print(f'{path}: UNREADABLE: {e}', file=sys.stderr)
+            rc = 1
+            continue
+        counts = collections.Counter(r.get('event') for r in records)
+        ts = [r['ts'] for r in records if isinstance(
+            r.get('ts'), (int, float))]
+        span_s = (max(ts) - min(ts)) if len(ts) >= 2 else 0.0
+        files = []
+        for fname in _log_files(path):
+            with open(fname, encoding='utf-8') as f:
+                n_lines = sum(1 for line in f if line.strip())
+            # `lines` is the RAW non-empty line count — it can exceed
+            # the parsed `events` total by one when the newest file
+            # ends in a crash-torn tail line (which read_events
+            # tolerates and skips).
+            files.append({'path': fname,
+                          'bytes': os.path.getsize(fname),
+                          'lines': n_lines})
+        reports.append({
+            'log': path, 'events': len(records),
+            'wall_span_seconds': span_s,
+            'events_per_second': (len(records) / span_s if span_s
+                                  else None),
+            'first_ts': min(ts) if ts else None,
+            'last_ts': max(ts) if ts else None,
+            'by_event': dict(sorted(counts.items(),
+                                    key=lambda kv: str(kv[0]))),
+            'files': files,
+        })
+    if args.json:
+        # Always a list — one element per readable log — so consumers
+        # get a stable shape regardless of how many paths were passed.
+        print(json.dumps(reports, indent=2, default=str))
+        return rc
+    for rep in reports:
+        rate = (f'{rep["events_per_second"]:.1f}/s'
+                if rep['events_per_second'] else 'n/a')
+        print(f'{rep["log"]}: {rep["events"]} events over '
+              f'{rep["wall_span_seconds"]:.2f}s ({rate}) in '
+              f'{len(rep["files"])} file(s)')
+        for ev, n in rep['by_event'].items():
+            print(f'  {ev:24} {n}')
+        for fi in rep['files']:
+            print(f'  file {fi["path"]}: {fi["lines"]} lines, '
+                  f'{fi["bytes"]} bytes')
+    return rc
+
+
 def _cmd_timeline(args):
     tl = timeline(args.request_id, args.log)
-    print(json.dumps({
+    payload = {
         'request_id': tl.request_id, 'status': tl.status,
         'reason': tl.reason, 'complete': tl.complete,
         'errors': tl.errors, 'phases': tl.phases(),
         'admits': tl.admits, 'quarantines': tl.quarantines,
         'tokens': tl.tokens,
-        'events': [(r['seq'], r['event']) for r in tl.events],
-    }, indent=2, default=str))
+    }
+    if args.json:
+        # Machine-readable: full event records, compact encoding.
+        payload['events'] = tl.events
+        print(json.dumps(payload, separators=(',', ':'), default=str))
+    else:
+        payload['events'] = [(r['seq'], r['event']) for r in tl.events]
+        print(json.dumps(payload, indent=2, default=str))
     return 0 if tl.complete else 1
 
 
@@ -78,9 +156,20 @@ def main(argv=None):
                    help='also require every request lifecycle complete')
     v.set_defaults(fn=_cmd_validate)
 
+    s = sub.add_parser('stats', help='operational summary of a log '
+                                     '(counts, rate, rotation files)')
+    s.add_argument('logs', nargs='+')
+    s.add_argument('--json', action='store_true',
+                   help='one machine-readable JSON object instead of '
+                        'the human table')
+    s.set_defaults(fn=_cmd_stats)
+
     t = sub.add_parser('timeline', help='print one request lifecycle')
     t.add_argument('log')
     t.add_argument('request_id')
+    t.add_argument('--json', action='store_true',
+                   help='compact machine-readable output with full '
+                        'event records')
     t.set_defaults(fn=_cmd_timeline)
 
     args = parser.parse_args(argv)

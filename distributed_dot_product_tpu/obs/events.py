@@ -73,6 +73,13 @@ EVENT_SCHEMA = {
     'health.readiness': ('state',),
     # -- fault injection (utils/faults.py) -----------------------------
     'fault.inject': ('kind',),
+    # -- perf observatory (obs/perf.py, obs/devmon.py) -----------------
+    # One bounded jax.profiler capture began (manual /profile hit or
+    # the scheduler's adaptive ttft-p99 trigger — `trigger` names it).
+    'profile.capture': ('trigger', 'seconds', 'path'),
+    # `perf check` found a per-entry tolerance violation against the
+    # committed baseline (entry = registry name, metric = which gate).
+    'perf.regression': ('entry', 'metric'),
     # -- swallowed exceptions (utils.tracing.log_exception) ------------
     'exception': ('context', 'type'),
 }

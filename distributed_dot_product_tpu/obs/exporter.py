@@ -12,7 +12,11 @@ No external metrics dependency exists in the image, so this renders the
 - histograms → a summary family: ``{quantile="0.5"|"0.99"}`` lines from
   the aged reservoir (CURRENT behavior — what an alert wants) plus the
   Prometheus-mandated cumulative ``_count``/``_sum`` from the lifetime
-  totals (``Histogram.summary()``'s ``total_count``/``total_sum``).
+  totals (``Histogram.summary()``'s ``total_count``/``total_sum``);
+  histograms with bucket bounds ALSO render a real cumulative
+  histogram family ``<fam>_hist`` with ``_bucket{le="..."}`` lines —
+  lifetime counters an external Prometheus can sum across replicas
+  (ROADMAP item 2's per-replica merge needs exactly that).
 
 Dotted registry names are sanitized (``serve.queue_depth`` →
 ``ddp_serve_queue_depth``); labeled metrics (``registry.counter(name,
@@ -30,6 +34,12 @@ start` it explicitly::
 health.HealthMonitor` snapshot, status 200 while readiness is
 ``ready``/``degraded`` (degraded still serves) and 503 otherwise — the
 shape a load-balancer probe consumes.
+
+With a ``profiler`` (:class:`~distributed_dot_product_tpu.obs.devmon.
+ProfileCapture`), ``/profile?seconds=N`` begins one bounded
+``jax.profiler`` trace capture — 200 with the trace directory, 409
+while one is already in flight (never two traces), 400 on a bad
+duration, 404 when the server carries no profiler.
 """
 
 import http.server
@@ -37,6 +47,7 @@ import json
 import math
 import re
 import threading
+import urllib.parse
 from typing import Optional
 
 from distributed_dot_product_tpu.utils import tracing
@@ -84,13 +95,21 @@ def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
     values — only values at least as fresh as the render's start."""
     registry = registry or tracing.get_registry()
     lines = []
+    # Cumulative-bucket histogram families are buffered and emitted
+    # after the main body: interleaving `<fam>` summary lines and
+    # `<fam>_hist` bucket lines per label set would split each family
+    # into non-contiguous groups, which strict exposition parsers
+    # (OpenMetrics, promtool) reject. iter_metrics() yields label sets
+    # of one family adjacently, so each buffer stays grouped.
+    hist_lines = []
     typed = set()
 
-    def _head(kind, fam, comment):
+    def _head(kind, fam, comment, out=None):
         if fam not in typed:
             typed.add(fam)
-            lines.append(f'# HELP {fam} {comment}')
-            lines.append(f'# TYPE {fam} {kind}')
+            out = lines if out is None else out
+            out.append(f'# HELP {fam} {comment}')
+            out.append(f'# TYPE {fam} {kind}')
 
     for kind, name, labels, value in registry.iter_metrics():
         if kind == 'counter':
@@ -113,6 +132,32 @@ def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
                          f'{_fmt(value["total_count"])}')
             lines.append(f'{fam}_sum{_labels_str(labels)} '
                          f'{_fmt(value["total_sum"])}')
+            buckets = value.get('buckets')
+            if buckets:
+                # Real cumulative histogram series under a SEPARATE
+                # family (`<fam>` is already TYPE summary; mixing
+                # children kinds under one family is invalid
+                # exposition). These are lifetime counters, so an
+                # external Prometheus can sum them across replicas —
+                # the aggregation the reservoir quantiles can't give.
+                famh = fam + '_hist'
+                _head('histogram', famh,
+                      f'histogram {name} (cumulative lifetime buckets)',
+                      out=hist_lines)
+                for le, n in buckets:
+                    hist_lines.append(
+                        f'{famh}_bucket'
+                        f'{_labels_str(labels, [("le", _fmt(le))])} '
+                        f'{_fmt(n)}')
+                hist_lines.append(
+                    f'{famh}_bucket'
+                    f'{_labels_str(labels, [("le", "+Inf")])} '
+                    f'{_fmt(value["total_count"])}')
+                hist_lines.append(f'{famh}_count{_labels_str(labels)} '
+                                  f'{_fmt(value["total_count"])}')
+                hist_lines.append(f'{famh}_sum{_labels_str(labels)} '
+                                  f'{_fmt(value["total_sum"])}')
+    lines += hist_lines
     return '\n'.join(lines) + '\n' if lines else ''
 
 
@@ -124,6 +169,7 @@ class _ObsHTTPServer(http.server.ThreadingHTTPServer):
     # Exporter endpoints hold references, not state:
     registry = None
     health = None
+    profiler = None
     namespace = 'ddp'
 
 
@@ -158,8 +204,43 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._send(200 if ok else 503,
                        json.dumps(snap, default=str) + '\n',
                        'application/json')
+        elif path == '/profile':
+            self._do_profile()
         else:
             self._send(404, 'not found\n', 'text/plain')
+
+    def _do_profile(self):
+        """``GET /profile?seconds=N``: begin one bounded profiler
+        capture (obs/devmon.py ProfileCapture). 409 while a capture is
+        in flight — never two traces; 404 when the server was built
+        without a profiler (the guarded-off default)."""
+        from distributed_dot_product_tpu.obs.devmon import CaptureInFlight
+        profiler = self.server.profiler
+        if profiler is None:
+            self._send(404, json.dumps(
+                {'error': 'no profiler configured on this server'})
+                + '\n', 'application/json')
+            return
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        try:
+            seconds = float(query['seconds'][0]) if 'seconds' in query \
+                else None
+            if seconds is not None and not seconds > 0:
+                raise ValueError(seconds)
+        except (ValueError, TypeError):
+            self._send(400, json.dumps(
+                {'error': 'seconds must be a positive number'}) + '\n',
+                'application/json')
+            return
+        try:
+            info = profiler.start(seconds, trigger='http')
+        except CaptureInFlight as e:
+            self._send(409, json.dumps({'error': str(e)}) + '\n',
+                       'application/json')
+            return
+        self._send(200, json.dumps({'status': 'capturing', **info})
+                   + '\n', 'application/json')
 
     def log_message(self, fmt, *args):
         # Probes hit /healthz every few seconds — stay silent.
@@ -172,10 +253,13 @@ class MetricsServer:
     ephemeral port (read it back from ``.port`` — how tests avoid
     collisions)."""
 
-    def __init__(self, registry=None, *, health=None,
+    def __init__(self, registry=None, *, health=None, profiler=None,
                  host='127.0.0.1', port=0, namespace='ddp'):
         self.registry = registry or tracing.get_registry()
         self.health = health
+        # Optional obs.devmon.ProfileCapture: enables the guarded
+        # /profile?seconds=N endpoint (404 without one).
+        self.profiler = profiler
         self.host = host
         self.port = port
         self.namespace = namespace
@@ -188,6 +272,7 @@ class MetricsServer:
         srv = _ObsHTTPServer((self.host, self.port), _Handler)
         srv.registry = self.registry
         srv.health = self.health
+        srv.profiler = self.profiler
         srv.namespace = self.namespace
         self.port = srv.server_address[1]
         self._server = srv

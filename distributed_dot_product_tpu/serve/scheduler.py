@@ -50,6 +50,7 @@ import numpy as np
 
 from distributed_dot_product_tpu.obs import events as obs_events
 from distributed_dot_product_tpu.obs import spans as obs_spans
+from distributed_dot_product_tpu.obs.devmon import CaptureInFlight
 from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.serve.admission import (
     AdmissionController, RejectReason, Request, RequestResult,
@@ -85,6 +86,15 @@ class ServeConfig:
     stall_timeout: float = 2.0
     watchdog: bool = True
     watchdog_poll: Optional[float] = None
+    # Adaptive profiling (needs a `profiler` on the Scheduler):
+    # when the serve.ttft reservoir p99 exceeds `profile_ttft_p99`
+    # seconds, capture ONE bounded jax.profiler trace of
+    # `profile_seconds`, then hold off `profile_cooldown` REAL seconds
+    # — the profile of a latency regression is taken while it happens,
+    # never two at once, never a capture storm.
+    profile_ttft_p99: Optional[float] = None
+    profile_seconds: float = 2.0
+    profile_cooldown: float = 60.0
 
 
 class _SlotState(enum.Enum):
@@ -131,8 +141,14 @@ class Scheduler:
                  fault_injector=None, clock=time.monotonic,
                  registry: Optional[tracing.MetricsRegistry] = None,
                  health: Optional[HealthMonitor] = None,
-                 on_tick: Optional[Callable] = None, event_log=None):
+                 on_tick: Optional[Callable] = None, event_log=None,
+                 profiler=None):
         self.engine = engine
+        # Optional obs.devmon.ProfileCapture for the adaptive
+        # ttft-p99 trigger (cfg.profile_ttft_p99 arms it).
+        self.profiler = profiler
+        self._last_capture_at: Optional[float] = None
+        self._ttft_dirty = False
         self.cfg = config or ServeConfig()
         self.clock = clock
         self.on_tick = on_tick
@@ -177,6 +193,7 @@ class Scheduler:
                     'abandoned', 'deadline_expired', 'failed',
                     'decode_steps', 'tokens_generated')}
         self._g_active = reg.gauge('serve.active_slots')
+        self._c_profile = reg.counter('serve.profile_triggers')
         self._h_step = reg.histogram('serve.step_seconds')
         # Request-timeline histograms: the latency decomposition a
         # continuous-batching server is judged by. All measured on the
@@ -454,6 +471,7 @@ class Scheduler:
                     req.first_token_at = now
                     ttft = max(0.0, now - req.submitted_at)
                     self._h_ttft.observe(ttft)
+                    self._ttft_dirty = True
                     token_fields['ttft'] = ttft
                 elif slot.last_token_at is not None:
                     gap = max(0.0, now - slot.last_token_at)
@@ -477,11 +495,54 @@ class Scheduler:
 
         self._g_active.set(sum(s.state is not _SlotState.FREE
                                for s in self._slots))
+        self._maybe_profile()
         self._update_readiness()
         if self.on_tick is not None:
             self.on_tick(self)
         return bool(self.admission.depth) or any(
             s.state is not _SlotState.FREE for s in self._slots)
+
+    def _maybe_profile(self):
+        """Adaptive capture trigger: when armed (cfg.profile_ttft_p99 +
+        a profiler) and the ttft p99 over the reservoir exceeds the
+        threshold, begin ONE bounded trace capture. Checked only on
+        ticks that observed a fresh TTFT (the p99 recompute sorts the
+        reservoir — not a per-tick cost), rate-limited by a REAL-time
+        cooldown (captures are real however the scheduler clock runs),
+        and skipped while a capture is already in flight."""
+        if not self._ttft_dirty:
+            return
+        self._ttft_dirty = False
+        prof, threshold = self.profiler, self.cfg.profile_ttft_p99
+        if prof is None or threshold is None:
+            return
+        now = time.monotonic()
+        if (self._last_capture_at is not None
+                and now - self._last_capture_at
+                < self.cfg.profile_cooldown):
+            return
+        p99 = self._h_ttft.percentile(99)
+        if not p99 > threshold:
+            return
+        if getattr(prof, 'busy', False):
+            return
+        try:
+            prof.start(self.cfg.profile_seconds,
+                       trigger='serve.ttft_p99',
+                       event_log=self.event_log, ttft_p99=p99,
+                       threshold=threshold)
+        except CaptureInFlight:
+            # Expected contention, not a fault: an HTTP /profile hit
+            # can land between our busy-check and start(). Skip
+            # quietly like the busy-check above — no exception event.
+            return
+        except Exception as e:
+            # A failing profiler must never take the serving loop down.
+            tracing.log_exception('scheduler.profile_trigger', e,
+                                  registry=self.registry)
+            return
+        self._last_capture_at = now
+        self._c_profile.inc()
 
     def run_until_idle(self, max_ticks=100_000):
         """Drive ticks until queue and slots are empty. ``max_ticks``

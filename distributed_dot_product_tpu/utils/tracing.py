@@ -23,6 +23,7 @@ Differences, deliberate:
   ``benchmark.py --profile-dir``).
 """
 
+import bisect
 import collections
 import functools
 import os
@@ -306,24 +307,69 @@ class Gauge:
         return self._value
 
 
+# Default cumulative-bucket bounds (seconds): spans the sub-ms decode
+# dispatch floor through multi-second compile phases. A Prometheus
+# scraping several replicas can SUM _bucket series across them — the
+# one aggregation the reservoir quantiles cannot support.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
 class Histogram:
     """Bounded reservoir of the most recent ``maxlen`` observations with
     nearest-rank percentiles — enough for honest p50/p99 step latency
     without an external metrics stack. Older observations age out, so
     the percentiles track CURRENT behavior (what a readiness probe
-    wants), not the run's whole history."""
+    wants), not the run's whole history.
 
-    def __init__(self, maxlen=4096):
+    Independently, LIFETIME cumulative bucket counts are kept over
+    ``buckets`` (upper bounds, ``le`` semantics; default
+    :data:`DEFAULT_BUCKETS`, ``()`` disables) — these never age out,
+    which is what lets an external Prometheus aggregate histograms
+    across replicas (sum of cumulative counters is meaningful; merged
+    reservoir quantiles are not)."""
+
+    def __init__(self, maxlen=4096, buckets=DEFAULT_BUCKETS):
         self._values = collections.deque(maxlen=maxlen)
         self._count = 0
         self._sum = 0.0
+        self._bounds = (tuple(sorted({float(b) for b in buckets}))
+                        if buckets else ())
+        self._bucket_counts = [0] * len(self._bounds)
         self._lock = threading.Lock()
 
     def observe(self, value):
         with self._lock:
-            self._values.append(float(value))
+            v = float(value)
+            self._values.append(v)
             self._count += 1
-            self._sum += float(value)
+            self._sum += v
+            if self._bounds:
+                i = bisect.bisect_left(self._bounds, v)
+                if i < len(self._bounds):
+                    self._bucket_counts[i] += 1
+
+    @property
+    def bucket_bounds(self):
+        return self._bounds
+
+    def _cumulative(self, counts):
+        """Per-bucket counts → cumulative ``[(le, count), ...]`` (the
+        ONE place the le accumulation rule lives — buckets() and
+        summary() both render through it)."""
+        out, cum = [], 0
+        for le, c in zip(self._bounds, counts):
+            cum += c
+            out.append((le, cum))
+        return out
+
+    def buckets(self):
+        """Cumulative ``[(le, count), ...]`` over the lifetime counts
+        (ascending bounds; observations above the last bound appear
+        only in ``total_count`` — the exporter's ``+Inf`` line)."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+        return self._cumulative(counts)
 
     @property
     def count(self):
@@ -356,15 +402,25 @@ class Histogram:
         describe two different distributions once anything has aged
         out) — plus the lifetime ``total_count``/``total_sum`` the
         Prometheus exporter needs for its cumulative _count/_sum
-        series."""
+        series. Histograms with bucket bounds additionally carry
+        ``'buckets'`` (the cumulative lifetime counts) for the
+        exporter's real ``_bucket{le=...}`` lines."""
         with self._lock:
             vals = sorted(self._values)
             count, total = self._count, self._sum
+            # Bucket counts read in the SAME locked snapshot as
+            # total_count: a cumulative bucket exceeding the +Inf line
+            # (rendered from total_count) is corrupt data to a
+            # Prometheus consumer.
+            bucket_counts = list(self._bucket_counts)
+        buckets = ({'buckets': [[le, n] for le, n
+                                in self._cumulative(bucket_counts)]}
+                   if self._bounds else {})
         if not vals:
             return {'count': 0, 'mean': float('nan'),
                     'p50': float('nan'), 'p99': float('nan'),
                     'max': float('nan'),
-                    'total_count': count, 'total_sum': total}
+                    'total_count': count, 'total_sum': total, **buckets}
 
         def _pct(p):
             return vals[min(len(vals) - 1,
@@ -373,7 +429,7 @@ class Histogram:
 
         return {'count': len(vals), 'mean': sum(vals) / len(vals),
                 'p50': _pct(50), 'p99': _pct(99), 'max': vals[-1],
-                'total_count': count, 'total_sum': total}
+                'total_count': count, 'total_sum': total, **buckets}
 
 
 def _metric_key(name, labels):
@@ -419,10 +475,20 @@ class MetricsRegistry:
             return self._gauges.setdefault(
                 _metric_key(name, labels), Gauge())
 
-    def histogram(self, name, maxlen=4096, labels=None) -> Histogram:
+    def histogram(self, name, maxlen=4096, labels=None,
+                  buckets=None) -> Histogram:
+        """``buckets``: cumulative-bucket upper bounds for this series
+        (None → :data:`DEFAULT_BUCKETS`, ``()`` disables). Get-or-create
+        semantics: the first registration's bounds win."""
         with self._lock:
-            return self._histograms.setdefault(
-                _metric_key(name, labels), Histogram(maxlen))
+            key = _metric_key(name, labels)
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(
+                    maxlen,
+                    buckets=DEFAULT_BUCKETS if buckets is None
+                    else buckets)
+            return h
 
     def iter_metrics(self):
         """Structured iteration for exporters: yields ``(kind, name,

@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/4] ruff (generic hygiene) ==='
+echo '=== [1/5] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,10 +27,10 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/4] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/5] graphlint (jaxpr/domain contracts) ==='
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/4] tier-1 tests ==='
+echo '=== [3/5] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -38,7 +38,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/4] smoke serve + event-log schema validation ==='
+echo '=== [4/5] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -46,6 +46,24 @@ if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping smoke-serve stage'
 else
     scripts/smoke_serve.sh 12 4 || rc=1
+fi
+
+echo '=== [5/5] perf gate (compiled-program cost vs committed baseline) ==='
+# Compiles every registered entrypoint hermetically (8-dev CPU mesh),
+# snapshots XLA cost/memory/compile-time/retrace accounting, and gates
+# it against the committed PERF_BASELINE.json (tolerances sized for
+# CPU-mesh determinism — see obs/perf.py Tolerances). On an
+# INTENTIONAL program change, refresh the baseline in the same diff:
+#   python -m distributed_dot_product_tpu.obs.perf snapshot -o PERF_BASELINE.json
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping perf-gate stage'
+else
+    perf_now="$(mktemp /tmp/ddp_perf_now.XXXXXX.json)"
+    { JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.obs.perf \
+          snapshot -o "$perf_now" \
+      && JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.obs.perf \
+          check --against PERF_BASELINE.json --current "$perf_now"; } || rc=1
+    rm -f "$perf_now"
 fi
 
 exit $rc

@@ -1,0 +1,58 @@
+# -*- coding: utf-8 -*-
+"""
+Seeded perf regressions for the perf-gate negative tests
+(tests/test_obs_perf.py) and the CLI
+(``python -m distributed_dot_product_tpu.obs.perf check --registry
+tests.perf_fixtures:regressed``).
+
+One entry, two variants under the SAME registry name:
+
+- ``clean()``     — a decode-shaped step (surgical append + attention
+  scores over the whole cache) that stores and streams its cache at
+  bf16 with f32 accumulation on the dot — the contract the
+  cache-upcast graphlint rule and the decode kernels keep.
+- ``regressed()`` — the identical step with the cache WIDENED to f32
+  (the upcast persisted into the stored buffer — the form the
+  optimizer cannot fold away, unlike a transient ``astype`` pair,
+  which XLA simplifies to identity): argument bytes double and the
+  compiler-counted bytes accessed / peak memory blow through the
+  check tolerances. ``perf check`` against a clean baseline must exit
+  1 naming this entry.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.analysis.registry import TraceSpec
+
+# Cache big enough that its bytes dominate the program (the regression
+# signal must clear the default 25% relative tolerance decisively).
+_B, _H, _T, _D = 1, 4, 2048, 16
+
+
+def _builder(cache_dtype):
+    def build():
+        def step(cache, q, k):
+            cache = jax.lax.dynamic_update_slice(
+                cache, k.astype(cache.dtype), (0, 0, 5, 0))
+            scores = jax.lax.dot_general(
+                q.astype(cache.dtype), cache,
+                (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            return cache, scores
+
+        cache = jnp.zeros((_B, _H, _T, _D), cache_dtype)
+        q = jnp.zeros((_B, _H, 1, _D), jnp.bfloat16)
+        k = jnp.zeros((_B, _H, 1, _D), jnp.bfloat16)
+        return TraceSpec(name='fx.cache_step', fn=step,
+                         args=(cache, q, k))
+
+    return build
+
+
+def clean():
+    return {'fx.cache_step': _builder(jnp.bfloat16)}
+
+
+def regressed():
+    return {'fx.cache_step': _builder(jnp.float32)}

@@ -1,0 +1,308 @@
+# -*- coding: utf-8 -*-
+"""
+Device telemetry + on-demand profiling (obs/devmon.py): memory-stats
+gauges over injectable devices, guarded ProfileCapture (one trace at a
+time — the /profile endpoint's 409 contract), the profile.capture
+event, and the scheduler's adaptive ttft-p99 trigger.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs.devmon import (
+    CaptureInFlight, DeviceMonitor, ProfileCapture,
+    device_stats_snapshot,
+)
+from distributed_dot_product_tpu.obs.events import EventLog, activate
+from distributed_dot_product_tpu.obs.exporter import (
+    MetricsServer, render_prometheus,
+)
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+class FakeDevice:
+    platform = 'tpu'
+    device_kind = 'fake v9'
+
+    def __init__(self, dev_id, stats):
+        self.id = dev_id
+        self._stats = stats
+
+    def memory_stats(self):
+        if self._stats is None:
+            raise NotImplementedError('no stats on this backend')
+        return self._stats
+
+
+# -- DeviceMonitor ------------------------------------------------------
+
+def test_poll_once_fills_labeled_gauges():
+    reg = MetricsRegistry()
+    devs = [FakeDevice(0, {'bytes_in_use': 5 * 2**20,
+                           'peak_bytes_in_use': 9 * 2**20,
+                           'bytes_limit': 16 * 2**30,
+                           'ignored_key': 'not-a-number'}),
+            FakeDevice(1, None)]          # backend without stats
+    mon = DeviceMonitor(reg, devices=devs)
+    out = mon.poll_once()
+    assert set(out) == {'tpu:0'}
+    g = reg.gauge('device.memory.bytes_in_use',
+                  labels={'device': 'tpu:0'})
+    assert g.value == 5 * 2**20
+    assert reg.gauge('device.memory.devices_reporting').value == 1
+    assert reg.counter('device.memory.polls').value == 1
+    text = render_prometheus(reg)
+    assert ('ddp_device_memory_bytes_in_use{device="tpu:0"} '
+            f'{5 * 2**20}') in text
+    assert f'ddp_device_memory_bytes_limit{{device="tpu:0"}} ' \
+           f'{16 * 2**30}' in text
+
+
+def test_gauges_go_nan_when_device_stops_reporting():
+    """A device that stops answering must not keep serving its last
+    value as if it were live — the gauge flips to NaN (unknown) and
+    recovers when the device reports again."""
+    import math
+    reg = MetricsRegistry()
+    dev = FakeDevice(0, {'bytes_in_use': 5})
+    mon = DeviceMonitor(reg, devices=[dev])
+    mon.poll_once()
+    g = reg.gauge('device.memory.bytes_in_use', labels={'device': 'tpu:0'})
+    assert g.value == 5
+    dev._stats = None                     # backend starts failing
+    mon.poll_once()
+    assert math.isnan(g.value)
+    assert reg.gauge('device.memory.devices_reporting').value == 0
+    dev._stats = {'bytes_in_use': 7}      # and recovers
+    mon.poll_once()
+    assert g.value == 7
+
+
+def test_monitor_thread_polls_on_interval():
+    reg = MetricsRegistry()
+    mon = DeviceMonitor(reg, devices=[FakeDevice(0, {'bytes_in_use': 1})],
+                        interval=0.01)
+    with mon:
+        deadline = time.monotonic() + 5.0
+        while (reg.counter('device.memory.polls').value < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    assert reg.counter('device.memory.polls').value >= 3
+    assert mon._thread is None            # stopped cleanly
+
+
+def test_device_stats_snapshot_shapes():
+    snap = device_stats_snapshot(devices=[
+        FakeDevice(0, {'bytes_in_use': 7}), FakeDevice(1, None)])
+    assert snap[0]['device'] == 'tpu:0'
+    assert snap[0]['memory_stats'] == {'bytes_in_use': 7}
+    assert snap[1]['memory_stats'] is None
+    # Real backend: never raises, CPU reports stats-less devices.
+    real = device_stats_snapshot()
+    assert len(real) >= 1 and 'device' in real[0]
+
+
+# -- ProfileCapture -----------------------------------------------------
+
+def _trace_files(path):
+    return [os.path.join(r, f) for r, _, fs in os.walk(path) for f in fs]
+
+
+def test_capture_writes_loadable_trace_and_event(tmp_path):
+    import jax.numpy as jnp
+    reg = MetricsRegistry()
+    prof = ProfileCapture(tmp_path / 'traces', registry=reg,
+                          max_seconds=1.0)
+    log_path = tmp_path / 'ev.jsonl'
+    with activate(EventLog(log_path)) as log:
+        info = prof.start(0.05, trigger='unit-test')
+        # Device work inside the capture window, so the trace has a
+        # device timeline to show.
+        jnp.ones((32, 32)).sum().block_until_ready()
+        assert prof.join(60.0)
+        log.flush()
+    assert info['seconds'] == 0.05
+    assert info['trigger'] == 'unit-test'
+    files = _trace_files(info['path'])
+    assert files, 'capture produced no trace files'
+    assert any('plugins' in f or f.endswith('.pb') for f in files)
+    assert reg.counter('profile.captures').value == 1
+    assert reg.gauge('profile.capture_in_flight').value == 0
+    records, errors = obs_events.validate_file(str(log_path))
+    assert errors == []
+    caps = [r for r in records if r['event'] == 'profile.capture']
+    assert caps and caps[0]['trigger'] == 'unit-test'
+    assert caps[0]['path'] == info['path']
+
+
+def test_capture_seconds_clamped_and_validated(tmp_path):
+    prof = ProfileCapture(tmp_path, registry=MetricsRegistry(),
+                          max_seconds=0.05, clock=lambda s: None)
+    info = prof.start(3600)
+    assert info['seconds'] == 0.05       # clamped to max_seconds
+    assert prof.join(60.0)
+    with pytest.raises(ValueError):
+        prof.start(0)
+    with pytest.raises(ValueError):
+        prof.start(-1)
+
+
+def test_second_capture_while_in_flight_raises(tmp_path):
+    release = threading.Event()
+    prof = ProfileCapture(tmp_path, registry=MetricsRegistry(),
+                          clock=lambda s: release.wait(60))
+    prof.start(0.2)
+    try:
+        assert prof.busy
+        with pytest.raises(CaptureInFlight):
+            prof.start(0.2)
+    finally:
+        release.set()
+    assert prof.join(60.0)
+    # After the first lands, a new capture is accepted again.
+    prof.start(0.01)
+    assert prof.join(60.0)
+
+
+def test_capture_never_reuses_populated_trace_dir(tmp_path):
+    """A restarted process sharing base_dir must not hand out a
+    directory holding the previous run's trace."""
+    base = tmp_path / 'traces'
+    stale = base / 'trace-0001'
+    stale.mkdir(parents=True)
+    (stale / 'old.pb').write_bytes(b'previous run')
+    prof = ProfileCapture(base, registry=MetricsRegistry(),
+                          clock=lambda s: None)
+    info = prof.start(0.01)
+    assert prof.join(60.0)
+    assert info['path'] != str(stale)
+    assert not os.listdir(info['path']) or 'old.pb' not in \
+        os.listdir(info['path'])
+
+
+# -- /profile endpoint --------------------------------------------------
+
+def _get(url):
+    # Generous timeout: under a loaded suite the profiler's native
+    # start/stop can hold the GIL for seconds; the contract under test
+    # is request ORDERING (409 while busy), not endpoint latency.
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_profile_endpoint_guarded_concurrency(tmp_path):
+    """The 409 contract: a second /profile hit while a capture is in
+    flight is refused — never two traces — and the endpoint recovers
+    once the capture lands."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def gated_sleep(seconds):
+        started.set()
+        release.wait(60)
+
+    reg = MetricsRegistry()
+    prof = ProfileCapture(tmp_path / 'traces', registry=reg,
+                          clock=gated_sleep)
+    with MetricsServer(reg, profiler=prof) as srv:
+        code, body = _get(srv.url + '/profile?seconds=0.2')
+        assert code == 200
+        first = json.loads(body)
+        assert first['status'] == 'capturing'
+        assert started.wait(60)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/profile?seconds=0.2')
+        assert exc.value.code == 409
+        # Malformed durations are a client error, not a crash.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/profile?seconds=nope')
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/profile?seconds=-3')
+        assert exc.value.code == 400
+        release.set()
+        assert prof.join(60.0)
+        code, body = _get(srv.url + '/profile?seconds=0.01')
+        assert code == 200
+        assert json.loads(body)['path'] != first['path']
+        assert prof.join(60.0)
+    assert _trace_files(first['path'])
+
+
+def test_profile_endpoint_404_without_profiler():
+    with MetricsServer(MetricsRegistry()) as srv:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + '/profile?seconds=1')
+        assert exc.value.code == 404
+
+
+# -- scheduler adaptive trigger -----------------------------------------
+
+class StubProfiler:
+    def __init__(self, busy=False):
+        self.busy = busy
+        self.calls = []
+
+    def start(self, seconds, **kw):
+        self.calls.append((seconds, kw))
+        return {'path': 'stub', 'seconds': seconds}
+
+
+def _run_burst(profiler, **cfg_kw):
+    reg = MetricsRegistry()
+    eng = KernelEngine(slots=2, t_max=32, vocab=16, heads=2, head_dim=4,
+                       prefill_chunk=4, seed=3)
+    cfg = ServeConfig(watchdog=False, queue_limit=16, max_new_tokens=4,
+                      **cfg_kw)
+    sched = Scheduler(eng, cfg, registry=reg, profiler=profiler)
+    for i in range(6):
+        sched.submit(np.array([1, 2, 3], np.int32), request_id=f'r{i}')
+    sched.run_until_idle()
+    sched.close()
+    return reg
+
+
+def test_ttft_p99_trigger_fires_once_under_cooldown():
+    stub = StubProfiler()
+    reg = _run_burst(stub, profile_ttft_p99=0.0, profile_seconds=1.5,
+                     profile_cooldown=3600.0)
+    assert len(stub.calls) == 1, stub.calls
+    seconds, kw = stub.calls[0]
+    assert seconds == 1.5
+    assert kw['trigger'] == 'serve.ttft_p99'
+    assert kw['ttft_p99'] > 0.0
+    assert kw['threshold'] == 0.0
+    assert reg.counter('serve.profile_triggers').value == 1
+
+
+def test_trigger_skips_while_capture_in_flight():
+    stub = StubProfiler(busy=True)
+    reg = _run_burst(stub, profile_ttft_p99=0.0,
+                     profile_cooldown=0.0)
+    assert stub.calls == []
+    assert reg.counter('serve.profile_triggers').value == 0
+
+
+def test_trigger_disarmed_by_default():
+    stub = StubProfiler()
+    _run_burst(stub)                     # profile_ttft_p99 defaults None
+    assert stub.calls == []
+
+
+def test_trigger_respects_threshold():
+    stub = StubProfiler()
+    _run_burst(stub, profile_ttft_p99=3600.0, profile_cooldown=0.0)
+    assert stub.calls == []              # p99 never crosses an hour

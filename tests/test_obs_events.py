@@ -254,3 +254,64 @@ def test_train_loop_emits_lifecycle_events_and_metrics(tmp_path):
     assert snap['histograms']['train.checkpoint_save_seconds'][
         'total_count'] >= 1
     assert snap['gauges']['train.tokens_per_s'] > 0
+
+
+# -- offline CLI: stats + machine-readable timeline ---------------------
+
+def _cli_main(argv, capsys):
+    from distributed_dot_product_tpu.obs.__main__ import main
+    rc = main(argv)
+    return rc, capsys.readouterr().out
+
+
+def test_cli_stats_counts_rate_and_files(tmp_path, capsys):
+    path = tmp_path / 'events.jsonl'
+    t = [100.0]
+
+    def clock():
+        t[0] += 0.5
+        return t[0]
+
+    with EventLog(path, clock=clock, rotate_bytes=256,
+                  keep_rotations=3) as log:
+        for i in range(12):
+            log.emit('serve.admit', request_id=f'r{i}', slot=0)
+        log.emit('serve.retire', request_id='r0', status='completed')
+    rc, out = _cli_main(['stats', str(path)], capsys)
+    assert rc == 0
+    assert 'serve.admit' in out and '12' in out
+    assert 'file ' in out                      # rotation accounting
+
+    rc, out = _cli_main(['stats', '--json', str(path)], capsys)
+    assert rc == 0
+    [rep] = json.loads(out)           # stable shape: always a list
+    assert rep['events'] == 13
+    assert rep['by_event']['serve.admit'] == 12
+    assert rep['wall_span_seconds'] == pytest.approx(6.0)
+    assert rep['events_per_second'] == pytest.approx(13 / 6.0)
+    assert len(rep['files']) > 1               # rotated set accounted
+    assert sum(f['lines'] for f in rep['files']) == 13
+
+
+def test_cli_stats_unreadable_log_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / 'bad.jsonl'
+    bad.write_text('{"schema": 1}\nnot json mid-file\n{"schema": 1}\n')
+    rc, _ = _cli_main(['stats', str(bad)], capsys)
+    assert rc == 1
+
+
+def test_cli_timeline_json_full_records(tmp_path, capsys):
+    path = tmp_path / 'events.jsonl'
+    with EventLog(path) as log:
+        log.emit('serve.admit', request_id='r1', slot=0, queue_wait=0.0)
+        log.emit('serve.decode', request_id='r1', slot=0,
+                 token_index=0, ttft=0.01)
+        log.emit('serve.retire', request_id='r1', status='completed',
+                 tokens=1, total_seconds=0.02)
+    rc, out = _cli_main(['timeline', str(path), 'r1', '--json'], capsys)
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload['complete'] is True
+    # Machine-readable form carries the FULL records, not (seq, event).
+    assert payload['events'][0]['event'] == 'serve.admit'
+    assert payload['events'][0]['request_id'] == 'r1'

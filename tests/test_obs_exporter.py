@@ -77,6 +77,84 @@ def test_histogram_empty_renders_nan_quantiles():
     assert 'ddp_empty_h_count 0' in text
 
 
+def test_histogram_bucket_family_rendered():
+    """Cumulative `_bucket{le=...}` lines under a real histogram family
+    NEXT TO the reservoir summary — lifetime counters an external
+    Prometheus can sum across replicas."""
+    reg = MetricsRegistry()
+    h = reg.histogram('serve.ttft_seconds', buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    assert '# TYPE ddp_serve_ttft_seconds summary' in text
+    assert '# TYPE ddp_serve_ttft_seconds_hist histogram' in text
+    assert 'ddp_serve_ttft_seconds_hist_bucket{le="0.01"} 1' in text
+    assert 'ddp_serve_ttft_seconds_hist_bucket{le="0.1"} 2' in text
+    assert 'ddp_serve_ttft_seconds_hist_bucket{le="1"} 3' in text
+    assert 'ddp_serve_ttft_seconds_hist_bucket{le="+Inf"} 4' in text
+    assert 'ddp_serve_ttft_seconds_hist_count 4' in text
+    assert re.search(r'ddp_serve_ttft_seconds_hist_sum 5\.5\d*', text)
+
+
+def test_labeled_bucket_families_stay_contiguous():
+    """Labeled histograms must not interleave the summary and _hist
+    families per label set — strict exposition parsers require all
+    lines of one family in a single group."""
+    reg = MetricsRegistry()
+    for tenant in ('a', 'b'):
+        h = reg.histogram('serve.ttft_seconds', buckets=(0.1,),
+                          labels={'tenant': tenant})
+        h.observe(0.05)
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    # Every _hist line comes after every summary line of the family.
+    last_summary = max(i for i, ln in enumerate(text.splitlines())
+                       if ln.startswith('ddp_serve_ttft_seconds')
+                       and '_hist' not in ln)
+    first_hist = min(i for i, ln in enumerate(text.splitlines())
+                     if '_hist' in ln)
+    assert last_summary < first_hist
+    # And each family's own lines form one contiguous block.
+    kinds = [('hist' if '_hist' in ln else 'summary')
+             for ln in text.splitlines()
+             if ln.startswith(('ddp_serve_ttft_seconds', '# '))]
+    joined = ''.join('h' if k == 'hist' else 's' for k in kinds)
+    assert 'hs' not in joined, joined
+
+
+def test_bucket_counts_are_lifetime_not_reservoir():
+    """Bucket counters never age out: a tiny reservoir drops old
+    observations from the quantiles, but the cumulative buckets keep
+    counting — the property cross-replica aggregation needs."""
+    from distributed_dot_product_tpu.utils.tracing import Histogram
+    h = Histogram(maxlen=2, buckets=(1.0,))
+    for _ in range(10):
+        h.observe(0.5)
+    s = h.summary()
+    assert s['count'] == 2               # reservoir window
+    assert s['total_count'] == 10
+    assert s['buckets'] == [[1.0, 10]]   # lifetime cumulative
+    assert h.buckets() == [(1.0, 10)]
+
+
+def test_buckets_disabled_and_default_bounds():
+    from distributed_dot_product_tpu.utils.tracing import (
+        DEFAULT_BUCKETS, Histogram,
+    )
+    reg = MetricsRegistry()
+    assert reg.histogram('h.default').bucket_bounds \
+        == tuple(sorted(DEFAULT_BUCKETS))
+    h = Histogram(buckets=())
+    h.observe(0.1)
+    assert 'buckets' not in h.summary()
+    reg.histogram('h.off', buckets=()).observe(0.1)
+    text = render_prometheus(reg)
+    _assert_valid_exposition(text)
+    assert 'ddp_h_off_hist' not in text
+    assert 'ddp_h_default_hist_bucket' in text
+
+
 def test_concurrent_export_no_torn_reads():
     """Writer threads (counters + histograms, the scheduler/watchdog
     write pattern) hammer the registry while a reader renders: every
