@@ -120,6 +120,18 @@ def parse_args():
                              'kernel on TPU. Recorded in the result row '
                              'so kernel-vs-XLA tables read straight off '
                              'the JSON')
+    parser.add_argument('--cache-mode', choices=['slab', 'paged'],
+                        default='slab',
+                        help='decode-serve mode: KV-cache layout — the '
+                             'dense per-slot slab, or the paged pool '
+                             '(same KV byte budget, 4x the slots; rows '
+                             'record pool utilization + peak '
+                             'concurrency so slab/paged twin rows '
+                             'compare at fixed memory)')
+    parser.add_argument('--page-size', type=int, default=16,
+                        help='decode-serve --cache-mode paged: pool '
+                             'page granularity in rows (= the fused '
+                             "kernel's K split; must divide --seq-len)")
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -861,10 +873,30 @@ def run_decode_serve(args):
     )
     from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
 
-    slots = args.batch if args.batch > 1 else 4
+    slots_slab = args.batch if args.batch > 1 else 4
     t_max = args.seq_len or 256
     h, d = args.heads, args.head_dim
     max_new = 16
+    prompt_len = min(8, t_max - max_new - 1)
+    steps_per_seq = prompt_len + max_new
+    paged = args.cache_mode == 'paged'
+    # Fixed-memory framing: the slab row's KV budget is slots × t_max
+    # rows; the paged twin holds the SAME bytes as a page pool and
+    # raises the slot count toward 4× — capped by what the pool can
+    # hold at this run's per-sequence fill, so the recorded
+    # max_concurrent is an honest same-budget number.
+    budget_rows = slots_slab * t_max
+    if paged:
+        page_size = args.page_size
+        if t_max % page_size:
+            raise SystemExit(f'--page-size {page_size} must divide '
+                             f'the cache length {t_max}')
+        pages = budget_rows // page_size
+        pages_per_seq = -(-steps_per_seq // page_size)
+        slots = max(1, min(4 * slots_slab, pages // pages_per_seq))
+    else:
+        page_size = pages = None
+        slots = slots_slab
     # Whole rounds of `slots` concurrent sequences: both measurements
     # then serve the same token volume, and the bare loop's per-round
     # resets keep every sequence inside t_max (an unreset loop would
@@ -872,13 +904,16 @@ def run_decode_serve(args):
     # frozen cache).
     n_rounds = -(-(args.serve_requests or 4 * slots) // slots)
     n_requests = n_rounds * slots
-    prompt_len = min(8, t_max - max_new - 1)
+    # f32 engine dtype, K + V buffers.
+    kv_budget_bytes = budget_rows * h * d * 4 * 2
 
     def make_engine():
+        extra = (dict(cache_mode='paged', pages=pages,
+                      page_size=page_size) if paged else {})
         return KernelEngine(slots=slots, t_max=t_max, vocab=256, heads=h,
                             head_dim=d, prefill_chunk=8, seed=0,
                             decode_impl=(None if args.decode_impl == 'auto'
-                                         else args.decode_impl))
+                                         else args.decode_impl), **extra)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 256, size=prompt_len).astype(np.int32)
@@ -889,7 +924,11 @@ def run_decode_serve(args):
     eng = make_engine()
     tokens = np.zeros(slots, np.int32)
     active = np.ones(slots, bool)
-    steps_per_seq = prompt_len + max_new
+
+    # step() auto-prepares pages (vectorized fast-path mask, allocator
+    # only on page crossings) — the same per-token cost the scheduler
+    # path pays, so the bare row must not add an explicit per-step
+    # prepare_step() pass only the paged twin would be charged for.
     eng.step(tokens, active)                      # compile + warm
     for i in range(slots):
         eng.reset(i)                              # warm append undone
@@ -924,10 +963,21 @@ def run_decode_serve(args):
     # before its first token.
     chunks = [prompts[0][i:i + eng.prefill_chunk]
               for i in range(0, prompt_len, eng.prefill_chunk)]
+    def _reserve_ttft_pages():
+        # Page allocation happens here, OUTSIDE the timed window (and
+        # not via an assert — `python -O` must not move the pool work
+        # into the TTFT measurement).
+        if paged and not eng.reserve_rows(0, prompt_len + 1):
+            raise RuntimeError(
+                'page pool too small for the TTFT probe prompt — the '
+                'pool is sized from the slab twin (--batch × --seq-len '
+                'rows): raise --batch/--seq-len or lower --page-size')
+    _reserve_ttft_pages()
     for c in chunks:                              # warm the prefill jit
         eng.prefill(0, c)
     eng.step(tokens, active)
     eng.reset(0)
+    _reserve_ttft_pages()
     t0 = _time.perf_counter()
     for c in chunks:
         eng.prefill(0, c)
@@ -942,10 +992,22 @@ def run_decode_serve(args):
     cfg = ServeConfig(queue_limit=max(8, n_requests),
                       max_new_tokens=max_new, watchdog=False,
                       degrade_watermark=1.1)      # measure undegraded
+    # Peak concurrency and pool fill, observed per tick — the
+    # fixed-memory comparison columns of the slab/paged twin rows.
+    peak = {'busy': 0, 'pages_used': 0}
+
+    def _on_tick(s):
+        peak['busy'] = max(peak['busy'],
+                           sum(sl.request is not None
+                               for sl in s._slots))
+        if paged:
+            peak['pages_used'] = max(peak['pages_used'],
+                                     eng.pool.used_pages)
+
     # --metrics-out: route the serve metrics (TTFT/queue-wait/per-token
     # histograms, counters) into the process registry the snapshot
     # serializes; otherwise keep them isolated from other runs.
-    sched = Scheduler(eng, cfg,
+    sched = Scheduler(eng, cfg, on_tick=_on_tick,
                       registry=(tracing.get_registry()
                                 if getattr(args, 'metrics_out', None)
                                 else MetricsRegistry()))
@@ -970,6 +1032,9 @@ def run_decode_serve(args):
         'heads': h, 'head_dim': d, 'requests': n_requests,
         'prompt_len': prompt_len, 'max_new_tokens': max_new,
         'decode_impl': impl_resolved,
+        'cache_mode': args.cache_mode,
+        'kv_budget_bytes': kv_budget_bytes,
+        'max_concurrent': peak['busy'],
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
         'bare_tokens_per_s': bare_tps,
@@ -981,11 +1046,24 @@ def run_decode_serve(args):
                          for r in results.values()),
         'perf_model': step_model,
     }
-    print(f"decode-serve[{impl_resolved}] slots={slots} t_max={t_max} "
+    if paged:
+        record.update({
+            'page_size': page_size, 'pages': pages,
+            'pages_used_peak': peak['pages_used'],
+            'page_utilization_peak': peak['pages_used'] / pages,
+        })
+    paged_note = ('' if not paged else
+                  f" pages={peak['pages_used']}/{pages} "
+                  f"({100.0 * record['page_utilization_peak']:.0f}% "
+                  f"peak)")
+    print(f"decode-serve[{impl_resolved}/{args.cache_mode}] "
+          f"slots={slots} t_max={t_max} "
           f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
           f"{bare_tps:,.0f} tok/s "
           f"({record['sched_overhead_pct']:.1f}% overhead, "
-          f"TTFT {record['ttft_ms']:.1f} ms)")
+          f"TTFT {record['ttft_ms']:.1f} ms, "
+          f"peak {peak['busy']} concurrent at "
+          f"{kv_budget_bytes / 2**20:.1f} MiB KV{paged_note})")
     _append_record(args.file, record)
     return record
 

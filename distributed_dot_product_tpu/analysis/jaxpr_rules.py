@@ -12,12 +12,13 @@ the ClosedJaxpr enforcing the repo's compiled-graph contracts:
   next refactor from silently dropping it.
 - ``cache-alias`` — each declared cache buffer must flow input→output
   through *surgical* writes only: ``dynamic_update_slice`` (appends),
-  ``select_n`` (masked slot writes), same-dtype ``convert_element_type``,
-  and kernel ``input_output_aliases`` — across ``pjit``/``shard_map``/
+  ``select_n`` (masked slot writes), sub-operand ``scatter`` (the paged
+  pool's page-write spine), same-dtype ``convert_element_type``, and
+  kernel ``input_output_aliases`` — across ``pjit``/``shard_map``/
   custom-vjp boundaries. A buffer that is re-materialized (arithmetic,
   gather, full-shape copy) or overwritten by a full-buffer-shaped
-  ``dynamic_update_slice`` breaks the in-place append contract and
-  degrades every decode step into a cache copy.
+  ``dynamic_update_slice``/full-operand ``scatter`` breaks the in-place
+  append contract and degrades every decode step into a cache copy.
 - ``cache-upcast`` — no ``convert_element_type`` widens a cache-shaped
   tensor (e.g. ``cache.k.astype(f32)`` before a matmul): that
   materializes a full-size high-precision copy per step. Request the
@@ -204,11 +205,16 @@ def _check_axes(spec, jaxpr, out):
 # `reshape` is a layout view (the kernel path folds (B, H, T, d) to
 # (B·H, T, d) around its pallas_call); `transpose` is NOT — it moves
 # every byte on TPU, so it stays off-spine and gets reported.
+# `scatter` (operand position only) is the PAGED pool's page-write
+# spine: per-slot appends and freed-page zeroing are drop-mode
+# scatters into the pool operand — a full-operand-sized scatter (the
+# degenerate rewrite) is blocked like a full-shape DUS.
 _SPINE_WALK = {
     'dynamic_update_slice': lambda eqn: [eqn.invars[0]],
     'select_n': lambda eqn: list(eqn.invars[1:]),
     'convert_element_type': lambda eqn: [eqn.invars[0]],
     'reshape': lambda eqn: [eqn.invars[0]],
+    'scatter': lambda eqn: [eqn.invars[0]],
     'copy_p': lambda eqn: [],               # explicit copy breaks it
 }
 
@@ -255,6 +261,12 @@ def _spine_sources(jaxpr, out_var, blockers):
                     f, ln = _src(eqn)
                     blockers.append(('full-shape dynamic_update_slice',
                                      f, ln))
+                    continue
+            if name == 'scatter':
+                op, upd = eqn.invars[0].aval, eqn.invars[-1].aval
+                if getattr(upd, 'size', 0) >= getattr(op, 'size', 1):
+                    f, ln = _src(eqn)
+                    blockers.append(('full-operand scatter', f, ln))
                     continue
             if name == 'convert_element_type':
                 src_aval = eqn.invars[0].aval

@@ -47,6 +47,8 @@ RESULTS.md "KV-cache decode").
 import math
 from typing import NamedTuple, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -56,7 +58,9 @@ from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'decode_attention', 'init_slot_cache', 'append_kv_slots',
            'reset_slot', 'slots_all_finite', 'decode_step',
-           'decode_kernel_eligible']
+           'decode_kernel_eligible', 'PagedDecodeCache', 'PagePool',
+           'init_paged_cache', 'paged_gather', 'paged_append_kv_slots',
+           'paged_append_rows', 'paged_reset_slot', 'paged_copy_attach']
 
 
 class DecodeCache(NamedTuple):
@@ -276,6 +280,11 @@ def append_kv_slots(cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     eagerly naming the slot; traced lengths write NOTHING for the
     overflowing slot while its length still advances (detectable as
     ``cache.length[i] > cache.t_max``)."""
+    if isinstance(cache, PagedDecodeCache):
+        # Same surface, paged substrate: rows scatter into pool pages
+        # through the slot's page-table row instead of its dense strip.
+        return paged_append_kv_slots(cache, k_new, v_new,
+                                     slot_mask=slot_mask, counts=counts)
     if cache.length.ndim != 1:
         raise ValueError(
             'append_kv_slots needs a per-slot cache (init_slot_cache); '
@@ -341,6 +350,10 @@ def reset_slot(cache: DecodeCache, slot) -> DecodeCache:
             'reset_slot needs a per-slot cache (init_slot_cache); a '
             'scalar-length cache is reset by init_cache — its batch '
             'rows share one sequence clock')
+    if isinstance(cache, PagedDecodeCache):
+        raise ValueError(
+            'reset_slot on a paged cache needs the freed-page list — '
+            'use paged_reset_slot with PagePool.release()\'s result')
     sel = jnp.arange(cache.k.shape[0]) == slot             # (B,)
 
     def clear(buf):
@@ -362,18 +375,504 @@ def slots_all_finite(x):
     return jnp.all(jnp.isfinite(x.reshape(x.shape[0], -1)), axis=-1)
 
 
-def decode_kernel_eligible(cache: DecodeCache, n=1, segment_ids=None,
-                           qk_quant=None):
+# -- paged KV cache -----------------------------------------------------
+#
+# The slab cache above reserves a dense t_max-length strip per slot, so
+# concurrency per chip is bounded by WORST-CASE context length. The
+# paged cache breaks that bound: one global pool of fixed-size pages,
+# indexed per slot by a page table — a slot holds exactly the pages its
+# actual fill needs, pages can be SHARED between slots (refcounted — a
+# registered system-prompt prefix occupies its pages once no matter how
+# many sequences ride it), and forking a sequence for parallel sampling
+# is a refcount bump plus one partial-page copy (copy-on-write). The
+# slab path stays as the reference implementation; the paged step must
+# match it bit-identically (tests/test_paged_decode.py pins it).
+#
+# Split of responsibilities: the DEVICE side (PagedDecodeCache + the
+# paged_* ops below) only ever reads/writes pool pages named by the
+# page table — appends are drop-mode scatters, so a -1 (unallocated)
+# table entry writes nothing. The HOST side (PagePool) owns the policy:
+# free list, refcounts, copy-on-write, prefix attach, fork. The serving
+# engine mirrors the page table to the device whenever the host mutates
+# it (a (slots, pages_per_slot) int32 array — bytes, not buffers).
+
+
+class PagedDecodeCache(NamedTuple):
+    """Paged serving cache: ``k_pool``/``v_pool`` are global
+    ``(pages + 1, H_kv, page_size, d·)`` pools; ``page_table`` is the
+    ``(slots, pages_per_slot) int32`` map from each slot's logical page
+    ordinal to its pool page (−1 = unallocated); ``length`` the per-slot
+    fill, exactly as :func:`init_slot_cache`. Logical positions work
+    like the slab cache's: position ``p`` of slot ``i`` lives at row
+    ``p % page_size`` of pool page ``page_table[i, p // page_size]``.
+
+    The LAST pool row (index :attr:`pages`) is the reserved SINK page —
+    never allocated, never attended. The fused kernel redirects the
+    write-back of slots with nothing to append (and the stream of
+    unallocated table entries) there, so no grid row ever touches a
+    page another slot owns: Pallas flushes every output block whether
+    or not the kernel wrote it, and without the sink an idle slot's
+    copy-through could race another slot's in-flight append on real
+    TPU (grid rows have no cross-row write ordering)."""
+    k_pool: jax.Array
+    v_pool: jax.Array
+    page_table: jax.Array
+    length: jax.Array
+
+    @property
+    def page_size(self):
+        return self.k_pool.shape[-2]
+
+    @property
+    def pages(self):
+        """Allocatable pages (the sink row is not one of them)."""
+        return self.k_pool.shape[0] - 1
+
+    @property
+    def pages_per_slot(self):
+        return self.page_table.shape[1]
+
+    @property
+    def slots(self):
+        return self.page_table.shape[0]
+
+    @property
+    def t_max(self):
+        """Per-slot logical capacity (the page table's reach)."""
+        return self.page_table.shape[1] * self.k_pool.shape[-2]
+
+
+def init_paged_cache(slots, kv_heads, t_max, head_dim, *, pages,
+                     page_size, v_head_dim=None, dtype=jnp.bfloat16):
+    """Zero paged cache: a ``pages``-page pool whose page size must
+    divide the per-slot capacity ``t_max``. The pool is sized by the
+    MEMORY budget, not ``slots × t_max`` — that decoupling is the whole
+    point (``pages << slots · t_max/page_size`` serves more concurrent
+    sequences than a slab of the same bytes whenever actual fill is
+    below worst case)."""
+    v_head_dim = v_head_dim or head_dim
+    if page_size < 1 or t_max % page_size:
+        raise ValueError(f'page_size {page_size} must divide t_max '
+                         f'{t_max}')
+    if pages < 1:
+        raise ValueError(f'need pages >= 1, got {pages}')
+    # +1: the reserved write-sink row (see PagedDecodeCache).
+    return PagedDecodeCache(
+        k_pool=jnp.zeros((pages + 1, kv_heads, page_size, head_dim),
+                         dtype),
+        v_pool=jnp.zeros((pages + 1, kv_heads, page_size, v_head_dim),
+                         dtype),
+        page_table=jnp.full((slots, t_max // page_size), -1, jnp.int32),
+        length=jnp.zeros((slots,), jnp.int32))
+
+
+def paged_gather(cache: PagedDecodeCache):
+    """Materialize the slab view ``(slots, H_kv, t_max, d·)`` of a paged
+    cache — the portable XLA decode path attends against this (the same
+    masked math as the slab cache, so outputs are bit-identical), and
+    tests compare paged and slab contents through it. Unallocated table
+    entries redirect to the reserved SINK row (last pool page): on the
+    XLA path nothing ever writes it, so those columns read the slab's
+    literal zeros — and a slot never gathers another slot's live pages
+    even when its host-tracked length runs ahead of its allocation.
+    (Columns past ``length`` are masked regardless, so kernel-path
+    flush garbage parked on the sink still contributes exactly 0.)"""
+    pt = jnp.where(cache.page_table >= 0, cache.page_table,
+                   cache.pages).reshape(-1)                # (B·np,)
+    b, npg = cache.page_table.shape
+    ps = cache.page_size
+
+    def g(pool):
+        h_kv, d = pool.shape[1], pool.shape[-1]
+        x = jnp.take(pool, pt, axis=0, mode='clip')  # (B·np, H, ps, d)
+        x = x.reshape(b, npg, h_kv, ps, d)
+        return jnp.moveaxis(x, 2, 1).reshape(b, h_kv, npg * ps, d)
+
+    return g(cache.k_pool), g(cache.v_pool)
+
+
+def paged_append_kv_slots(cache: PagedDecodeCache, k_new, v_new, *,
+                          slot_mask=None, counts=None):
+    """:func:`append_kv_slots` over the paged pool: each slot's rows
+    scatter into the pool pages its table names, at its own length.
+    Same contract — ``counts``/``slot_mask`` semantics, eager overflow
+    raise on concrete lengths naming the slot, traced overflow writes
+    nothing while the length advances — plus the paged guard: a row
+    whose page-table entry is unallocated (−1) is DROPPED, never
+    written anywhere (the host allocator must have reserved pages
+    first; :class:`PagePool` is that allocator)."""
+    b, npg = cache.page_table.shape
+    ps = cache.page_size
+    t_max = cache.t_max
+    n = k_new.shape[-2]
+    if n > t_max:
+        raise ValueError(f'appending {n} positions to a t_max='
+                         f'{t_max} cache')
+    counts = (jnp.full((b,), n, jnp.int32) if counts is None
+              else jnp.asarray(counts, jnp.int32))
+    active = (jnp.ones((b,), bool) if slot_mask is None
+              else jnp.asarray(slot_mask, bool))
+    eff = jnp.where(active, jnp.clip(counts, 0, n), 0)
+
+    host_len = _concrete_lengths(cache.length)
+    host_eff = _concrete_lengths(eff)
+    if host_len is not None and host_eff is not None:
+        for i, (cur, add) in enumerate(zip(host_len, host_eff)):
+            if cur + add > t_max:
+                raise ValueError(
+                    f'KV-cache overflow on slot {i}: length {cur} + '
+                    f'{add} new positions exceeds t_max {t_max} '
+                    f'— evict the slot (reset_slot) or stop its '
+                    f'generation loop')
+
+    ok = cache.length + eff <= t_max                       # (B,)
+    pos = cache.length[:, None] + jnp.arange(n)[None, :]   # (B, n)
+    valid = jnp.logical_and(
+        jnp.arange(n)[None, :] < eff[:, None], ok[:, None])
+    pi = pos // ps
+    pg = jnp.take_along_axis(cache.page_table,
+                             jnp.clip(pi, 0, npg - 1), axis=1)
+    # Dropped rows point ONE PAST the pool end (past the sink row too):
+    # scatter mode='drop' discards out-of-bounds indices, whereas −1
+    # would WRAP to the last pool page (numpy indexing semantics) and
+    # corrupt it.
+    pg = jnp.where(jnp.logical_and(valid,
+                                   jnp.logical_and(pi < npg, pg >= 0)),
+                   pg, cache.pages + 1)                    # (B, n)
+    rw = pos % ps
+
+    def write(pool, new):
+        vals = jnp.moveaxis(new.astype(pool.dtype), 2, 1)  # (B, n, H, d)
+        return pool.at[pg, :, rw, :].set(vals, mode='drop')
+
+    return cache._replace(k_pool=write(cache.k_pool, k_new),
+                          v_pool=write(cache.v_pool, v_new),
+                          length=cache.length + eff)
+
+
+def paged_append_rows(cache: PagedDecodeCache, k_rows, v_rows, page_row,
+                      start, count):
+    """Single-SEQUENCE scatter used by prefix registration: ``count`` of
+    the ``k_rows``/``v_rows (H_kv, C, d·)`` rows land at logical
+    positions ``start..`` through the ``(pages_per_slot,) int32``
+    ``page_row`` vector (−1-padded), with no slot or length involved —
+    a registered prefix lives in registry-owned pages, not a slot."""
+    npg = cache.pages_per_slot
+    ps = cache.page_size
+    c = k_rows.shape[-2]
+    pos = start + jnp.arange(c)
+    pi = pos // ps
+    pg = jnp.take(page_row, jnp.clip(pi, 0, npg - 1))
+    pg = jnp.where(jnp.logical_and(jnp.arange(c) < count,
+                                   jnp.logical_and(pi < npg, pg >= 0)),
+                   pg, cache.pages + 1)   # past the sink row: dropped
+    rw = pos % ps
+
+    def write(pool, rows):
+        vals = jnp.moveaxis(rows.astype(pool.dtype), 1, 0)  # (C, H, d)
+        return pool.at[pg, :, rw, :].set(vals, mode='drop')
+
+    return cache._replace(k_pool=write(cache.k_pool, k_rows),
+                          v_pool=write(cache.v_pool, v_rows))
+
+
+def paged_reset_slot(cache: PagedDecodeCache, slot, freed_pages):
+    """Evict one sequence from a paged cache: zero the pool pages in
+    ``freed_pages`` (a ``(pages_per_slot,) int32`` vector, −1-padded —
+    the pages whose refcount the host allocator just dropped to zero;
+    still-shared pages are NOT listed and keep their bits), clear the
+    slot's page-table row and zero its length. Zeroing freed pages is
+    what keeps a recycled page's unfilled tail benign: the masked
+    attention multiplies it by exactly 0, and a NaN left behind by a
+    poisoned sequence would otherwise leak into its next owner's
+    output (0 · NaN = NaN)."""
+    idx = jnp.asarray(freed_pages, jnp.int32)
+    idx = jnp.where(idx >= 0, idx, cache.pages + 1)  # −1 pads: dropped
+
+    def clear(pool):
+        return pool.at[idx].set(jnp.zeros((), pool.dtype), mode='drop')
+
+    sel = jnp.arange(cache.slots) == slot
+    return PagedDecodeCache(
+        k_pool=clear(cache.k_pool), v_pool=clear(cache.v_pool),
+        page_table=jnp.where(sel[:, None], -1, cache.page_table),
+        length=jnp.where(sel, 0, cache.length))
+
+
+def paged_copy_attach(cache: PagedDecodeCache, src_page, dst_page, slot,
+                      length_val):
+    """The copy-on-write / attach primitive, one compiled program for
+    all three uses: copy pool page ``src_page`` → ``dst_page`` (both
+    scalars; −1 = no copy) and set ``length[slot] = length_val``
+    (``slot = −1`` = no length change). CoW passes pages only; prefix
+    attach and fork pass the partial tail-page copy plus the inherited
+    length. The page table is host-owned; the caller re-mirrors it."""
+    dst = jnp.where(dst_page >= 0, dst_page, cache.pages + 1)[None]
+
+    def copy(pool):
+        val = jnp.take(pool, jnp.maximum(src_page, 0)[None], axis=0)
+        return pool.at[dst].set(val, mode='drop')
+
+    sel = jnp.arange(cache.slots) == slot
+    return cache._replace(
+        k_pool=copy(cache.k_pool), v_pool=copy(cache.v_pool),
+        length=jnp.where(sel, jnp.asarray(length_val, jnp.int32),
+                         cache.length))
+
+
+class PagePool:
+    """Host-side page allocator for a :class:`PagedDecodeCache`: free
+    list, per-page refcounts, per-slot page-table mirror and length
+    mirror. Pure numpy bookkeeping — deterministic (LIFO free list),
+    no device work; the owner performs the device-side copies/zeroing
+    its return values call for and re-mirrors :attr:`table` to the
+    device when :attr:`dirty` is set.
+
+    Sharing model: a page's refcount counts the page-table rows (plus
+    registered prefixes) naming it. Pages are only ever WRITTEN at
+    refcount 1 — :meth:`prepare_append` returns the copy-on-write pair
+    when a slot's append page is shared, and :meth:`fork` /
+    :meth:`attach` share full pages read-only while copying the partial
+    tail page the branch will append into."""
+
+    def __init__(self, pages, page_size, slots, pages_per_slot):
+        self.pages = pages
+        self.page_size = page_size
+        self.slots = slots
+        self.pages_per_slot = pages_per_slot
+        self.refcount = np.zeros(pages, np.int32)
+        self._free = list(range(pages - 1, -1, -1))   # pop() → 0, 1, …
+        self.table = np.full((slots, pages_per_slot), -1, np.int32)
+        self.counts = np.zeros(slots, np.int32)       # pages per slot
+        self.lengths = np.zeros(slots, np.int64)      # fill per slot
+        self.dirty = False          # table changed since last mirror
+
+    # -- introspection --------------------------------------------------
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.pages - len(self._free)
+
+    @property
+    def shared_pages(self):
+        """Pages referenced more than once — the prefix-sharing/fork
+        win, and the acceptance gauge ('the prefix's pages occupied
+        exactly once')."""
+        return int(np.sum(self.refcount > 1))
+
+    def slot_pages(self, slot):
+        return int(self.counts[slot])
+
+    def pages_for_rows(self, rows):
+        """Pages a fresh sequence of ``rows`` tokens needs."""
+        return -(-rows // self.page_size)
+
+    # -- allocation -----------------------------------------------------
+    def alloc(self):
+        """One free page at refcount 1, or None (exhausted). Freshly
+        allocated pages are always zero: init starts them zero and
+        :meth:`_unref` only frees a page after the owner zeroes it."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refcount[page] = 1
+        return page
+
+    def _unref(self, page):
+        self.refcount[page] -= 1
+        if self.refcount[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def alloc_block(self, n):
+        """Allocate ``n`` fresh pages as one unit (prefix
+        registration). Returns the page list, or None with NOTHING
+        changed when the pool cannot supply all of them (partial
+        allocations roll back — never-written pages go straight back
+        on the free list, still zero)."""
+        pages = []
+        for _ in range(n):
+            p = self.alloc()
+            if p is None:
+                for q in reversed(pages):
+                    self.refcount[q] = 0
+                    self._free.append(q)
+                return None
+            pages.append(p)
+        return pages
+
+    def release_pages(self, pages):
+        """Drop one reference from each page. Returns the pages that
+        hit refcount 0 — back on the free list, and owed a device zero
+        by the caller before any reuse (the :meth:`alloc` invariant)."""
+        return [p for p in pages if self._unref(p)]
+
+    def prepare_append(self, slot):
+        """Make the next append position of ``slot`` writable. Returns
+        ``(status, src, dst)``: ``('ok', -1, -1)`` nothing to do;
+        ``('alloc', -1, page)`` a fresh (zero) page was mapped;
+        ``('cow', src, dst)`` the append page was shared — the caller
+        must device-copy ``src → dst`` (copy-on-write: the FIRST
+        divergent append after a fork/attach pays one page copy);
+        ``('full', -1, -1)`` the slot is at ``t_max`` — no page can
+        ever cover the position, the device write drops (the slab
+        engine's frozen-write contract), and allocating would not
+        help; ``('exhausted', -1, -1)`` the pool is out of pages and
+        nothing changed."""
+        pos = int(self.lengths[slot])
+        pi = pos // self.page_size
+        if pi >= self.pages_per_slot:
+            return ('full', -1, -1)
+        if pi >= self.counts[slot]:
+            page = self.alloc()
+            if page is None:
+                return ('exhausted', -1, -1)
+            self.table[slot, pi] = page
+            self.counts[slot] = pi + 1
+            self.dirty = True
+            return ('alloc', -1, page)
+        page = int(self.table[slot, pi])
+        if self.refcount[page] > 1:
+            fresh = self.alloc()
+            if fresh is None:
+                return ('exhausted', -1, -1)
+            self.refcount[page] -= 1        # > 1 before: never frees
+            self.table[slot, pi] = fresh
+            self.dirty = True
+            return ('cow', page, fresh)
+        return ('ok', -1, -1)
+
+    def reserve_rows(self, slot, rows):
+        """Reserve every page covering logical rows ``[length, length +
+        rows)`` of ``slot`` (admission-time: a prompt's prefill must
+        never fail mid-chunk). Returns ``(ok, copies)`` — ``copies``
+        is the list of ``(src, dst)`` device copies the caller owes
+        (at most one: the shared tail page). On exhaustion nothing is
+        changed (partial allocations are rolled back)."""
+        start = int(self.lengths[slot])
+        end = start + rows
+        if end > self.pages_per_slot * self.page_size:
+            return False, []
+        counts0 = int(self.counts[slot])
+        undo = []                   # (pi, previous_entry, was_cow)
+        copies = []
+        for pi in range(start // self.page_size,
+                        -(-end // self.page_size)):
+            if pi >= self.counts[slot]:
+                page = self.alloc()
+                if page is None:
+                    self._undo_reserve(slot, undo, counts0)
+                    return False, []
+                undo.append((pi, -1, False))
+                self.table[slot, pi] = page
+                self.counts[slot] = pi + 1
+                self.dirty = True
+            else:
+                page = int(self.table[slot, pi])
+                if self.refcount[page] > 1:
+                    dup = self.alloc()
+                    if dup is None:
+                        self._undo_reserve(slot, undo, counts0)
+                        return False, []
+                    undo.append((pi, page, True))
+                    self.refcount[page] -= 1
+                    self.table[slot, pi] = dup
+                    copies.append((page, dup))
+                    self.dirty = True
+        return True, copies
+
+    def _undo_reserve(self, slot, undo, counts0):
+        """Roll a partial :meth:`reserve_rows` back: on exhaustion the
+        pool and the slot's row look exactly as they did before the
+        call (a shed admission must not leak pages or CoW remaps)."""
+        for pi, prev, was_cow in reversed(undo):
+            page = int(self.table[slot, pi])
+            self.refcount[page] = 0
+            self._free.append(page)
+            self.table[slot, pi] = prev
+            if was_cow:
+                self.refcount[prev] += 1
+        self.counts[slot] = counts0
+
+    def release(self, slot):
+        """Drop every page reference ``slot`` holds; returns the pages
+        whose refcount reached zero (the caller zeroes them on device
+        BEFORE they can be re-allocated) and clears the slot's row and
+        length."""
+        freed = []
+        for pi in range(int(self.counts[slot])):
+            page = int(self.table[slot, pi])
+            if page >= 0 and self._unref(page):
+                freed.append(page)
+        self.table[slot, :] = -1
+        self.counts[slot] = 0
+        self.lengths[slot] = 0
+        self.dirty = True
+        return freed
+
+    # -- sharing --------------------------------------------------------
+    def attach(self, slot, pages, length):
+        """Point an EMPTY slot at a registered prefix: share the full
+        pages read-only (refcount++), and if ``length`` ends mid-page
+        allocate a private tail page the caller must device-copy the
+        prefix's tail into. Returns ``(ok, tail_src, tail_dst)`` with
+        −1s when no tail copy is needed; on exhaustion nothing is
+        changed."""
+        if self.counts[slot] or self.lengths[slot]:
+            raise ValueError(f'attach needs an empty slot, slot {slot} '
+                             f'holds {self.counts[slot]} pages')
+        full = length // self.page_size
+        rem = length % self.page_size
+        tail_src = tail_dst = -1
+        if rem:
+            tail_dst = self.alloc()
+            if tail_dst is None:
+                return False, -1, -1
+            tail_src = int(pages[full])
+        for i in range(full):
+            self.table[slot, i] = pages[i]
+            self.refcount[pages[i]] += 1
+        if rem:
+            self.table[slot, full] = tail_dst
+        self.counts[slot] = full + (1 if rem else 0)
+        self.lengths[slot] = length
+        self.dirty = True
+        return True, tail_src, tail_dst
+
+    def fork(self, src, dst):
+        """Copy-on-write fork ``src → dst`` (an empty slot): full pages
+        shared (refcount++), the partial tail page — the only page the
+        branches will write divergently — copied. Returns ``(ok,
+        tail_src, tail_dst)`` exactly like :meth:`attach`."""
+        length = int(self.lengths[src])
+        pages = [int(self.table[src, i])
+                 for i in range(int(self.counts[src]))]
+        return self.attach(dst, pages, length)
+
+
+def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None):
     """Can :func:`decode_step` take the fused Pallas kernel for this
     call? The kernel covers the serving hot path — one new token per
     slot, causal/window/ALiBi/GQA masking, the int8 mirror — and leaves
     the long tail (packed segments, multi-row chunks, mirror-less int8,
-    K splits that don't divide ``t_max``) to the XLA formulation."""
+    K splits that don't divide ``t_max``) to the XLA formulation.
+    Paged caches are kernel-native (the page size IS the K split) minus
+    the int8 mirror, which the pool doesn't carry yet — and the page
+    size must sit under the same VMEM cap the slab split honors (an
+    oversized page would double-buffer a K+V stream past the budget;
+    those caches take the XLA path)."""
     from distributed_dot_product_tpu.ops.pallas_decode import (
+        _BLOCK_K_CAP,
         decode_block_k,
     )
     if n != 1 or segment_ids is not None:
         return False
+    if isinstance(cache, PagedDecodeCache):
+        return qk_quant is None and cache.page_size <= _BLOCK_K_CAP
     if qk_quant == 'int8' and cache.k_q is None:
         return False
     return decode_block_k(cache.t_max) is not None
@@ -396,7 +895,8 @@ def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant):
         raise ValueError(
             'decode_step: the fused kernel does not cover this call '
             '(needs n=1, no segment_ids, an int8 mirror when '
-            "qk_quant='int8', and a t_max the K split divides) — use "
+            "qk_quant='int8', a t_max the K split divides, and a "
+            'paged page size within the K-split VMEM cap) — use '
             "impl='auto' to fall back")
     return impl
 
@@ -429,7 +929,12 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     """
     n = q.shape[-2]
     impl = _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant)
+    paged = isinstance(cache, PagedDecodeCache)
     per_slot = cache.length.ndim == 1
+    if paged and axis_name is not None:
+        raise ValueError(
+            'paged caches are a local serving construct; sequence-'
+            'sharded decode uses the scalar-length slab cache')
     if per_slot and axis_name is not None:
         raise ValueError(
             'per-slot lengths (init_slot_cache) are a local serving '
@@ -449,8 +954,17 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
                                     slot_mask=slot_mask)
         else:
             cache = append_kv(cache, k_new, v_new)
+        attend = cache
+        if paged:
+            # Reference formulation: attend against the gathered slab
+            # view — the IDENTICAL masked math as the slab path, so the
+            # paged step matches it bit for bit (the contract the tests
+            # pin). The gather is O(t_max) traffic, the same order as
+            # the attention read itself; the kernel path avoids it.
+            gk, gv = paged_gather(cache)
+            attend = DecodeCache(k=gk, v=gv, length=cache.length)
         out = decode_attention(
-            q, cache, scale=scale, window=window,
+            q, attend, scale=scale, window=window,
             alibi_slopes=alibi_slopes, segment_ids=segment_ids,
             seg_q=seg_q, qk_quant=qk_quant, axis_name=axis_name)
         return cache, out
@@ -504,6 +1018,18 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
         adv = active.astype(cache.length.dtype)
         new_length = (cache.length + adv if per_slot
                       else cache.length + 1)
+
+    if paged:
+        # Same fused program, page-table-redirected DMA: the BlockSpec
+        # index maps read the prefetched page-table row, aliasing still
+        # writes only the single append page (ops/pallas_decode.py).
+        out, new_k, new_v, _, _ = flash_decode(
+            q, k_new, v_new, cache.k_pool, cache.v_pool, vt, ap,
+            page_table=cache.page_table, scale=scale, window=window,
+            alibi_slopes=alibi_slopes, interpret=interpret)
+        return PagedDecodeCache(k_pool=new_k, v_pool=new_v,
+                                page_table=cache.page_table,
+                                length=new_length), out
 
     res = flash_decode(
         q, k_new, v_new, cache.k, cache.v, vt, ap,
@@ -614,10 +1140,51 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k, o[0].v],
             expect_donation=True, donate_argnums=(1,), min_donated=2)
 
+    def _paged_args():
+        b, h, d = 2, 2, 8
+        cache = init_paged_cache(b, h, 32, d, pages=6, page_size=8,
+                                 dtype=jnp.bfloat16)
+        # A realistic mid-serve table: slot 0 holds two pages (fill 10),
+        # slot 1 one page (fill 3); pool page 3 stays free.
+        cache = cache._replace(
+            page_table=jnp.array([[0, 1, -1, -1], [2, -1, -1, -1]],
+                                 jnp.int32),
+            length=jnp.array([10, 3], jnp.int32))
+        new = jnp.zeros((b, h, 1, d), jnp.bfloat16)
+        return cache, new
+
+    def step_paged_xla():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        cache, new = _paged_args()
+        return TraceSpec(
+            name='decode.step_paged_xla',
+            fn=partial(decode_step, impl='xla'),
+            args=(new, cache, new, new),
+            cache_in=lambda a: [a[1].k_pool, a[1].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    def step_paged_kernel():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        cache, new = _paged_args()
+        return TraceSpec(
+            name='decode.step_paged_kernel',
+            fn=partial(decode_step, impl='kernel', interpret=True),
+            args=(new, cache, new, new),
+            cache_in=lambda a: [a[1].k_pool, a[1].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
     return {
         'decode.step_xla_slots': step_xla_slots,
         'decode.step_kernel_int8': step_kernel_int8,
         'decode.step_sharded': step_sharded,
+        'decode.step_paged_xla': step_paged_xla,
+        'decode.step_paged_kernel': step_paged_kernel,
     }
 
 

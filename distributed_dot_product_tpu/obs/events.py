@@ -55,6 +55,11 @@ ENV_VAR = 'DDP_TPU_EVENT_LOG'
 # fail offline validation.
 EVENT_SCHEMA = {
     # -- serving lifecycle (serve/scheduler.py, serve/admission.py) ----
+    # `reason` values come from admission.RejectReason: queue_full,
+    # deadline_exceeded, prompt_too_long, cache_exhausted (paged
+    # KV-pool exhaustion — static impossibility at submit, or spent
+    # preemption retries stamped on the terminal evict/retire),
+    # prefix_unregistered (unknown/unregistered shared prefix).
     'serve.admit': ('request_id', 'slot'),
     'serve.reject': ('request_id', 'reason'),
     'serve.evict': ('request_id', 'slot'),
@@ -62,6 +67,9 @@ EVENT_SCHEMA = {
     'serve.decode': ('request_id', 'slot', 'token_index'),
     'serve.retire': ('request_id', 'status'),
     'serve.quarantine': ('request_id', 'slot', 'requeued'),
+    # Paged pool ran dry under this slot mid-stream: slot freed, request
+    # requeued (True) or terminally evicted CACHE_EXHAUSTED (False).
+    'serve.preempt': ('request_id', 'slot', 'requeued'),
     # -- training driver (train_loop.py via utils.tracing.log_step) ----
     'train.step': ('step', 'loss'),
     'train.bad_step': ('step',),

@@ -31,7 +31,7 @@ __all__ = ['Timeline', 'timeline', 'reconstruct']
 _TERMINAL = {'serve.retire', 'serve.reject'}
 # Events legal only while the request holds a slot.
 _RUNNING_ONLY = {'serve.prefill', 'serve.decode', 'serve.evict',
-                 'serve.quarantine'}
+                 'serve.quarantine', 'serve.preempt'}
 
 
 @dataclasses.dataclass
@@ -51,6 +51,7 @@ class Timeline:
     total_seconds: Optional[float] = None
     admits: int = 0
     quarantines: int = 0
+    preempts: int = 0
     tokens: int = 0
 
     def phases(self):
@@ -96,6 +97,12 @@ def _validate(tl: Timeline):
                 tl.quarantines += 1
                 # Quarantine frees the slot: a requeued request must be
                 # re-admitted; an exhausted one goes straight to retire.
+                state = 'queued' if rec.get('requeued') else 'running'
+            elif ev == 'serve.preempt':
+                # Page-pool preemption: same slot-freeing arc as a
+                # quarantine (requeued → re-admit; exhausted retries →
+                # the terminal evict/retire follows while 'running').
+                tl.preempts += 1
                 state = 'queued' if rec.get('requeued') else 'running'
         elif ev == 'serve.retire':
             tl.status = rec.get('status')

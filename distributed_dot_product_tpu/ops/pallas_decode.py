@@ -100,12 +100,19 @@ def _pad_rows(x, mult):
 
 
 def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
-                        has_alibi):
+                        has_alibi, paged=False):
     """Kernel body; refs are ordered to match ``flash_decode``'s spec
     list below. Grid = (B·H_kv, ns) with the K split innermost; the
-    running softmax state lives in scratch across splits."""
+    running softmax state lives in scratch across splits.
 
-    def kernel(vt_ref, ap_ref, *refs):
+    The PAGED variant is the same body verbatim: grid step ``ki`` is the
+    LOGICAL page ordinal, so every mask/score/append computation below
+    already speaks logical positions — only the BlockSpec index maps
+    (which translate logical ordinal → pool page) differ, and those
+    live in ``flash_decode``. The page-table prefetch ref is consumed
+    by the index maps alone."""
+
+    def kernel_body(vt_ref, ap_ref, *refs):
         b = pl.program_id(0)
         ki = pl.program_id(1)
         br = b // h_kv                          # cache batch row
@@ -224,13 +231,20 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
             m_ref[0] = m_s[:]
             l_ref[0] = l_s[:]
 
-    return kernel
+    if not paged:
+        return kernel_body
+
+    def kernel_paged(vt_ref, ap_ref, pt_ref, *refs):
+        del pt_ref                      # index maps' operand, not ours
+        kernel_body(vt_ref, ap_ref, *refs)
+
+    return kernel_paged
 
 
 def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
-                 *, k_q=None, k_scale=None, scale=None, window=None,
-                 alibi_slopes=None, qk_quant=None, interpret=None,
-                 block_k=None, partials=False):
+                 *, page_table=None, k_q=None, k_scale=None, scale=None,
+                 window=None, alibi_slopes=None, qk_quant=None,
+                 interpret=None, block_k=None, partials=False):
     """One fused decode step: in-place cache append + masked online-
     softmax attention of each slot's query against its own prefix.
 
@@ -253,6 +267,19 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     dequantization — the mirror's halved K bytes become halved stream
     traffic. The mirror and the bf16 buffer are BOTH appended in place.
 
+    ``page_table (B, pages_per_slot) int32``: PAGED mode —
+    ``cache_k``/``cache_v`` are global ``(pages + 1, H_kv, page_size,
+    d·)`` pools whose LAST row is the reserved write-sink page
+    (``init_paged_cache`` reserves it) and each slot's K split streams
+    the pool pages its table row names (−1 = unallocated → the sink,
+    fully masked; a slot appending nothing also writes its mandatory
+    block flush to the sink, so no grid row ever writes a live page it
+    doesn't own). The K split IS
+    the page size, the grid and kernel body are unchanged — paging
+    costs one prefetched index lookup per block, not a new kernel —
+    and aliasing still writes only the single append page. The int8
+    mirror is not carried on the pool (XLA path covers paged int8).
+
     Returns ``(out, cache_k, cache_v, k_q, k_scale)`` with
     ``out (B, H, 1, dv)`` in ``cache_v.dtype`` — or, with
     ``partials=True``, ``((num, m, l), cache_k, cache_v, k_q, k_scale)``
@@ -261,8 +288,9 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     flash-decoding cross-shard merge (pmax the maxes, rescale, psum).
     """
     b, h, n, d = q.shape
-    h_kv, t_max = cache_k.shape[1], cache_k.shape[2]
+    h_kv = cache_k.shape[1]
     dv = cache_v.shape[-1]
+    paged = page_table is not None
     if n != 1:
         raise ValueError(f'flash_decode is a single-token kernel; got '
                          f'{n} query rows (use prefill for chunks)')
@@ -273,19 +301,32 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     if qk_quant not in (None, 'int8'):
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')
+    if quantized and paged:
+        raise ValueError('the paged pool carries no int8 mirror — use '
+                         "the XLA decode path for qk_quant='int8'")
     if quantized and (k_q is None or k_scale is None):
         raise ValueError("qk_quant='int8' needs the cache's k_q/k_scale "
                          'mirror (init_cache(qk_quant=...))')
-    bk = block_k or decode_block_k(t_max)
-    if bk is None or t_max % bk:
-        raise ValueError(
-            f'no usable K split for t_max={t_max} (block_k must divide '
-            f'it); use the XLA decode path for this cache shape')
+    if paged:
+        n_pages, bk = cache_k.shape[0], cache_k.shape[2]
+        ns = page_table.shape[1]            # logical pages per slot
+        t_max = ns * bk
+        if block_k not in (None, bk):
+            raise ValueError(f'paged decode splits K at the page size '
+                             f'{bk}; block_k={block_k} cannot differ')
+    else:
+        t_max = cache_k.shape[2]
+        bk = block_k or decode_block_k(t_max)
+        if bk is None or t_max % bk:
+            raise ValueError(
+                f'no usable K split for t_max={t_max} (block_k must '
+                f'divide it); use the XLA decode path for this cache '
+                f'shape')
+        ns = t_max // bk
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     group = h // h_kv
-    ns = t_max // bk
     nb = b * h_kv
 
     # Query rows grouped per cache head, padded to the sublane multiple
@@ -307,8 +348,25 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
 
     knf = k_new.astype(cache_k.dtype).reshape(nb, 1, d)
     vnf = v_new.astype(cache_v.dtype).reshape(nb, 1, dv)
-    kf = cache_k.reshape(nb, t_max, d)
-    vf = cache_v.reshape(nb, t_max, dv)
+    if paged:
+        # Pool flattening mirrors the slab's (B, H_kv) fold: pool page
+        # p's head hh lives at flat row p·H_kv + hh, so one BlockSpec
+        # row index addresses (page, head) exactly like (slot, head).
+        kf = cache_k.reshape(n_pages * h_kv, bk, d)
+        vf = cache_v.reshape(n_pages * h_kv, bk, dv)
+        # −1 (unallocated) redirects to the pool's reserved SINK row
+        # (last page, never allocated — init_paged_cache): an empty
+        # slot streams sink garbage (fully masked) and, crucially,
+        # never WRITES a page another slot owns — Pallas flushes every
+        # output block, and grid rows have no cross-row write ordering
+        # on real TPU, so parking idle write-backs on a live page
+        # would race an in-flight append.
+        sink = n_pages - 1
+        ptf = jnp.where(page_table >= 0, page_table,
+                        sink).astype(jnp.int32).reshape(-1)
+    else:
+        kf = cache_k.reshape(nb, t_max, d)
+        vf = cache_v.reshape(nb, t_max, dv)
     valid_to = jnp.asarray(valid_to, jnp.int32)
     append_at = jnp.asarray(append_at, jnp.int32)
 
@@ -326,11 +384,30 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         a = ap[bi // h_kv]
         return jnp.where(a >= 0, jnp.clip(a // bk, 0, ns - 1), 0)
 
-    def stream_idx(bi, ki, vt, ap):
-        return (bi, _stream_blk(bi, ki, vt), 0)
+    if paged:
+        # The tentpole redirect: the index map translates the LOGICAL
+        # block ordinal through the prefetched page-table row instead
+        # of using it as the physical block — the gather that makes
+        # paging nearly free (same DMA skip, same aliasing).
+        def stream_idx(bi, ki, vt, ap, pt):
+            blk = _stream_blk(bi, ki, vt)
+            return (pt[(bi // h_kv) * ns + blk] * h_kv + bi % h_kv,
+                    0, 0)
 
-    def write_idx(bi, ki, vt, ap):
-        return (bi, _write_blk(bi, ap), 0)
+        def write_idx(bi, ki, vt, ap, pt):
+            # Appending nothing → write-back lands on the sink page,
+            # never on a page some other slot is appending into.
+            br = bi // h_kv
+            a = ap[br]
+            blk = jnp.clip(a // bk, 0, ns - 1)
+            page = jnp.where(a >= 0, pt[br * ns + blk], sink)
+            return (page * h_kv + bi % h_kv, 0, 0)
+    else:
+        def stream_idx(bi, ki, vt, ap):
+            return (bi, _stream_blk(bi, ki, vt), 0)
+
+        def write_idx(bi, ki, vt, ap):
+            return (bi, _write_blk(bi, ap), 0)
 
     # The int8 scale mirror rides as a (nb, 1, t_max) ROW vector (a
     # size-1-axis reshape — a bitcast, not a transpose), blocked on the
@@ -399,22 +476,26 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         jax.ShapeDtypeStruct(kf.shape, kf.dtype),
         jax.ShapeDtypeStruct(vf.shape, vf.dtype),
     ]
-    # +2: alias indices count the scalar-prefetch operands.
-    aliases = {2 + k_in_pos: 3, 2 + v_in_pos: 4}
+    # +n_prefetch: alias indices count the scalar-prefetch operands
+    # (valid_to, append_at, and — paged — the flattened page table).
+    n_prefetch = 3 if paged else 2
+    aliases = {n_prefetch + k_in_pos: 3, n_prefetch + v_in_pos: 4}
     if quantized:
         out_specs += [pl.BlockSpec((1, bk, d), write_idx),
                       pl.BlockSpec((1, 1, bk), write_idx_row)]
         out_shape += [jax.ShapeDtypeStruct(kqf.shape, kqf.dtype),
                       jax.ShapeDtypeStruct(ksf.shape, ksf.dtype)]
-        aliases[2 + kq_in_pos] = 5
-        aliases[2 + ks_in_pos] = 6
+        aliases[n_prefetch + kq_in_pos] = 5
+        aliases[n_prefetch + ks_in_pos] = 6
 
     kernel = _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
-                                 has_alibi)
+                                 has_alibi, paged=paged)
+    prefetch = ((valid_to, append_at, ptf) if paged
+                else (valid_to, append_at))
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=n_prefetch,
             grid=(nb, ns),
             in_specs=in_specs,
             out_specs=out_specs,
@@ -423,7 +504,7 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
                             pltpu.VMEM((g_pad, dv), jnp.float32)]),
         out_shape=out_shape,
         input_output_aliases=aliases,
-        interpret=interpret)(valid_to, append_at, *args)
+        interpret=interpret)(*prefetch, *args)
 
     num, m, l, new_k, new_v = outs[:5]
     new_kq = new_ks = None

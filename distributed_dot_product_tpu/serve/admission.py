@@ -46,6 +46,13 @@ class RejectReason(enum.Enum):
     QUEUE_FULL = 'queue_full'
     DEADLINE_EXCEEDED = 'deadline_exceeded'
     PROMPT_TOO_LONG = 'prompt_too_long'
+    # Paged KV pool (scheduler over a cache_mode='paged' engine): the
+    # request needs more pool pages than the pool can EVER provide, or
+    # mid-stream page exhaustion outlasted its preemption retries.
+    CACHE_EXHAUSTED = 'cache_exhausted'
+    # The request names a shared prefix that is not (or no longer)
+    # registered — at submit, or unregistered while it sat queued.
+    PREFIX_UNREGISTERED = 'prefix_unregistered'
 
 
 class RejectedError(Exception):
@@ -70,6 +77,11 @@ class Request:
     deadline: Optional[float] = None
     id: str = ''
     submitted_at: float = 0.0
+    # Paged serving: id of a registered shared prefix the prompt
+    # CONTINUES (the prompt tokens come after it), and its length —
+    # admission budgets against prefix_len + len(prompt).
+    prefix_id: Optional[int] = None
+    prefix_len: int = 0
     # -- runtime state (scheduler-owned) --------------------------------
     tokens: List[int] = dataclasses.field(default_factory=list)
     requeues: int = 0
@@ -112,11 +124,15 @@ class AdmissionController:
 
     def __init__(self, *, queue_limit, t_max, max_new_tokens,
                  degrade_watermark=0.75, degraded_max_new_tokens=None,
-                 clock=time.monotonic, registry=None, event_log=None):
+                 clock=time.monotonic, registry=None, event_log=None,
+                 capacity_tokens=None):
         if queue_limit < 1:
             raise ValueError(f'queue_limit must be >= 1, got {queue_limit}')
         self.queue_limit = queue_limit
         self.t_max = t_max
+        # Paged pool: most rows ONE request can ever hold (pool pages ×
+        # page size, capped by t_max). None = slab (t_max governs).
+        self.capacity_tokens = capacity_tokens
         self.max_new_tokens = max_new_tokens
         self.degrade_watermark = degrade_watermark
         self.degraded_max_new_tokens = (degraded_max_new_tokens
@@ -169,34 +185,85 @@ class AdmissionController:
                        reason=reason.value, queued=False)
         raise RejectedError(reason, message)
 
+    def reject(self, reason: RejectReason, message: str,
+               request_id=None):
+        """Public typed shed: counter + submit-time event + raise —
+        for reject conditions the CALLER owns (the scheduler's paged
+        checks), so they account exactly like queue/deadline sheds."""
+        self._reject(reason, message, request_id=request_id)
+
     def reject_count(self, reason: RejectReason):
         c = self._c_reject.get(reason)
         return c.value if c is not None else 0
 
+    def count_reject(self, reason: RejectReason):
+        """Count a scheduler-owned shed that is FINALIZED rather than
+        raised (tick-time rejects of already-queued requests): same
+        counters as submit-time sheds, no exception — dashboards see
+        every typed reject however it was delivered."""
+        if reason in self._c_reject:
+            self._c_reject[reason].inc()
+
     # -- admission ------------------------------------------------------
     def validate(self, request: Request, now=None):
         """Typed-reject anything that can never be served: an expired
-        deadline, or a prompt leaving no room to generate one token.
+        deadline, a prompt leaving no room to generate one token, or —
+        paged — a sequence no pool-sized allocation can ever hold.
         Clamps the token budget to the config cap and cache capacity."""
         now = self.clock() if now is None else now
         if request.deadline is not None and request.deadline <= now:
             self._reject(RejectReason.DEADLINE_EXCEEDED,
                          f'request {request.id}: deadline already passed '
                          f'at submit', request_id=request.id)
-        room = self.t_max - len(request.prompt)
+        full_len = request.prefix_len + len(request.prompt)
+        room = self.t_max - full_len
         if len(request.prompt) < 1 or room < 1:
             self._reject(RejectReason.PROMPT_TOO_LONG,
                          f'request {request.id}: prompt of '
-                         f'{len(request.prompt)} tokens leaves no room '
-                         f'to generate in a t_max={self.t_max} cache',
+                         f'{full_len} tokens (prefix included) leaves '
+                         f'no room to generate in a t_max={self.t_max} '
+                         f'cache', request_id=request.id)
+        if self.capacity_tokens is not None \
+                and full_len + 1 > self.capacity_tokens:
+            # Statically impossible however long it waits: the POOL
+            # cannot hold the prompt plus one generated token.
+            self._reject(RejectReason.CACHE_EXHAUSTED,
+                         f'request {request.id}: {full_len} prompt rows '
+                         f'+ 1 exceed the page pool\'s '
+                         f'{self.capacity_tokens}-row capacity',
                          request_id=request.id)
+        self.clamp_budget(request)
+
+    def clamp_budget(self, request: Request):
+        """Clamp the token budget to the config cap and the cache/pool
+        capacity. This is the ONE place the budget policy lives:
+        submit-time :meth:`validate` and the scheduler's ``fork`` (which
+        places a branch without queueing) both apply it, so a forked
+        branch can never hold a slot or commit pool pages past what a
+        submitted request could."""
+        full_len = request.prefix_len + len(request.prompt)
+        room = self.t_max - full_len
+        if self.capacity_tokens is not None:
+            room = min(room, self.capacity_tokens - full_len)
         request.max_new_tokens = max(1, min(request.max_new_tokens,
                                             self.max_new_tokens, room))
 
-    def maybe_degrade(self, request: Request):
+    def count_admit(self):
+        """Count an admission that never crossed the queue (the
+        scheduler's ``fork`` places the branch straight into a slot):
+        same counter as queued admissions, so in-flight accounting over
+        admitted − terminal stays balanced when fork is used."""
+        if self._c_admit is not None:
+            self._c_admit.inc()
+
+    def maybe_degrade(self, request: Request, pressure=None):
         """Above the pressure watermark, cap the request's token budget
-        instead of rejecting it — rung one of the degradation ladder."""
-        if self.pressure >= self.degrade_watermark \
+        instead of rejecting it — rung one of the degradation ladder.
+        ``pressure`` overrides the queue-depth default (the scheduler
+        passes max(queue, page-pool) pressure on paged engines, so page
+        exhaustion degrades before it evicts before it rejects)."""
+        pressure = self.pressure if pressure is None else pressure
+        if pressure >= self.degrade_watermark \
                 and request.max_new_tokens > self.degraded_max_new_tokens:
             request.max_new_tokens = self.degraded_max_new_tokens
             request.degraded = True

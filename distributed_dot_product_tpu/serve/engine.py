@@ -40,6 +40,7 @@ requeued request regenerates the same tokens) rest on this property,
 and the tests pin it.
 """
 
+import itertools
 import os
 
 import numpy as np
@@ -48,8 +49,9 @@ import jax
 import jax.numpy as jnp
 
 from distributed_dot_product_tpu.models.decode import (
-    append_kv_slots, decode_step, init_slot_cache, reset_slot,
-    slots_all_finite,
+    PagePool, append_kv_slots, decode_step, init_paged_cache,
+    init_slot_cache, paged_append_rows, paged_copy_attach,
+    paged_reset_slot, reset_slot, slots_all_finite,
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
@@ -73,6 +75,21 @@ def _resolve_decode_impl(decode_impl):
     return 'auto'
 
 
+def _resolve_cache_mode(cache_mode):
+    """Cache-layout selection: explicit argument wins; else the
+    ``DDP_TPU_PAGED_CACHE`` env knob (1/paged → page-pool cache); else
+    the slab reference layout."""
+    if cache_mode is not None:
+        if cache_mode not in ('slab', 'paged'):
+            raise ValueError(f"cache_mode must be 'slab' or 'paged', "
+                             f'got {cache_mode!r}')
+        return cache_mode
+    env = os.environ.get('DDP_TPU_PAGED_CACHE', '').strip().lower()
+    if env in ('1', 'true', 'paged'):
+        return 'paged'
+    return 'slab'
+
+
 class KernelEngine:
     """Greedy decode engine over ``slots`` independent sequences.
 
@@ -88,15 +105,30 @@ class KernelEngine:
     TPU). Token streams are deterministic within an impl; the two
     impls agree to float tolerance (exp2 vs exp rounding), so
     bit-identity guarantees hold per-impl, not across.
+
+    ``cache_mode='paged'`` (or ``DDP_TPU_PAGED_CACHE=1``) swaps the
+    per-slot slab for the page-pool cache (``models/decode.py``
+    ``PagedDecodeCache``): ``pages`` sizes the global pool (the memory
+    budget — decoupled from ``slots × t_max``), ``page_size`` the page
+    granularity (= the kernel's K split). The host :class:`PagePool`
+    owns allocation; :meth:`step`/:meth:`prefill` auto-reserve the
+    pages they need (raising on exhaustion), while the Scheduler calls
+    :meth:`prepare_step`/:meth:`reserve_rows` itself so a deficit
+    routes through its evict/preempt ladder instead of a raise.
+    :meth:`register_prefix`/:meth:`start_with_prefix` give refcounted
+    prefix sharing, :meth:`fork_slot` copy-on-write forks. Token
+    streams are bit-identical to the slab engine per impl.
     """
 
     def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
                  prefill_chunk=8, seed=0, dtype=jnp.float32,
-                 decode_impl=None):
+                 decode_impl=None, cache_mode=None, pages=None,
+                 page_size=None):
         if slots < 1 or t_max < 2:
             raise ValueError(f'need slots >= 1 and t_max >= 2, got '
                              f'{slots}/{t_max}')
         self.decode_impl = _resolve_decode_impl(decode_impl)
+        self.cache_mode = _resolve_cache_mode(cache_mode)
         self.slots = slots
         self.t_max = t_max
         self.vocab = vocab
@@ -111,8 +143,28 @@ class KernelEngine:
         self._wk = jax.random.normal(ks[2], (dim, dim), dtype) * scale
         self._wv = jax.random.normal(ks[3], (dim, dim), dtype) * scale
         self._wo = jax.random.normal(ks[4], (dim, vocab), dtype) * scale
-        self.cache = init_slot_cache(slots, heads, t_max, head_dim,
-                                     dtype=dtype)
+        if self.cache_mode == 'paged':
+            ps = page_size or min(16, t_max)
+            if t_max % ps:
+                raise ValueError(f'page_size {ps} must divide t_max '
+                                 f'{t_max}')
+            self.page_size = ps
+            # Default pool = the slab's bytes; the paged win comes from
+            # sizing `pages` to the MEMORY budget while raising `slots`
+            # past what a slab of the same bytes could hold.
+            n_pages = pages if pages is not None \
+                else slots * (t_max // ps)
+            self.pool = PagePool(n_pages, ps, slots, t_max // ps)
+            self.cache = init_paged_cache(slots, heads, t_max, head_dim,
+                                          pages=n_pages, page_size=ps,
+                                          dtype=dtype)
+            self._prefix_registry = {}
+            self._prefix_counter = itertools.count()
+        else:
+            self.page_size = None
+            self.pool = None
+            self.cache = init_slot_cache(slots, heads, t_max, head_dim,
+                                         dtype=dtype)
         # Donated caches: appends write in place — see models/decode.py's
         # performance note. One compiled program each for the lifetime —
         # and the retrace sentinel (analysis/retrace.py) enforces it:
@@ -129,9 +181,26 @@ class KernelEngine:
         self._prefill = jax.jit(
             watch_traces(self._prefill_impl, 'engine.prefill', budget=2),
             donate_argnums=(0,))
-        self._reset = jax.jit(
-            watch_traces(reset_slot, 'engine.reset', budget=2),
-            donate_argnums=(0,))
+        if self.cache_mode == 'paged':
+            self._reset = jax.jit(
+                watch_traces(paged_reset_slot, 'engine.reset', budget=2),
+                donate_argnums=(0,))
+            # The sharing primitives: CoW/fork/attach page copy (+
+            # length set) and registry prefix prefill — each one fixed
+            # compiled program, dispatched only on page crossings and
+            # prefix/fork events, never per token.
+            self._copy_attach = jax.jit(
+                watch_traces(paged_copy_attach, 'engine.copy_attach',
+                             budget=2),
+                donate_argnums=(0,))
+            self._prefix_fill = jax.jit(
+                watch_traces(self._prefix_fill_impl,
+                             'engine.prefix_fill', budget=2),
+                donate_argnums=(0,))
+        else:
+            self._reset = jax.jit(
+                watch_traces(reset_slot, 'engine.reset', budget=2),
+                donate_argnums=(0,))
 
     # -- compiled bodies ------------------------------------------------
     def _project(self, tokens):
@@ -161,21 +230,36 @@ class KernelEngine:
             axis=-1).astype(jnp.int32)
         return cache, next_tok, finite
 
-    def _prefill_impl(self, cache, slot, tokens, count):
-        """Append ``count`` of the ``prefill_chunk`` padded ``tokens``
-        into ``slot``'s rows. Projections are computed once and
-        broadcast — the masked write only lands on the one slot."""
+    def _project_kv(self, tokens):
+        """Chunk tokens ``(C,)`` → cache-layout k, v each ``(H, C, D)``
+        — the ONE projection both prefill paths share (a projection
+        change must hit slot prefill and registry prefix fill alike,
+        or shared-prefix pages would attend with different K/V)."""
         x = jnp.take(self._embed, tokens, axis=0)          # (C, dim)
         c = tokens.shape[0]
         k = jnp.moveaxis((x @ self._wk).reshape(
             c, self.heads, self.head_dim), 0, 1)           # (H, C, D)
         v = jnp.moveaxis((x @ self._wv).reshape(
             c, self.heads, self.head_dim), 0, 1)
+        return k, v
+
+    def _prefill_impl(self, cache, slot, tokens, count):
+        """Append ``count`` of the ``prefill_chunk`` padded ``tokens``
+        into ``slot``'s rows. Projections are computed once and
+        broadcast — the masked write only lands on the one slot."""
+        k, v = self._project_kv(tokens)
         k = jnp.broadcast_to(k[None], (self.slots,) + k.shape)
         v = jnp.broadcast_to(v[None], (self.slots,) + v.shape)
         sel = jnp.arange(self.slots) == slot
         counts = jnp.where(sel, count, 0).astype(jnp.int32)
         return append_kv_slots(cache, k, v, slot_mask=sel, counts=counts)
+
+    def _prefix_fill_impl(self, cache, tokens, count, page_row, start):
+        """Registry prefill: project one padded chunk and scatter its
+        first ``count`` rows into the REGISTRY-owned ``page_row`` pages
+        at logical positions ``start..`` — no slot, no length."""
+        k, v = self._project_kv(tokens)
+        return paged_append_rows(cache, k, v, page_row, start, count)
 
     # -- host surface (numpy in, numpy out) -----------------------------
     def step(self, tokens, active, poison=None, request_ids=None):
@@ -191,6 +275,25 @@ class KernelEngine:
         design)."""
         poison = (np.zeros(self.slots, bool) if poison is None
                   else np.asarray(poison, bool))
+        if self.cache_mode == 'paged':
+            # Auto-prepare only when something actually needs a page
+            # (a vectorized check — the scheduler's _ensure_pages
+            # already prepared, so the per-token hot path pays one
+            # numpy mask, not a per-slot Python loop). Direct callers
+            # just work; exhaustion raises here because a bare loop
+            # has no evict/preempt ladder to resolve it.
+            act = np.asarray(active, bool)
+            if not self._writable_mask(act).all():
+                ok = self.prepare_step(act)
+                if not ok.all():
+                    bad = np.nonzero(~ok)[0]
+                    raise RuntimeError(
+                        f'page pool exhausted for slot(s) '
+                        f'{bad.tolist()} ({self.pool.free_pages} pages '
+                        f'free) — retire or evict sequences (the '
+                        f'Scheduler ladder does), or size the pool '
+                        f'larger')
+            self._sync_page_table()
         # Span attrs are built ONLY when spans are on: this is the
         # per-token hot path, and the disabled default must not pay a
         # per-step tuple build for labels nobody will read.
@@ -200,6 +303,8 @@ class KernelEngine:
             self.cache, tok, finite = self._decode(
                 self.cache, jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(active, bool), jnp.asarray(poison))
+            if self.cache_mode == 'paged':
+                self.pool.lengths[np.asarray(active, bool)] += 1
             return np.asarray(tok), np.asarray(finite)
 
     def prefill(self, slot, tokens, request_id=None):
@@ -213,17 +318,233 @@ class KernelEngine:
                              f'{self.prefill_chunk}')
         buf = np.zeros(self.prefill_chunk, np.int32)
         buf[:n] = np.asarray(tokens, np.int32)
+        if self.cache_mode == 'paged':
+            # Auto-reserve the chunk's pages (no-op when the scheduler
+            # already reserved the whole prompt at admission).
+            pos = int(self.pool.lengths[slot])
+            if (pos + n) > int(self.pool.counts[slot]) * self.page_size \
+                    and not self.reserve_rows(slot, n):
+                raise RuntimeError(
+                    f'page pool exhausted prefilling rows '
+                    f'[{pos}, {pos + n}) of slot {slot} '
+                    f'({self.pool.free_pages} pages free)')
+            self._sync_page_table()
         with span('engine.prefill', slot=int(slot),
                   request=request_id or ''):
             self.cache = self._prefill(self.cache, jnp.int32(slot),
                                        jnp.asarray(buf), jnp.int32(n))
+        if self.cache_mode == 'paged':
+            self.pool.lengths[slot] += n
+
+    def _zero_freed(self, freed, slot=-1):
+        """Zero freed pool pages (and clear ``slot``'s rows/length when
+        one is named; slot −1 touches no slot) through the ONE compiled
+        reset program — the freed-page zeroing contract lives here."""
+        vec = np.full(self.pool.pages_per_slot, -1, np.int32)
+        vec[:len(freed)] = freed
+        self.cache = self._reset(self.cache, jnp.int32(slot),
+                                 jnp.asarray(vec))
 
     def reset(self, slot):
-        """Evict ``slot`` (zero rows + length); other slots untouched."""
-        self.cache = self._reset(self.cache, jnp.int32(slot))
+        """Evict ``slot`` (zero rows + length); other slots untouched.
+        Paged: drops the slot's page references and zeroes exactly the
+        pages that reached refcount 0 (still-shared prefix/fork pages
+        keep their bits — they are someone else's context)."""
+        if self.cache_mode == 'paged':
+            self._zero_freed(self.pool.release(slot), slot)
+            self._sync_page_table()
+        else:
+            self.cache = self._reset(self.cache, jnp.int32(slot))
 
     def lengths(self):
         return np.asarray(self.cache.length)
+
+    # -- paged-pool surface (cache_mode='paged') ------------------------
+    def _sync_page_table(self):
+        if self.pool.dirty:
+            self.cache = self.cache._replace(
+                page_table=jnp.asarray(self.pool.table))
+            self.pool.dirty = False
+
+    def _apply_copies(self, copies):
+        for src, dst in copies:
+            self.cache = self._copy_attach(
+                self.cache, jnp.int32(src), jnp.int32(dst),
+                jnp.int32(-1), jnp.int32(0))
+
+    def _writable_mask(self, active):
+        """Per active slot: does a PRIVATE page already cover its next
+        append position (the prepare_step()/reserve_rows()
+        postcondition)? Vectorized — this is the per-token fast path
+        that lets step() skip re-preparing when the scheduler already
+        did. A slot AT ``t_max`` counts as writable: there is no page
+        to prepare — the device write drops while the length advances
+        (the slab engine's frozen-write contract), so stepping it must
+        not raise."""
+        idx = np.nonzero(active)[0]
+        ok = np.ones(len(active), bool)
+        if not idx.size:
+            return ok
+        pool = self.pool
+        pi = pool.lengths[idx] // self.page_size
+        full = pi >= pool.pages_per_slot
+        pg = pool.table[idx, np.minimum(pi, pool.pages_per_slot - 1)]
+        good = (pg >= 0)
+        good &= pool.refcount[np.maximum(pg, 0)] == 1
+        ok[idx] = full | good
+        return ok
+
+    def prepare_step(self, active):
+        """Make every active slot's next append position writable:
+        allocate the page a slot crossing a page boundary needs, and
+        copy-on-write any shared append page (first divergent append
+        after a fork/prefix attach). Returns a ``(slots,) bool`` mask —
+        False means the pool is EXHAUSTED for that slot and nothing was
+        allocated; the scheduler owns the evict/preempt policy. A slot
+        already at ``t_max`` is True (``'full'``): nothing to allocate,
+        its append drops on device like the slab path's."""
+        active = np.asarray(active, bool)
+        ok = np.ones(self.slots, bool)
+        # Vectorized fast path first: the per-token cost is one numpy
+        # mask; the Python allocator loop below runs only for slots
+        # that actually need a page (boundary crossing or shared
+        # append page) — the same contract step()'s auto-prepare uses.
+        todo = active & ~self._writable_mask(active)
+        for i in np.nonzero(todo)[0]:
+            st, src, dst = self.pool.prepare_append(int(i))
+            if st == 'exhausted':
+                ok[i] = False
+            elif st == 'cow':
+                self._apply_copies([(src, dst)])
+        self._sync_page_table()
+        return ok
+
+    def reserve_rows(self, slot, rows):
+        """Admission-time reservation: every page covering ``slot``'s
+        next ``rows`` logical rows (so chunked prefill can never fail
+        mid-prompt). False = pool exhausted, nothing changed."""
+        ok, copies = self.pool.reserve_rows(slot, rows)
+        if ok:
+            self._apply_copies(copies)
+            self._sync_page_table()
+        return ok
+
+    def register_prefix(self, tokens):
+        """Prefill ``tokens`` ONCE into registry-owned pool pages and
+        return a prefix id. Sequences started with
+        :meth:`start_with_prefix` share the prefix's full pages
+        read-only (refcounted) — N sequences riding a system prompt
+        cost its pages once plus one partial tail page each."""
+        if self.cache_mode != 'paged':
+            raise ValueError("prefix sharing needs cache_mode='paged'")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens)
+        if n < 1:
+            raise ValueError('empty prefix')
+        if n + 1 > self.t_max:
+            raise ValueError(f'prefix of {n} tokens leaves no room to '
+                             f'generate in a t_max={self.t_max} cache')
+        needed = self.pool.pages_for_rows(n)
+        pages = self.pool.alloc_block(needed)
+        if pages is None:
+            raise RuntimeError(
+                f'page pool exhausted registering a {n}-token '
+                f'prefix ({needed} pages needed, '
+                f'{self.pool.free_pages} free)')
+        row = np.full(self.pool.pages_per_slot, -1, np.int32)
+        row[:needed] = pages
+        row_j = jnp.asarray(row)
+        for start in range(0, n, self.prefill_chunk):
+            chunk = tokens[start:start + self.prefill_chunk]
+            buf = np.zeros(self.prefill_chunk, np.int32)
+            buf[:len(chunk)] = chunk
+            self.cache = self._prefix_fill(
+                self.cache, jnp.asarray(buf), jnp.int32(len(chunk)),
+                row_j, jnp.int32(start))
+        pid = next(self._prefix_counter)
+        self._prefix_registry[pid] = (pages, n)
+        return pid
+
+    def prefix_length(self, prefix_id):
+        return self._prefix_registry[prefix_id][1]
+
+    def unregister_prefix(self, prefix_id):
+        """Release the registry's page references; pages still shared
+        by live sequences survive until those retire."""
+        pages, _ = self._prefix_registry.pop(prefix_id)
+        freed = self.pool.release_pages(pages)
+        if freed:
+            self._zero_freed(freed)
+
+    def start_with_prefix(self, slot, prefix_id):
+        """Point an EMPTY slot at a registered prefix: full pages
+        shared (refcount++), partial tail page copied private, length
+        set — the slot then prefills/decodes its own continuation.
+        False = pool exhausted (no tail page available)."""
+        pages, plen = self._prefix_registry[prefix_id]
+        ok, src, dst = self.pool.attach(slot, pages, plen)
+        if not ok:
+            return False
+        self.cache = self._copy_attach(self.cache, jnp.int32(src),
+                                       jnp.int32(dst), jnp.int32(slot),
+                                       jnp.int32(plen))
+        self._sync_page_table()
+        return True
+
+    def fork_slot(self, src, dst):
+        """Copy-on-write fork for parallel sampling: ``dst`` (an empty
+        slot) shares ``src``'s full pages and gets a private copy of
+        the partial tail page — O(1 page) device work however long the
+        context. False = pool exhausted."""
+        ok, tail_src, tail_dst = self.pool.fork(src, dst)
+        if not ok:
+            return False
+        self.cache = self._copy_attach(
+            self.cache, jnp.int32(tail_src), jnp.int32(tail_dst),
+            jnp.int32(dst), jnp.int32(int(self.pool.lengths[dst])))
+        self._sync_page_table()
+        return True
+
+    @property
+    def free_pages(self):
+        return self.pool.free_pages if self.pool is not None else None
+
+    @property
+    def pinned_pages(self):
+        """Distinct pool pages the prefix registry holds a permanent
+        reference on — they can never return to the free list while
+        their prefix stays registered (each prefix allocates fresh
+        pages, so the per-prefix page lists are disjoint). 0 on slab
+        engines, like the other probe-any-engine accessors."""
+        if self.pool is None:
+            return 0
+        return sum(len(pages)
+                   for pages, _ in self._prefix_registry.values())
+
+    @property
+    def capacity_tokens(self):
+        """Most rows ONE fresh sequence can ever hold: the per-slot
+        table reach capped by the pool itself."""
+        if self.pool is None:
+            return self.t_max
+        return min(self.t_max, self.pool.pages * self.page_size)
+
+    def slot_pages(self, slot):
+        return self.pool.slot_pages(slot) if self.pool is not None else 0
+
+    def cache_stats(self):
+        """Occupancy snapshot for the scheduler's gauges. A slab
+        engine has no pool (everything statically reserved) — report
+        zeros so generic dashboard code can probe any engine, matching
+        the ``free_pages``/``slot_pages`` guards."""
+        pool = self.pool
+        if pool is None:
+            return {'pages': 0, 'pages_used': 0, 'pages_free': 0,
+                    'shared_pages': 0, 'page_size': 0}
+        return {'pages': pool.pages, 'pages_used': pool.used_pages,
+                'pages_free': pool.free_pages,
+                'shared_pages': pool.shared_pages,
+                'page_size': pool.page_size}
 
 
 def graphlint_entrypoints():
@@ -249,4 +570,23 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k, o[0].v],
             expect_donation=True, min_donated=2)
 
-    return {'serve.engine_decode': engine_decode}
+    def engine_decode_paged():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        eng = KernelEngine(slots=2, t_max=16, decode_impl='xla',
+                           cache_mode='paged', page_size=8, pages=3)
+        active = jnp.ones((2,), bool)
+        assert eng.prepare_step(np.ones(2, bool)).all()
+        tokens = jnp.zeros((2,), jnp.int32)
+        poison = jnp.zeros((2,), bool)
+        return TraceSpec(
+            name='serve.engine_decode_paged', fn=eng._decode,
+            args=(eng.cache, tokens, active, poison),
+            prejitted=True,
+            cache_in=lambda a: [a[0].k_pool, a[0].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, min_donated=2)
+
+    return {'serve.engine_decode': engine_decode,
+            'serve.engine_decode_paged': engine_decode_paged}

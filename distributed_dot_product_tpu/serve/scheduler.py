@@ -30,6 +30,16 @@ Failure-handling ladder at submit, in order: admit → admit degraded
 (token budget capped under queue pressure) → evict the longest-idle
 running sequence and admit → reject with typed ``QUEUE_FULL``.
 
+Paged engines (``cache_mode='paged'``) plug PAGE EXHAUSTION into the
+same ladder: pool pressure degrades budgets like queue pressure,
+admission reserves a request's prompt pages up front (head-of-line
+waits when the pool is full), a mid-stream page deficit first evicts
+the longest-idle OTHER slot and then preempts/requeues the needy one
+(typed ``CACHE_EXHAUSTED`` once retries are spent), and requests can
+ride registered shared prefixes (``submit(prefix_id=...)``) or fork
+mid-stream (:meth:`Scheduler.fork`). Occupancy gauges
+(``serve.cache.pages_used/pages_free/shared_pages``) refresh per tick.
+
 Liveness is judged OUTSIDE the loop: the scheduler heartbeats the
 :class:`~distributed_dot_product_tpu.serve.health.HealthMonitor` every
 tick and a watchdog thread flags a stuck compiled step (no heartbeat)
@@ -53,7 +63,8 @@ from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.devmon import CaptureInFlight
 from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.serve.admission import (
-    AdmissionController, RejectReason, Request, RequestResult,
+    AdmissionController, RejectedError, RejectReason, Request,
+    RequestResult,
 )
 from distributed_dot_product_tpu.serve.health import (
     HealthMonitor, Liveness, Readiness,
@@ -144,6 +155,10 @@ class Scheduler:
                  on_tick: Optional[Callable] = None, event_log=None,
                  profiler=None):
         self.engine = engine
+        # Paged engines gate admission by FREE PAGES, not free slots,
+        # and join page exhaustion into the degrade→evict→reject
+        # ladder (plus the mid-stream preemption rung in _ensure_pages).
+        self._paged = getattr(engine, 'cache_mode', 'slab') == 'paged'
         # Optional obs.devmon.ProfileCapture for the adaptive
         # ttft-p99 trigger (cfg.profile_ttft_p99 arms it).
         self.profiler = profiler
@@ -162,7 +177,9 @@ class Scheduler:
             max_new_tokens=self.cfg.max_new_tokens,
             degrade_watermark=self.cfg.degrade_watermark,
             degraded_max_new_tokens=self.cfg.degraded_max_new_tokens,
-            clock=clock, registry=self.registry, event_log=event_log)
+            clock=clock, registry=self.registry, event_log=event_log,
+            capacity_tokens=(engine.capacity_tokens if self._paged
+                             else None))
         # None = "consult the env knobs" (a shell faults a real run);
         # False = explicitly unfaulted even when knobs are set (the
         # clean reference run a fault-isolation audit compares against).
@@ -193,6 +210,17 @@ class Scheduler:
                     'abandoned', 'deadline_expired', 'failed',
                     'decode_steps', 'tokens_generated')}
         self._g_active = reg.gauge('serve.active_slots')
+        if self._paged:
+            # Cache-occupancy surface (tick-refreshed, /metrics-
+            # rendered): pool fill, free headroom, and the sharing win
+            # (pages referenced more than once). The histogram records
+            # pages held per request at retirement.
+            self._c_preempted = reg.counter('serve.cache_preempted')
+            self._g_pages_used = reg.gauge('serve.cache.pages_used')
+            self._g_pages_free = reg.gauge('serve.cache.pages_free')
+            self._g_shared = reg.gauge('serve.cache.shared_pages')
+            self._h_req_pages = reg.histogram(
+                'serve.cache.request_pages', buckets=())
         self._c_profile = reg.counter('serve.profile_triggers')
         self._h_step = reg.histogram('serve.step_seconds')
         # Request-timeline histograms: the latency decomposition a
@@ -214,19 +242,34 @@ class Scheduler:
 
     # -- submission surface --------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, deadline=None,
-               request_id=None) -> Request:
+               request_id=None, prefix_id=None) -> Request:
         """Admit one request or raise a typed
         :class:`~distributed_dot_product_tpu.serve.admission
         .RejectedError`. Applies the full backpressure ladder (degrade →
-        evict → reject)."""
+        evict → reject). ``prefix_id`` (paged engines): a registered
+        shared prefix the prompt CONTINUES — its pages are shared, the
+        budget math covers prefix + prompt."""
+        if prefix_id is not None and not self._paged:
+            raise ValueError("prefix_id needs a paged engine "
+                             "(cache_mode='paged')")
         req = Request(prompt=prompt,
                       max_new_tokens=max_new_tokens
                       or self.cfg.max_new_tokens,
-                      deadline=deadline, id=request_id or '')
+                      deadline=deadline, id=request_id or '',
+                      prefix_id=prefix_id)
         req.submitted_at = self.clock()
         try:
+            if prefix_id is not None:
+                try:
+                    req.prefix_len = self.engine.prefix_length(
+                        prefix_id)
+                except KeyError:
+                    self.admission.reject(
+                        RejectReason.PREFIX_UNREGISTERED,
+                        f'request {req.id}: prefix id {prefix_id!r} '
+                        f'is not registered', request_id=req.id)
             self.admission.validate(req)
-            self.admission.maybe_degrade(req)
+            self.admission.maybe_degrade(req, pressure=self._pressure())
             if self.admission.full and self.cfg.evict_before_reject:
                 # Freeing a slot lets a queued request promote out of
                 # the queue, which is what makes room for this one.
@@ -274,6 +317,10 @@ class Scheduler:
             requeues=req.requeues, degraded=req.degraded,
             finished_at=finished_at)
 
+    def _observe_slot_pages(self, slot: _Slot):
+        if self._paged:
+            self._h_req_pages.observe(self.engine.slot_pages(slot.index))
+
     def _finish(self, slot: _Slot, status,
                 reason: Optional[RejectReason] = None):
         """Retire a slot's request with a terminal status and free the
@@ -281,51 +328,163 @@ class Scheduler:
         if status == 'evicted':
             self._emit('serve.evict', request_id=slot.request.id,
                        slot=slot.index)
+        self._observe_slot_pages(slot)       # pages held AT retirement
         self._finalize_request(slot.request, status, reason)
         if status in self._c:
             self._c[status].inc()
+        self._clear_slot(slot)
+
+    def _clear_slot(self, slot: _Slot):
+        """Free a slot without finalizing its request (quarantine and
+        preempt share this arc; _finish owns the terminal one). No
+        page observation here: serve.cache.request_pages records
+        occupancy at RETIREMENT only — a requeued request's mid-flight
+        partial fills would skew the distribution low."""
         self.engine.reset(slot.index)
         slot.state = _SlotState.FREE
         slot.request = None
         slot.produced = 0
         slot.prefill_pos = 0
 
+    def _requeue(self, req: Request):
+        """Retry an already-admitted request from scratch: the greedy
+        stream is deterministic, so the retry regenerates exactly what
+        the fault/preemption dropped. Its first token is a fresh TTFT
+        observation, not a token gap."""
+        req.requeues += 1
+        req.tokens = []
+        req.first_token_at = None
+        self._c['requeued'].inc()
+        self.admission.push_front(req)
+
     def _quarantine(self, slot: _Slot):
         """Non-finite logits in ONE slot: reset it and retry the request
-        from scratch (the greedy stream is deterministic, so a retry
-        reproduces what the fault destroyed) — or fail it with a typed
-        status once ``max_requeues`` is exhausted. Other slots are
-        untouched by construction (per-slot cache + row-independent
-        engine), which the tests pin bit-exactly."""
+        from scratch — or fail it with a typed status once
+        ``max_requeues`` is exhausted. Other slots are untouched by
+        construction (per-slot cache + row-independent engine), which
+        the tests pin bit-exactly."""
         req = slot.request
         self._c['nan_quarantined'].inc()
-        self.engine.reset(slot.index)
-        slot.state = _SlotState.FREE
-        slot.request = None
-        slot.produced = 0
-        slot.prefill_pos = 0
+        self._clear_slot(slot)
         requeued = req.requeues < self.cfg.max_requeues
         self._emit('serve.quarantine', request_id=req.id,
                    slot=slot.index, requeued=requeued)
         if requeued:
-            req.requeues += 1
-            req.tokens = []
-            # The retry regenerates the stream from scratch: its first
-            # token is a fresh TTFT observation, not a token gap.
-            req.first_token_at = None
-            self._c['requeued'].inc()
-            self.admission.push_front(req)
+            self._requeue(req)
         else:
             self._c['failed'].inc()
             self._finalize_request(req, 'failed_nan')
 
-    def _evict_longest_idle(self):
+    def _ensure_pages(self):
+        """Page-deficit ladder, run before every decode tick: make each
+        active slot's append page writable (``engine.prepare_step`` —
+        allocation on page crossings, copy-on-write on shared pages).
+        On pool exhaustion: evict the longest-idle OTHER busy slot to
+        free pages and retry; when no other slot can yield, PREEMPT the
+        needy slot itself — requeued from scratch like a quarantine
+        (bounded by ``max_requeues``), then terminally evicted with the
+        typed CACHE_EXHAUSTED reason. Each rung frees at least one
+        slot, so the loop terminates."""
+        while True:
+            active = np.array([s.state is _SlotState.ACTIVE
+                               for s in self._slots])
+            if not active.any():
+                return
+            ok = self.engine.prepare_step(active)
+            deficit = [s for s in self._slots
+                       if active[s.index] and not ok[s.index]]
+            if not deficit:
+                return
+            exclude = {s.index for s in deficit}
+            if self.cfg.evict_before_reject \
+                    and self._evict_longest_idle(exclude=exclude):
+                continue
+            self._preempt(deficit[0])
+
+    def _preempt(self, slot: _Slot):
+        """Page exhaustion landed on THIS slot: free it and retry the
+        request from scratch, or evict it with the typed
+        CACHE_EXHAUSTED reason once ``max_requeues`` is spent."""
+        req = slot.request
+        self._c_preempted.inc()
+        requeued = req.requeues < self.cfg.max_requeues
+        self._emit('serve.preempt', request_id=req.id, slot=slot.index,
+                   requeued=requeued)
+        if requeued:
+            self._clear_slot(slot)
+            self._requeue(req)
+        else:
+            self._finish(slot, 'evicted', RejectReason.CACHE_EXHAUSTED)
+
+    def fork(self, request_id, *, request_id_new=None,
+             max_new_tokens=None) -> Request:
+        """Fork an actively decoding request into a free slot (parallel
+        sampling): the branch shares the source's full pages read-only
+        and copies only the partial tail page (engine.fork_slot), then
+        continues decoding independently with its own budget. Raises a
+        typed :class:`RejectedError` — QUEUE_FULL without a free slot,
+        CACHE_EXHAUSTED without a free page."""
+        if not self._paged:
+            raise ValueError("fork needs a paged engine "
+                             "(cache_mode='paged')")
+        src = next((s for s in self._slots if s.request is not None
+                    and s.request.id == request_id), None)
+        if src is None or src.state is not _SlotState.ACTIVE:
+            raise ValueError(f'fork needs an actively decoding request;'
+                             f' {request_id!r} is not one')
+        free = next((s for s in self._slots
+                     if s.state is _SlotState.FREE), None)
+        if free is None:
+            raise RejectedError(
+                RejectReason.QUEUE_FULL,
+                f'no free slot to fork {request_id} into')
+        if not self.engine.fork_slot(src.index, free.index):
+            raise RejectedError(
+                RejectReason.CACHE_EXHAUSTED,
+                f'page pool exhausted forking {request_id}')
+        now = self.clock()
+        orig = src.request
+        req = Request(prompt=orig.prompt,
+                      max_new_tokens=max_new_tokens
+                      or orig.max_new_tokens,
+                      deadline=orig.deadline, id=request_id_new or '',
+                      prefix_id=orig.prefix_id,
+                      prefix_len=orig.prefix_len)
+        # Same budget policy admission applies at submit — one clamp,
+        # shared, so the two entry points can never drift.
+        self.admission.clamp_budget(req)
+        self.admission.count_admit()
+        req.submitted_at = now
+        req.queued_since = now
+        req.admitted_at = now
+        req.tokens = list(orig.tokens)
+        # The branch inherits the stream mid-flight: its next token is
+        # a continuation, not a first token — no fresh TTFT.
+        req.first_token_at = orig.first_token_at
+        req.admit_index = self._admit_counter
+        self._admit_counter += 1
+        free.request = req
+        free.state = _SlotState.ACTIVE
+        free.produced = src.produced
+        free.input_token = src.input_token
+        free.prefill_pos = src.prefill_pos
+        free.last_progress = now
+        free.last_token_at = src.last_token_at
+        self._emit('serve.admit', request_id=req.id, slot=free.index,
+                   queue_wait=0.0, prompt_len=len(req.prompt),
+                   requeues=0, fork_of=orig.id)
+        return req
+
+    def _evict_longest_idle(self, exclude=()):
         """Rung two of the ladder: evict the busy slot that has gone
         longest without progress (ties → oldest admission), if it has
         been idle at least ``min_evict_idle``. The evicted request
-        terminates with status ``'evicted'`` and its partial tokens."""
+        terminates with status ``'evicted'`` and its partial tokens.
+        ``exclude``: slot indices never chosen (the page-deficit ladder
+        evicts OTHERS to free pages before preempting the needy one)."""
         now = self.clock()
-        busy = [s for s in self._slots if s.state is not _SlotState.FREE]
+        busy = [s for s in self._slots if s.state is not _SlotState.FREE
+                and s.index not in exclude]
         if not busy:
             return False
         victim = max(busy, key=lambda s: (now - s.last_progress,
@@ -345,14 +504,89 @@ class Scheduler:
                 self._finalize_request(req, 'rejected',
                                        RejectReason.DEADLINE_EXCEEDED)
 
+    def _place_paged(self, slot: _Slot, req: Request):
+        """Paged admission: attach the shared prefix (refcount++, tail
+        copy) and RESERVE every page the prompt's prefill plus first
+        decode append need (``len(prompt)`` rows past the prefix:
+        ``len−1`` prefill appends + the first decode append) — chunked
+        prefill can then never fail mid-prompt. Returns ``'ok'``,
+        ``'wait'`` (pool exhausted — head-of-line waits, slot left
+        clean) or ``'rejected'`` (the prefix vanished while queued, or
+        the request can NEVER be placed — finalized with the typed
+        reason)."""
+        eng = self.engine
+        # Cheap headroom check BEFORE any device work: a head-of-line
+        # wait must not re-do an attach tail copy plus a page zeroing
+        # every tick while the pool refills. Exact page count: the
+        # attach's private tail copy (one page when the prefix ends
+        # mid-page) plus the fresh pages the prompt reserve opens past
+        # the prefix's coverage.
+        plen = req.prefix_len
+        covered = eng.pool.pages_for_rows(plen)
+        need = ((1 if plen % eng.page_size else 0)
+                + eng.pool.pages_for_rows(plen + len(req.prompt))
+                - covered)
+        if need > eng.pool.pages - eng.pinned_pages:
+            # Statically unservable HERE AND FOREVER: registry-pinned
+            # prefix pages never free while registered, so even a
+            # fully drained pool cannot supply the attach tail copy
+            # plus the prompt's fresh pages (admission.validate can't
+            # see the pin — it only knows raw pool capacity). Waiting
+            # would stall the head of the line for every later
+            # request; reject with the typed reason instead.
+            self.admission.count_reject(RejectReason.CACHE_EXHAUSTED)
+            self._finalize_request(req, 'rejected',
+                                   RejectReason.CACHE_EXHAUSTED)
+            return 'rejected'
+        if eng.free_pages < need:
+            return 'wait'
+        if req.prefix_id is not None:
+            try:
+                attached = eng.start_with_prefix(slot.index,
+                                                 req.prefix_id)
+            except KeyError:
+                # Unregistered while the request sat queued: a typed
+                # terminal, never a KeyError crashing the tick.
+                self.admission.count_reject(
+                    RejectReason.PREFIX_UNREGISTERED)
+                self._finalize_request(
+                    req, 'rejected', RejectReason.PREFIX_UNREGISTERED)
+                return 'rejected'
+            if not attached:
+                return 'wait'
+        if not eng.reserve_rows(slot.index, len(req.prompt)):
+            eng.reset(slot.index)       # releases a prefix attach too
+            return 'wait'
+        return 'ok'
+
     def _admit_into_free_slots(self):
         for slot in self._slots:
             if slot.state is not _SlotState.FREE:
                 continue
-            req, dropped = self.admission.pop_ready()
-            self._record_dropped(dropped)
-            if req is None:
-                break
+            # A statically-rejected request must not burn this slot's
+            # turn: the SAME slot keeps popping until something places
+            # (or the queue drains / the head has to wait for pages,
+            # which stops admission for the whole tick).
+            while True:
+                req, dropped = self.admission.pop_ready()
+                self._record_dropped(dropped)
+                if req is None:
+                    return
+                if not self._paged:
+                    break
+                placed = self._place_paged(slot, req)
+                if placed == 'ok':
+                    break
+                if placed == 'wait':
+                    # Admission is BY FREE PAGES: head-of-line waits
+                    # (its queue position and wait clock intact) until
+                    # running sequences retire pages.
+                    queued_since = req.queued_since
+                    self.admission.push_front(req)
+                    req.queued_since = queued_since
+                    return
+                # 'rejected': typed terminal already recorded — the
+                # slot is still free, try the next queued request.
             req.admit_index = self._admit_counter
             self._admit_counter += 1
             slot.request = req
@@ -379,14 +613,26 @@ class Scheduler:
             else:
                 slot.state = _SlotState.PREFILL
 
+    def _pressure(self):
+        """Backpressure signal: queue depth, and on paged engines the
+        page-pool fill — whichever is higher. A nearly-full pool caps
+        new budgets and downgrades readiness exactly like a nearly-
+        full queue (shorter streams → fewer pages committed)."""
+        pressure = self.admission.pressure
+        if self._paged:
+            stats = self.engine.cache_stats()
+            pressure = max(pressure,
+                           stats['pages_used'] / max(1, stats['pages']))
+        return pressure
+
     def _update_readiness(self):
         if self.health.liveness is Liveness.STALLED or self._closed:
             return      # the watchdog owns NOT_READY during a stall
         if self.admission.full:
             self.health.set_readiness(Readiness.NOT_READY, 'queue full')
-        elif self.admission.pressure >= self.cfg.degrade_watermark:
+        elif self._pressure() >= self.cfg.degrade_watermark:
             self.health.set_readiness(Readiness.DEGRADED,
-                                      'queue pressure')
+                                      'queue or page-pool pressure')
         else:
             self.health.set_readiness(Readiness.READY, 'serving')
 
@@ -424,6 +670,8 @@ class Scheduler:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
 
+        if self._paged:
+            self._ensure_pages()
         active = np.array([s.state is _SlotState.ACTIVE
                            for s in self._slots])
         if active.any():
@@ -495,6 +743,11 @@ class Scheduler:
 
         self._g_active.set(sum(s.state is not _SlotState.FREE
                                for s in self._slots))
+        if self._paged:
+            stats = self.engine.cache_stats()
+            self._g_pages_used.set(stats['pages_used'])
+            self._g_pages_free.set(stats['pages_free'])
+            self._g_shared.set(stats['shared_pages'])
         self._maybe_profile()
         self._update_readiness()
         if self.on_tick is not None:

@@ -212,6 +212,15 @@ SWEEPS = [
        ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
         '--serve-requests', '32', '--decode-impl', impl])
       for impl in ('xla', 'kernel')],
+    # --- round-7: paged-cache twins of the rows above — SAME KV byte
+    # budget (8 slots × 4096 rows) as a page pool, 4× the slots; the
+    # rows record pool utilization + peak concurrency, so the
+    # slots-per-chip win reads straight off slab-vs-paged pairs. ---
+    *[(f'decode_serve_paged_{impl}',
+       ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
+        '--serve-requests', '64', '--decode-impl', impl,
+        '--cache-mode', 'paged', '--page-size', '256'])
+      for impl in ('xla', 'kernel')],
     # --- round-5: LM capstone training (embed → scanned+remat stack →
     # tied head → chunked cross-entropy, one SPMD program) ---
     ('lm_32k',

@@ -13,7 +13,8 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_dot_product_tpu.analysis.registry import TraceSpec
 from distributed_dot_product_tpu.models.decode import (
-    append_kv, decode_attention, init_cache,
+    append_kv, decode_attention, init_cache, init_paged_cache,
+    paged_append_kv_slots,
 )
 from distributed_dot_product_tpu.parallel.mesh import seq_mesh
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
@@ -70,6 +71,28 @@ def bad_full_shape_dus():
         name='neg.full_shape_dus', fn=fn, args=(cache, new, new),
         cache_in=lambda a: [a[0].k],
         cache_out=lambda o: [o.k])
+
+
+def bad_paged_pool_rematerialize():
+    """The paged append done WRONG: the pool buffer is re-materialized
+    by arithmetic (`pool * 1`) on the way out, off the page-write
+    scatter spine — every decode step would copy the ENTIRE pool, the
+    exact failure paging exists to avoid."""
+
+    def fn(cache, k_new, v_new):
+        cache = paged_append_kv_slots(cache, k_new, v_new)
+        return cache._replace(k_pool=cache.k_pool * jnp.bfloat16(1))
+
+    cache = init_paged_cache(1, 2, 32, 8, pages=4, page_size=8,
+                             dtype=jnp.bfloat16)
+    cache = cache._replace(
+        page_table=jnp.array([[0, -1, -1, -1]], jnp.int32))
+    new = jnp.zeros((1, 2, 1, 8), jnp.bfloat16)
+    return TraceSpec(
+        name='neg.paged_pool_rematerialize', fn=fn,
+        args=(cache, new, new),
+        cache_in=lambda a: [a[0].k_pool, a[0].v_pool],
+        cache_out=lambda o: [o.k_pool, o.v_pool])
 
 
 def bad_cache_upcast():
@@ -138,6 +161,8 @@ def bad_trace_error():
 ALL = {
     'neg.f32_accum': (bad_f32_accum, 'f32-accum'),
     'neg.cache_rematerialize': (bad_cache_rematerialize, 'cache-alias'),
+    'neg.paged_pool_rematerialize': (bad_paged_pool_rematerialize,
+                                     'cache-alias'),
     'neg.full_shape_dus': (bad_full_shape_dus, 'cache-alias'),
     'neg.cache_upcast': (bad_cache_upcast, 'cache-upcast'),
     'neg.missing_donation': (bad_missing_donation, 'donation'),

@@ -73,6 +73,8 @@ def test_offset_none_and_ring(tmp_path):
     assert rec['impl'] == 'ring'
 
 
+@pytest.mark.xfail(
+    reason='PartitionId SPMD lowering, jax 0.4.37', strict=False)
 def test_attn_mode(tmp_path):
     rec = _run(tmp_path, 'attn', '--mode', 'attn', '--attn-impl', 'online',
                '--scale', '2344', '--skip-local')
@@ -81,6 +83,8 @@ def test_attn_mode(tmp_path):
     assert rec['dist_gflops_per_chip'] > 0
 
 
+@pytest.mark.xfail(
+    reason='PartitionId SPMD lowering, jax 0.4.37', strict=False)
 def test_attn_mode_seq_len_override(tmp_path):
     # --seq-len overrides the reference's T = 75000/scale convention
     # (used by the head-dim sweep to pin T exactly).
@@ -115,6 +119,31 @@ def test_decode_serve_mode(tmp_path):
     assert rec['sched_tokens_per_s'] > 0
     assert rec['decode_impl'] == 'xla'        # auto resolves off-TPU
     assert rec['ttft_ms'] > 0
+
+
+def test_decode_serve_mode_paged_twin(tmp_path):
+    """--cache-mode paged: the fixed-memory twin row — same KV byte
+    budget as the slab row, more slots, pool-utilization and
+    peak-concurrency columns recorded."""
+    rec_s = _run(tmp_path, 'dserve_s', '--mode', 'decode-serve',
+                 '--seq-len', '64', '--batch', '2',
+                 '--serve-requests', '8')
+    rec_p = _run(tmp_path, 'dserve_p', '--mode', 'decode-serve',
+                 '--seq-len', '64', '--batch', '2',
+                 '--serve-requests', '8', '--cache-mode', 'paged',
+                 '--page-size', '8')
+    assert rec_s['cache_mode'] == 'slab'
+    assert rec_p['cache_mode'] == 'paged'
+    # The twin framing: identical KV budget, strictly more concurrency.
+    assert rec_p['kv_budget_bytes'] == rec_s['kv_budget_bytes']
+    assert rec_p['slots'] > rec_s['slots']
+    assert rec_p['max_concurrent'] > rec_s['max_concurrent']
+    assert rec_p['pages'] * rec_p['page_size'] \
+        == rec_s['slots'] * rec_s['t_max']
+    assert 0 < rec_p['pages_used_peak'] <= rec_p['pages']
+    # The burst rounds up to whole rounds of `slots` requests.
+    assert rec_p['completed'] == rec_p['requests'] >= 8
+    assert rec_p['sched_tokens_per_s'] > 0
 
 
 def test_decode_serve_mode_kernel_path(tmp_path):

@@ -74,8 +74,10 @@ def test_registry_covers_every_layer(devices):
         'attention.fwd_flash', 'attention.bwd_full', 'attention.fwd_ring',
         'attention.fwd_ulysses', 'decode.seq_parallel_step',
         'decode.step_xla_slots', 'decode.step_kernel_int8',
-        'decode.step_sharded', 'lm.head_bf16', 'lm.loss_f32',
-        'serve.engine_decode', 'train.lm_step', 'obs.spanned_decode',
+        'decode.step_sharded', 'decode.step_paged_xla',
+        'decode.step_paged_kernel', 'lm.head_bf16', 'lm.loss_f32',
+        'serve.engine_decode', 'serve.engine_decode_paged',
+        'train.lm_step', 'obs.spanned_decode',
     }
     assert expected <= names, f'missing: {expected - names}'
 
@@ -111,9 +113,9 @@ def test_ast_rule_catches_fixture(fixture, rule):
 # -- jaxpr rules: negative fixtures -------------------------------------
 
 _NEGATIVE_NAMES = ('neg.f32_accum', 'neg.cache_rematerialize',
-                   'neg.full_shape_dus', 'neg.cache_upcast',
-                   'neg.missing_donation', 'neg.collective_axis',
-                   'neg.trace_error')
+                   'neg.paged_pool_rematerialize', 'neg.full_shape_dus',
+                   'neg.cache_upcast', 'neg.missing_donation',
+                   'neg.collective_axis', 'neg.trace_error')
 
 
 @pytest.mark.parametrize('name', _NEGATIVE_NAMES)

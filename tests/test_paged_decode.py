@@ -1,0 +1,392 @@
+# -*- coding: utf-8 -*-
+"""
+Paged KV cache (models/decode.py PagedDecodeCache + PagePool,
+ops/pallas_decode.py page-table mode) — unit and parity tests.
+
+The contract under test: the paged cache is a MEMORY layout change,
+not a numerics change. The paged XLA step must match the slab XLA step
+bit for bit; the paged kernel step must match the paged XLA step to
+kernel tolerance (exp2 online softmax) and keep the pool bit-identical
+to the XLA append. On top of the layout: refcounted prefix sharing,
+copy-on-write fork, freed-page zeroing, and the exhaustion surface the
+scheduler's ladder is built on.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_dot_product_tpu.models.decode import (
+    PagePool, append_kv_slots, decode_step, init_paged_cache,
+    init_slot_cache, paged_append_rows, paged_copy_attach, paged_gather,
+    paged_reset_slot, reset_slot,
+)
+
+B, H, T, D, PS, PAGES = 2, 2, 32, 8, 8, 10
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _mk_pair(dtype=jnp.float32, fills=(10, 3), seed=0):
+    """A slab cache and a paged twin holding identical contents at
+    per-slot fills, plus the paged side's host allocator."""
+    rng = _rng(seed)
+    slab = init_slot_cache(B, H, T, D, dtype=dtype)
+    paged = init_paged_cache(B, H, T, D, pages=PAGES, page_size=PS,
+                             dtype=dtype)
+    pool = PagePool(PAGES, PS, B, T // PS)
+    for slot, n in enumerate(fills):
+        if not n:
+            continue
+        k = jnp.asarray(rng.normal(size=(B, H, n, D)), dtype)
+        v = jnp.asarray(rng.normal(size=(B, H, n, D)), dtype)
+        sel = np.arange(B) == slot
+        counts = np.where(sel, n, 0).astype(np.int32)
+        ok, copies = pool.reserve_rows(slot, n)
+        assert ok and not copies
+        paged = paged._replace(page_table=jnp.asarray(pool.table))
+        slab = append_kv_slots(slab, k, v, slot_mask=sel, counts=counts)
+        paged = append_kv_slots(paged, k, v, slot_mask=sel,
+                                counts=counts)
+        pool.lengths[slot] += n
+    return slab, paged, pool
+
+
+def _prepare(paged, pool, active=None):
+    """Host-side page reservation + device mirror for one decode step."""
+    for slot in range(pool.slots):
+        if active is not None and not active[slot]:
+            continue
+        st, src, dst = pool.prepare_append(slot)
+        assert st != 'exhausted'
+        if st == 'cow':
+            paged = paged_copy_attach(paged, jnp.int32(src),
+                                      jnp.int32(dst), jnp.int32(-1),
+                                      jnp.int32(0))
+    return paged._replace(page_table=jnp.asarray(pool.table))
+
+
+def _qkv(seed=7, dtype=jnp.float32):
+    rng = _rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(B, H, 1, D)), dtype)
+                 for _ in range(3))
+
+
+# -- layout parity ------------------------------------------------------
+
+def test_append_and_gather_match_slab_bitwise():
+    slab, paged, pool = _mk_pair()
+    gk, gv = paged_gather(paged)
+    assert np.array_equal(np.asarray(slab.length),
+                          np.asarray(paged.length))
+    for i, ln in enumerate(np.asarray(slab.length)):
+        assert np.array_equal(np.asarray(slab.k)[i, :, :ln],
+                              np.asarray(gk)[i, :, :ln])
+        assert np.array_equal(np.asarray(slab.v)[i, :, :ln],
+                              np.asarray(gv)[i, :, :ln])
+
+
+def test_append_crosses_page_boundary():
+    """A chunk straddling two pages lands split across pool pages."""
+    _, paged, pool = _mk_pair(fills=(6, 0), seed=3)
+    rng = _rng(9)
+    k = jnp.asarray(rng.normal(size=(B, H, 5, D)), jnp.float32)
+    sel = np.arange(B) == 0
+    ok, _ = pool.reserve_rows(0, 5)          # rows 6..10: pages 0 and 1
+    assert ok and pool.counts[0] == 2
+    paged = paged._replace(page_table=jnp.asarray(pool.table))
+    paged = append_kv_slots(paged, k, k, slot_mask=sel,
+                            counts=np.where(sel, 5, 0).astype(np.int32))
+    gk, _ = paged_gather(paged)
+    assert np.array_equal(np.asarray(gk)[0, :, 6:11],
+                          np.asarray(k)[0])
+
+
+def test_decode_step_xla_bit_identical_to_slab():
+    slab, paged, pool = _mk_pair()
+    q, kn, vn = _qkv()
+    paged = _prepare(paged, pool)
+    slab2, out_s = decode_step(q, slab, kn, vn, impl='xla')
+    paged2, out_p = decode_step(q, paged, kn, vn, impl='xla')
+    assert np.array_equal(np.asarray(out_s), np.asarray(out_p))
+    gk, gv = paged_gather(paged2)
+    for i, ln in enumerate(np.asarray(slab2.length)):
+        assert np.array_equal(np.asarray(slab2.k)[i, :, :ln],
+                              np.asarray(gk)[i, :, :ln])
+
+
+@pytest.mark.parametrize('window,alibi', [(None, False), (6, False),
+                                          (None, True)])
+def test_decode_step_kernel_matches_xla(window, alibi):
+    """The fused paged kernel (page-table BlockSpec redirect, run
+    interpreted off-TPU) reproduces the paged XLA step: outputs to
+    kernel tolerance, pool contents BIT-identical (the aliased append
+    writes exactly the XLA scatter's bytes)."""
+    slopes = np.array([0.3, 0.7], np.float32) if alibi else None
+    _, paged, pool = _mk_pair()
+    q, kn, vn = _qkv()
+    paged = _prepare(paged, pool)
+    px, out_x = decode_step(q, paged, kn, vn, impl='xla',
+                            window=window, alibi_slopes=slopes)
+    pk, out_k = decode_step(q, paged, kn, vn, impl='kernel',
+                            interpret=True, window=window,
+                            alibi_slopes=slopes)
+    assert np.allclose(np.asarray(out_x), np.asarray(out_k), atol=1e-5)
+    assert np.array_equal(np.asarray(px.k_pool), np.asarray(pk.k_pool))
+    assert np.array_equal(np.asarray(px.v_pool), np.asarray(pk.v_pool))
+    assert np.array_equal(np.asarray(px.length), np.asarray(pk.length))
+
+
+def test_kernel_writes_only_the_append_pages():
+    """Aliasing discipline: every pool page NOT containing a slot's
+    append position keeps its exact bits through the kernel step."""
+    _, paged, pool = _mk_pair()
+    q, kn, vn = _qkv()
+    paged = _prepare(paged, pool)
+    before = np.asarray(paged.k_pool).copy()
+    append_pages = {int(pool.table[s, int(pool.lengths[s]) // PS])
+                    for s in range(B)}
+    pk, _ = decode_step(q, paged, kn, vn, impl='kernel', interpret=True)
+    after = np.asarray(pk.k_pool)
+    for page in range(PAGES):
+        if page not in append_pages:
+            assert np.array_equal(before[page], after[page]), page
+
+
+def test_slot_mask_freezes_inactive_slots():
+    slab, paged, pool = _mk_pair()
+    q, kn, vn = _qkv()
+    active = np.array([True, False])
+    paged = _prepare(paged, pool, active=active)
+    slab2, out_s = decode_step(q, slab, kn, vn, slot_mask=active,
+                               impl='xla')
+    paged2, out_p = decode_step(q, paged, kn, vn, slot_mask=active,
+                                impl='xla')
+    assert np.array_equal(np.asarray(out_s), np.asarray(out_p))
+    assert np.asarray(paged2.length)[1] == np.asarray(paged.length)[1]
+
+
+def test_overflow_raises_eagerly_naming_slot():
+    _, paged, pool = _mk_pair(fills=(0, 0))
+    paged = paged._replace(length=jnp.array([T, 0], jnp.int32))
+    rng = _rng(1)
+    k = jnp.asarray(rng.normal(size=(B, H, 1, D)), jnp.float32)
+    with pytest.raises(ValueError, match='slot 0'):
+        append_kv_slots(paged, k, k)
+
+
+def test_unallocated_page_drops_write():
+    """The device-side guard: a row whose table entry is −1 writes
+    NOTHING anywhere (host allocator bug ≠ silent cross-slot
+    corruption), while the length still advances (detectable)."""
+    _, paged, pool = _mk_pair(fills=(10, 3))
+    before = np.asarray(paged.k_pool).copy()
+    rng = _rng(2)
+    k = jnp.asarray(rng.normal(size=(B, H, 1, D)), jnp.float32)
+    # No reserve_rows / prepare_append: slot 0's position 10 has a page
+    # (page 1 row 2) but make it unallocated to simulate the bug.
+    tbl = pool.table.copy()
+    tbl[0, 1] = -1
+    paged = paged._replace(page_table=jnp.asarray(tbl))
+    out = append_kv_slots(paged, k, k,
+                          slot_mask=np.array([True, False]))
+    after = np.asarray(out.k_pool)
+    assert np.array_equal(before, after)
+    assert int(np.asarray(out.length)[0]) == 11
+
+
+def test_reset_zeroes_freed_pages_only():
+    _, paged, pool = _mk_pair()
+    shared_page = int(pool.table[1, 0])      # slot 1's page survives
+    freed = pool.release(0)
+    assert freed and shared_page not in freed
+    vec = np.full(T // PS, -1, np.int32)
+    vec[:len(freed)] = freed
+    out = paged_reset_slot(paged, jnp.int32(0), jnp.asarray(vec))
+    kp = np.asarray(out.k_pool)
+    for page in freed:
+        assert not kp[page].any()
+    assert kp[shared_page].any()
+    assert int(np.asarray(out.length)[0]) == 0
+    assert (np.asarray(out.page_table)[0] == -1).all()
+
+
+def test_reset_slot_on_paged_cache_directs_to_paged_reset():
+    _, paged, _ = _mk_pair()
+    with pytest.raises(ValueError, match='paged_reset_slot'):
+        reset_slot(paged, 0)
+
+
+# -- sharing: prefix attach, fork, copy-on-write ------------------------
+
+def test_attach_shares_full_pages_and_copies_tail():
+    """Two slots attached to one registered prefix occupy the prefix's
+    FULL pages exactly once (refcount 3 = registry + 2 slots, pool
+    usage unchanged) and each get a private copy of the partial tail
+    page."""
+    pool = PagePool(PAGES, PS, 2, T // PS)
+    plen = PS + 3                            # one full page + 3 rows
+    prefix_pages = [pool.alloc(), pool.alloc()]
+    used0 = pool.used_pages
+    attaches = []
+    for slot in range(2):
+        ok, src, dst = pool.attach(slot, prefix_pages, plen)
+        assert ok
+        assert src == prefix_pages[1] and dst not in prefix_pages
+        attaches.append(dst)
+        pool.lengths[slot] = plen
+    # The full page is counted once however many sequences share it.
+    assert pool.used_pages == used0 + 2      # the two private tails
+    assert pool.refcount[prefix_pages[0]] == 3
+    assert pool.shared_pages == 1
+    assert attaches[0] != attaches[1]
+
+
+def test_cow_on_first_divergent_append():
+    """Fork then append: the shared tail was already copied at fork, so
+    the branches' first appends hit private pages; a SHARED full page
+    boundary triggers the copy-on-write pair from prepare_append."""
+    pool = PagePool(PAGES, PS, 2, T // PS)
+    # Slot 0 with exactly one FULL page, then fork at the page boundary.
+    ok, _ = pool.reserve_rows(0, PS)
+    assert ok
+    pool.lengths[0] = PS
+    ok, src, dst = pool.fork(0, 1)
+    assert ok and src == -1 and dst == -1    # aligned fork: no copy
+    page = int(pool.table[0, 0])
+    assert pool.refcount[page] == 2
+    # Next append of either branch lands in a FRESH page (position PS
+    # opens page ordinal 1) — no CoW needed, the shared page is never
+    # written again.
+    st, _, _ = pool.prepare_append(0)
+    assert st == 'alloc'
+    # Now seed a genuinely shared append page: mid-page fork.
+    pool2 = PagePool(PAGES, PS, 2, T // PS)
+    ok, _ = pool2.reserve_rows(0, 3)
+    pool2.lengths[0] = 3
+    ok, src, dst = pool2.fork(0, 1)
+    assert ok and src == int(pool2.table[0, 0]) and dst >= 0
+    assert pool2.table[1, 0] == dst          # branch owns its tail copy
+    st, _, _ = pool2.prepare_append(1)
+    assert st == 'ok'                        # already private
+    st, _, _ = pool2.prepare_append(0)
+    assert st == 'ok'
+
+
+def test_fork_streams_identical(monkeypatch):
+    """Device-level fork: branch attends the forked prefix identically
+    to the source (shared pages + copied tail), then diverges only
+    through its own appends."""
+    _, paged, pool = _mk_pair(fills=(10, 0))
+    ok, src, dst = pool.fork(0, 1)
+    assert ok
+    paged = paged_copy_attach(paged, jnp.int32(src), jnp.int32(dst),
+                              jnp.int32(1), jnp.int32(10))
+    paged = paged._replace(page_table=jnp.asarray(pool.table))
+    gk, gv = paged_gather(paged)
+    assert np.array_equal(np.asarray(gk)[0, :, :10],
+                          np.asarray(gk)[1, :, :10])
+    # Shared full page counted once: 10 rows = 2 pages, 1 full shared.
+    assert pool.refcount[int(pool.table[0, 0])] == 2
+    assert pool.table[0, 1] != pool.table[1, 1]
+    # Divergent appends stay private.
+    q, kn, vn = _qkv(11)
+    paged = _prepare(paged, pool)
+    p2, out = decode_step(q, paged, kn, vn, impl='xla')
+    g2k, _ = paged_gather(p2)
+    assert np.array_equal(np.asarray(g2k)[0, :, :10],
+                          np.asarray(g2k)[1, :, :10])
+    assert np.array_equal(np.asarray(out)[0], np.asarray(out)[1]) \
+        == bool(np.array_equal(np.asarray(q)[0], np.asarray(q)[1]))
+
+
+def test_prefix_fill_writes_registry_pages():
+    paged = init_paged_cache(1, H, T, D, pages=PAGES, page_size=PS,
+                             dtype=jnp.float32)
+    pool = PagePool(PAGES, PS, 1, T // PS)
+    pages = [pool.alloc(), pool.alloc()]
+    rng = _rng(5)
+    rows = jnp.asarray(rng.normal(size=(H, PS + 2, D)), jnp.float32)
+    row_vec = np.full(T // PS, -1, np.int32)
+    row_vec[:2] = pages
+    paged = paged_append_rows(paged, rows, rows, jnp.asarray(row_vec),
+                              jnp.int32(0), jnp.int32(PS + 2))
+    kp = np.asarray(paged.k_pool)
+    assert np.array_equal(kp[pages[0]], np.asarray(rows)[:, :PS]
+                          .transpose(0, 1, 2))
+    assert np.array_equal(kp[pages[1], :, :2], np.asarray(rows)[:, PS:])
+    assert not kp[pages[1], :, 2:].any()
+
+
+# -- exhaustion ---------------------------------------------------------
+
+def test_pool_exhaustion_is_typed_and_rolls_back():
+    pool = PagePool(2, PS, 2, T // PS)
+    ok, _ = pool.reserve_rows(0, 2 * PS)     # takes both pages
+    assert ok and pool.free_pages == 0
+    ok, copies = pool.reserve_rows(1, 1)
+    assert not ok and not copies
+    assert pool.counts[1] == 0 and (pool.table[1] == -1).all()
+    st, _, _ = pool.prepare_append(1)
+    assert st == 'exhausted'
+    freed = pool.release(0)
+    assert sorted(freed) == sorted(pool._free[-2:])
+    assert pool.free_pages == 2
+
+
+def test_reserve_rollback_keeps_pool_consistent():
+    pool = PagePool(3, PS, 2, T // PS)
+    ok, _ = pool.reserve_rows(0, PS)         # 1 page used
+    assert ok
+    ok, _ = pool.reserve_rows(1, 3 * PS)     # needs 3, only 2 free
+    assert not ok
+    assert pool.free_pages == 2 and pool.counts[1] == 0
+    assert (pool.refcount >= 0).all()
+    ok, _ = pool.reserve_rows(1, 2 * PS)     # what's left still works
+    assert ok
+
+
+def test_kernel_ineligible_when_page_exceeds_vmem_cap():
+    """The paged kernel's K split IS the page size, so a page larger
+    than the slab split's VMEM cap must route to the XLA path (auto)
+    and raise a typed error when the kernel is forced — not hand
+    Mosaic an oversized double-buffered K+V stream."""
+    from distributed_dot_product_tpu.models.decode import (
+        decode_kernel_eligible,
+    )
+    from distributed_dot_product_tpu.ops.pallas_decode import (
+        _BLOCK_K_CAP,
+    )
+    big_ps = 2 * _BLOCK_K_CAP
+    cache = init_paged_cache(1, H, 2 * big_ps, D, pages=3,
+                             page_size=big_ps)
+    assert not decode_kernel_eligible(cache)
+    small = init_paged_cache(1, H, T, D, pages=PAGES, page_size=PS)
+    assert decode_kernel_eligible(small)
+    q = jnp.zeros((1, H, 1, D))
+    new = jnp.zeros((1, H, 1, D))
+    with pytest.raises(ValueError, match='does not cover'):
+        decode_step(q, cache, new, new, impl='kernel')
+
+
+def test_pool_alloc_block_and_release_pages():
+    """Block allocation is all-or-nothing (rollback leaves the pool
+    untouched) and release_pages reports exactly the pages whose last
+    reference dropped."""
+    pool = PagePool(4, PS, 1, 4)
+    assert pool.alloc_block(5) is None       # too big: nothing changed
+    assert pool.free_pages == 4
+    assert (pool.refcount == 0).all()
+    pages = pool.alloc_block(3)
+    assert pages is not None and pool.free_pages == 1
+    assert all(pool.refcount[p] == 1 for p in pages)
+    assert pool.alloc_block(2) is None       # partial: rolled back
+    assert pool.free_pages == 1
+    pool.refcount[pages[0]] += 1             # a rider shares page 0
+    freed = pool.release_pages(pages)
+    assert sorted(freed) == sorted(pages[1:])
+    assert pool.refcount[pages[0]] == 1
+    assert pool.free_pages == 3

@@ -1,0 +1,459 @@
+# -*- coding: utf-8 -*-
+"""
+Paged serving layer — the ISSUE 7 acceptance scenarios on the CPU
+backend:
+
+- **4× concurrency on the same memory budget**: a paged engine whose
+  pool holds exactly the bytes of the slab engine's cache admits ≥4×
+  the slab's concurrent sequence count (actual fill vs worst-case
+  reservation — the whole point of paging).
+- **Bit-identical streams vs the slab path under the fault cocktail**:
+  same seeded traffic, same faults, layouts differ — every completed
+  stream matches the slab run's token for token.
+- **Prefix sharing counted once**: two sequences riding one registered
+  prefix occupy its full pages exactly once (refcount gauge = the
+  acceptance check), and copy-on-write keeps divergent appends private.
+- **Page exhaustion is typed**: statically impossible requests reject
+  CACHE_EXHAUSTED at submit; mid-stream exhaustion walks the
+  evict→preempt ladder and terminates with the typed reason, with the
+  whole arc reconstructable from the event log alone.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+from distributed_dot_product_tpu.obs.exporter import render_prometheus
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, RejectedError, RejectReason, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+VOCAB = 16
+T_MAX = 64
+PS = 4
+SLAB_SLOTS = 4
+# Equal KV bytes: slab = SLAB_SLOTS × T_MAX rows; paged pool = the same
+# row count as pages — concurrency comes from raising `slots` 4×.
+BUDGET_ROWS = SLAB_SLOTS * T_MAX
+PAGED_SLOTS = 4 * SLAB_SLOTS
+PAGES = BUDGET_ROWS // PS
+
+TERMINAL = {'completed', 'deadline_expired', 'evicted', 'abandoned',
+            'failed_nan', 'rejected'}
+
+
+def _engine(mode, slots, **kw):
+    paged = dict(cache_mode='paged', page_size=PS, pages=PAGES) \
+        if mode == 'paged' else {}
+    return KernelEngine(slots=slots, t_max=T_MAX, vocab=VOCAB, heads=2,
+                        head_dim=4, prefill_chunk=4, seed=5,
+                        decode_impl=kw.pop('decode_impl', 'xla'),
+                        **paged, **kw)
+
+
+def _burst(n, seed):
+    rng = np.random.default_rng(seed)
+    return [(f'r{i:03d}',
+             rng.integers(0, VOCAB,
+                          size=int(rng.integers(1, 7))).astype(np.int32))
+            for i in range(n)]
+
+
+def _run(mode, slots, n_requests, injector=None, *, seed=11,
+         queue_limit=48, max_new=3, decode_impl='xla', on_tick=None):
+    sched = Scheduler(
+        _engine(mode, slots, decode_impl=decode_impl),
+        ServeConfig(queue_limit=queue_limit, max_new_tokens=max_new,
+                    watchdog=False, evict_before_reject=False),
+        fault_injector=injector if injector is not None else False,
+        registry=MetricsRegistry(), on_tick=on_tick)
+    rejected = {}
+    for i, (rid, prompt) in enumerate(_burst(n_requests, seed)):
+        try:
+            sched.submit(prompt, request_id=rid)
+        except RejectedError as e:
+            rejected[rid] = e.reason
+    results = sched.run_until_idle()
+    sched.close()
+    return sched, rejected, results
+
+
+# -- acceptance: 4x concurrency on the same memory budget ---------------
+
+def test_soak_4x_concurrency_same_memory_budget():
+    """The paged pool holds EXACTLY the slab's bytes (BUDGET_ROWS of
+    KV) yet serves 4× the concurrent sequences: short requests reserve
+    pages for their actual fill, not a worst-case t_max strip."""
+    peak = {'busy': 0}
+
+    def on_tick(s):
+        peak['busy'] = max(peak['busy'],
+                           sum(sl.request is not None
+                               for sl in s._slots))
+
+    n = 3 * PAGED_SLOTS
+    sched, rejected, results = _run('paged', PAGED_SLOTS, n,
+                                    on_tick=on_tick)
+    assert peak['busy'] >= 4 * SLAB_SLOTS, peak
+    assert not rejected
+    assert len(results) == n
+    assert all(r.status == 'completed' for r in results.values())
+    # The budget really is the slab's: a slab of PAGED_SLOTS slots
+    # would need 4× these bytes.
+    eng = sched.engine
+    assert eng.pool.pages * eng.page_size == BUDGET_ROWS
+
+
+@pytest.mark.parametrize('decode_impl', ['xla', 'kernel'])
+def test_soak_bit_identical_to_slab_under_fault_cocktail(decode_impl):
+    """Same seeded traffic + stuck/NaN faults through a slab scheduler
+    and a paged one (4× slots, same bytes): every request completed by
+    BOTH runs produced bit-identical tokens — the paged layout changes
+    memory, never streams. Quarantine/preempt/evict churn included."""
+    n = 20
+    plan = dict(stuck_at_step=3, stuck_seconds=0.02, nan_at_step=5,
+                nan_slot=1)
+    _, rej_s, res_s = _run('slab', SLAB_SLOTS, n,
+                           ServeFaultInjector(ServeFaultPlan(**plan)),
+                           decode_impl=decode_impl)
+    sched_p, rej_p, res_p = _run(
+        'paged', PAGED_SLOTS, n,
+        ServeFaultInjector(ServeFaultPlan(**plan)),
+        decode_impl=decode_impl)
+    counters = sched_p.registry.snapshot()['counters']
+    assert counters['serve.nan_quarantined'] >= 1
+    compared = 0
+    for rid, rp in res_p.items():
+        rs = res_s.get(rid)
+        if rs is None or rp.status != 'completed' \
+                or rs.status != 'completed':
+            continue
+        short, long_ = sorted((rp.tokens, rs.tokens), key=len)
+        assert long_[:len(short)] == short, f'{rid}: stream diverged'
+        if len(short) == len(long_):
+            compared += 1
+    assert compared >= 5, 'soak too small to witness identity'
+    # Zero dropped-without-reason on the paged side too.
+    for rid, _ in _burst(n, 11):
+        assert rid in res_p or rej_p.get(rid) is not None
+        if rid in res_p:
+            assert res_p[rid].status in TERMINAL
+
+
+# -- acceptance: prefix sharing counted once ----------------------------
+
+def test_prefix_pages_occupied_exactly_once():
+    eng = _engine('paged', 4)
+    sched = Scheduler(eng, ServeConfig(queue_limit=8, max_new_tokens=4,
+                                       watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    prefix = np.arange(2 * PS, dtype=np.int32) % VOCAB  # page-aligned
+    pid = eng.register_prefix(prefix)
+    used_before = eng.pool.used_pages
+    sched.submit([1, 2], prefix_id=pid, request_id='a')
+    sched.submit([3, 4], prefix_id=pid, request_id='b')
+    sched.step()                        # both admitted, prefix attached
+    pages = eng._prefix_registry[pid][0]
+    # THE acceptance check: both sequences attached, the prefix's pages
+    # exist once in the pool (refcount 3 = registry + 2 riders; the
+    # shared-pages gauge sees them, pool usage only grew by the two
+    # private continuation pages).
+    assert all(eng.pool.refcount[p] == 3 for p in pages)
+    stats = eng.cache_stats()
+    assert stats['shared_pages'] == len(pages) == 2
+    assert stats['pages_used'] == used_before + 2
+    g = sched.registry.snapshot()['gauges']
+    assert g['serve.cache.shared_pages'] == 2
+    results = sched.run_until_idle()
+    assert {r.status for r in results.values()} == {'completed'}
+    # Riders retired: the registry alone holds the prefix.
+    assert all(eng.pool.refcount[p] == 1 for p in pages)
+    # Both riders saw the SAME context: identical continuations decode
+    # identical streams only if prompts matched; here prompts differ,
+    # so just check both streams exist and the pool drained.
+    eng.unregister_prefix(pid)
+    assert eng.pool.used_pages == 0
+    sched.close()
+
+
+def test_prefix_streams_match_unshared_equivalent():
+    """A prefix-shared request decodes EXACTLY like the same tokens
+    submitted as one flat prompt on a fresh engine — sharing is a
+    memory optimization, not a semantics change."""
+    prefix = np.arange(2 * PS + 1, dtype=np.int32) % VOCAB  # partial!
+    tail = np.array([5, 9], np.int32)
+    eng1 = _engine('paged', 2)
+    s1 = Scheduler(eng1, ServeConfig(queue_limit=4, max_new_tokens=4,
+                                     watchdog=False),
+                   registry=MetricsRegistry(), fault_injector=False)
+    pid = eng1.register_prefix(prefix)
+    s1.submit(tail, prefix_id=pid, request_id='shared')
+    r1 = s1.run_until_idle()['shared']
+    s1.close()
+    eng2 = _engine('paged', 2)
+    s2 = Scheduler(eng2, ServeConfig(queue_limit=4, max_new_tokens=4,
+                                     watchdog=False),
+                   registry=MetricsRegistry(), fault_injector=False)
+    s2.submit(np.concatenate([prefix, tail]), request_id='flat')
+    r2 = s2.run_until_idle()['flat']
+    s2.close()
+    assert r1.status == r2.status == 'completed'
+    assert r1.tokens == r2.tokens
+
+
+def test_fork_branches_share_pages_and_streams():
+    eng = _engine('paged', 4)
+    sched = Scheduler(eng, ServeConfig(queue_limit=8, max_new_tokens=6,
+                                       watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    sched.submit([1, 2, 3, 4, 5, 6], request_id='a')
+    sched.step()
+    sched.step()                             # prefill + first decode
+    used = eng.pool.used_pages
+    sched.fork('a', request_id_new='a2')
+    # Fork cost: at most ONE page (the partial tail copy).
+    assert eng.pool.used_pages <= used + 1
+    assert eng.cache_stats()['shared_pages'] >= 1
+    results = sched.run_until_idle()
+    assert results['a'].status == results['a2'].status == 'completed'
+    assert results['a'].tokens == results['a2'].tokens
+    sched.close()
+
+
+# -- exhaustion ladder --------------------------------------------------
+
+def test_statically_impossible_prompt_rejects_cache_exhausted():
+    eng = KernelEngine(slots=2, t_max=T_MAX, vocab=VOCAB,
+                       cache_mode='paged', page_size=PS, pages=4,
+                       decode_impl='xla')
+    sched = Scheduler(eng, ServeConfig(queue_limit=4, watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    with pytest.raises(RejectedError) as ei:
+        sched.submit(np.arange(4 * PS + 1, dtype=np.int32) % VOCAB,
+                     request_id='too-big')
+    assert ei.value.reason is RejectReason.CACHE_EXHAUSTED
+    counters = sched.registry.snapshot()['counters']
+    assert counters['serve.rejected.cache_exhausted'] == 1
+    sched.close()
+
+
+def test_unknown_or_unregistered_prefix_is_typed():
+    """prefix_id failures are typed, never raw KeyErrors: unknown at
+    submit raises PREFIX_UNREGISTERED; a prefix unregistered while its
+    rider sat queued finalizes the rider with the same reason instead
+    of crashing the tick."""
+    eng = _engine('paged', 2)
+    sched = Scheduler(eng, ServeConfig(queue_limit=4, max_new_tokens=3,
+                                       watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    with pytest.raises(RejectedError) as ei:
+        sched.submit([1, 2], prefix_id=999, request_id='ghost')
+    assert ei.value.reason is RejectReason.PREFIX_UNREGISTERED
+    pid = eng.register_prefix(np.arange(PS, dtype=np.int32))
+    sched.submit([1, 2], prefix_id=pid, request_id='rider')
+    eng.unregister_prefix(pid)           # vanishes while queued
+    results = sched.run_until_idle()
+    r = results['rider']
+    assert r.status == 'rejected'
+    assert r.reason is RejectReason.PREFIX_UNREGISTERED
+    sched.close()
+
+
+def test_midstream_exhaustion_walks_preempt_ladder():
+    """Two growing sequences over a pool only one can finish in:
+    the deficit slot is preempted with the typed event, retries are
+    bounded, and the loser terminates 'evicted' with CACHE_EXHAUSTED —
+    never a hang, never a silent drop."""
+    eng = KernelEngine(slots=2, t_max=16, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=2, pages=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng,
+        ServeConfig(queue_limit=4, max_new_tokens=10, watchdog=False,
+                    evict_before_reject=False, max_requeues=1),
+        registry=MetricsRegistry(), fault_injector=False)
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    results = sched.run_until_idle()
+    counters = sched.registry.snapshot()['counters']
+    assert counters['serve.cache_preempted'] >= 1
+    statuses = sorted(r.status for r in results.values())
+    assert 'completed' in statuses
+    loser = [r for r in results.values() if r.status != 'completed']
+    assert loser and loser[0].status == 'evicted'
+    assert loser[0].reason is RejectReason.CACHE_EXHAUSTED
+    assert eng.pool.used_pages == 0       # everything drained
+    sched.close()
+
+
+def test_exhaustion_evicts_longest_idle_first_when_allowed():
+    eng = KernelEngine(slots=2, t_max=16, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=2, pages=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng,
+        ServeConfig(queue_limit=4, max_new_tokens=10, watchdog=False,
+                    evict_before_reject=True),
+        registry=MetricsRegistry(), fault_injector=False)
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    results = sched.run_until_idle()
+    statuses = sorted(r.status for r in results.values())
+    assert statuses == ['completed', 'evicted']
+    evicted = [r for r in results.values() if r.status == 'evicted'][0]
+    assert evicted.tokens, 'eviction keeps partial tokens'
+    sched.close()
+
+
+def test_preempt_arc_reconstructs_from_event_log(tmp_path):
+    log = obs_events.EventLog(tmp_path / 'serve.jsonl')
+    eng = KernelEngine(slots=2, t_max=16, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=2, pages=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng,
+        ServeConfig(queue_limit=4, max_new_tokens=10, watchdog=False,
+                    evict_before_reject=False, max_requeues=1),
+        registry=MetricsRegistry(), fault_injector=False,
+        event_log=log)
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    sched.run_until_idle()
+    sched.close()
+    log.close()
+    _records, errors = obs_events.validate_file(log.path)
+    assert errors == []
+    tls = reconstruct(log.path)
+    assert set(tls) == {'a', 'b'}
+    preempted = [t for t in tls.values() if t.preempts]
+    assert preempted, 'no preempt recorded'
+    for tl in tls.values():
+        assert tl.complete, tl.errors
+
+
+# -- observability surface ----------------------------------------------
+
+def test_cache_gauges_render_through_metrics_exporter():
+    sched, _, _ = _run('paged', PAGED_SLOTS, 8)
+    text = render_prometheus(sched.registry)
+    for gauge in ('ddp_serve_cache_pages_used',
+                  'ddp_serve_cache_pages_free',
+                  'ddp_serve_cache_shared_pages'):
+        assert gauge in text, f'{gauge} missing from /metrics'
+    assert 'ddp_serve_cache_request_pages' in text
+
+
+# -- slab-surface parity at the capacity boundary -----------------------
+
+def test_slot_at_t_max_steps_frozen_like_slab():
+    """A paged slot reaching t_max keeps stepping under the slab
+    engine's frozen-write contract (the device append drops while the
+    length advances) — step() must NOT raise 'page pool exhausted':
+    the pool has plenty of free pages and no allocation could ever
+    cover a past-capacity position. Direct callers get the same
+    surface on both layouts, token for token."""
+    t_max = 16
+    kw = dict(slots=1, t_max=t_max, vocab=VOCAB, heads=2, head_dim=4,
+              prefill_chunk=4, seed=5, decode_impl='xla')
+    slab = KernelEngine(**kw)
+    paged = KernelEngine(cache_mode='paged', page_size=PS, pages=100,
+                         **kw)
+    prompt = [1, 2, 3]
+    streams = []
+    for eng in (slab, paged):
+        eng.prefill(0, prompt)
+        tok = np.array([prompt[-1]], np.int32)
+        active = np.array([True])
+        toks = []
+        for _ in range(t_max + 8):          # well past capacity
+            tok, finite = eng.step(tok, active)
+            assert finite.all()
+            toks.append(int(tok[0]))
+        streams.append(toks)
+    assert streams[0] == streams[1]
+    assert paged.pool.free_pages > 0        # it never was exhaustion
+
+
+def test_fork_budget_clamped_to_config_cap():
+    """fork() applies the same budget clamp admission.validate gives
+    every submitted request: an explicit max_new_tokens cannot exceed
+    the config cap (or the cache/pool capacity), so a branch can't
+    hold a slot and pool pages past what submit() would allow."""
+    eng = _engine('paged', 4)
+    sched = Scheduler(eng, ServeConfig(queue_limit=8, max_new_tokens=4,
+                                       watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    sched.submit([1, 2, 3, 4], request_id='a')
+    sched.step()
+    sched.step()                             # prefill + first decode
+    br = sched.fork('a', request_id_new='b', max_new_tokens=1000)
+    assert br.max_new_tokens <= 4
+    results = sched.run_until_idle()
+    assert results['a'].status == results['b'].status == 'completed'
+    assert len(results['b'].tokens) <= 4
+    sched.close()
+
+
+def test_cache_stats_on_slab_engine_reports_zeros():
+    """Generic dashboard code may probe any engine the way the
+    scheduler probes paged ones — a slab engine answers with zeros,
+    not an AttributeError."""
+    eng = _engine('slab', 2)
+    assert eng.cache_stats() == {'pages': 0, 'pages_used': 0,
+                                 'pages_free': 0, 'shared_pages': 0,
+                                 'page_size': 0}
+
+
+def test_never_placeable_prefix_rider_rejects_instead_of_stalling():
+    """A rider whose pool can NEVER supply its placement (registry-
+    pinned prefix pages + CoW tail copy + fresh prompt pages exceed
+    the whole pool) must be typed-rejected at its admission tick —
+    admission.validate can't see the registry pin, and an eternal
+    head-of-line 'wait' would stall every later request behind it."""
+    eng = KernelEngine(slots=2, t_max=32, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=8, pages=4,
+                       decode_impl='xla')
+    pid = eng.register_prefix(np.arange(20, dtype=np.int32) % VOCAB)
+    assert eng.pinned_pages == 3             # 20 rows pin 3 of 4 pages
+    sched = Scheduler(eng, ServeConfig(queue_limit=8, max_new_tokens=2,
+                                       watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    # Needs the 1-page tail copy + 1 fresh prompt page = 2, but only
+    # 1 page can ever be free while the prefix stays registered.
+    sched.submit(np.arange(8, dtype=np.int32) % VOCAB,
+                 request_id='rider', prefix_id=pid)
+    sched.submit([1, 2, 3], request_id='later')
+    results = sched.run_until_idle()
+    assert results['rider'].status == 'rejected'
+    assert results['rider'].reason is RejectReason.CACHE_EXHAUSTED
+    assert results['later'].status == 'completed'   # no stall behind it
+    counters = sched.registry.snapshot()['counters']
+    assert counters['serve.rejected.cache_exhausted'] == 1
+    sched.close()
+
+
+def test_pool_pressure_downgrades_readiness():
+    """Pool fill joins queue depth in the readiness signal, not just
+    the budget degrade: a load balancer must see DEGRADED on a chip
+    whose pool is nearly full even while its queue sits empty."""
+    from distributed_dot_product_tpu.serve import Readiness
+    eng = KernelEngine(slots=2, t_max=64, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=8, pages=8,
+                       decode_impl='xla')
+    eng.register_prefix(np.arange(49, dtype=np.int32) % VOCAB)
+    assert eng.pinned_pages == 7             # 7/8 pages > 0.75 default
+    sched = Scheduler(eng, ServeConfig(queue_limit=8, watchdog=False),
+                      registry=MetricsRegistry(), fault_injector=False)
+    sched.step()                             # tick refreshes readiness
+    assert sched.health.readiness is Readiness.DEGRADED
+    sched.close()
