@@ -132,6 +132,21 @@ def parse_args():
                         help='decode-serve --cache-mode paged: pool '
                              'page granularity in rows (= the fused '
                              "kernel's K split; must divide --seq-len)")
+    parser.add_argument('--spec', choices=['off', 'ngram', 'draft'],
+                        default='off',
+                        help='decode mode: speculative (draft-verify) '
+                             'generation rows — the scheduler drives '
+                             'the fused verify-k program with the '
+                             'named proposer on a repetitive prompt '
+                             'and the row records accepted-tokens/'
+                             'step, tokens/s and the non-spec '
+                             "baseline's tokens/s on the same "
+                             'engine/prompts (greedy verification '
+                             'keeps both streams identical — the run '
+                             'asserts it)')
+    parser.add_argument('--spec-k', type=int, default=4,
+                        help='--spec: most proposals per slot per '
+                             'verify step (verify width k+1)')
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -1068,11 +1083,140 @@ def run_decode_serve(args):
     return record
 
 
+def run_decode_spec(args):
+    """``--mode decode --spec {ngram,draft}``: what draft-verify
+    decoding BUYS over plain one-token-per-dispatch generation. Two
+    scheduler runs over the same engine shape and the same repetitive
+    prompts (the regime speculation targets — code, templates,
+    quoting): (a) non-spec baseline, (b) the named proposer feeding
+    the fused verify-k program. Both runs are timed warm (one
+    throwaway burst compiles every program) and the row records
+    tokens/s for each plus the amortization telemetry — mean
+    accepted/proposed tokens per verify step out of the serve.spec
+    histograms. Greedy verification makes speculation EXACT, so the
+    run asserts the two bursts' streams are token-for-token identical
+    before recording anything: a row from diverging streams would be
+    a benchmark of a bug."""
+    import time as _time
+
+    import numpy as np
+
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, Scheduler, ServeConfig,
+    )
+    from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+    slots = args.batch                       # B=1 is the sweep twin
+    t_max = args.seq_len or 512
+    max_new = 64
+    # A cyclic prompt (period 3) — the n-gram proposer's best case and
+    # the draft twin's easiest stream; prompt_len rows + the generated
+    # tokens must fit the cache.
+    prompt_len = min(8, t_max - max_new - 1)
+    if prompt_len < 2:
+        raise SystemExit(f'--seq-len {t_max} leaves no room for a '
+                         f'prompt + {max_new} generated tokens')
+    prompt = [(i % 3) + 1 for i in range(prompt_len)]
+    n_rounds = -(-(args.serve_requests or 2 * slots) // slots)
+    n_requests = n_rounds * slots
+
+    def burst(sched, tag):
+        for i in range(n_requests):
+            sched.submit(list(prompt), request_id=f'{tag}.{i}')
+        # run_until_idle returns EVERY result since scheduler start —
+        # keep only this burst's, or the warm burst's tokens would
+        # inflate the timed rate.
+        return {rid: r for rid, r in sched.run_until_idle().items()
+                if rid.startswith(f'{tag}.')}
+
+    def measure(spec):
+        # seed=4: a random-init engine whose greedy continuation of
+        # the cyclic prompt locks into the cycle (most seeds wander) —
+        # the repetitive regime this row measures. The baseline twin
+        # shares the seed, so the comparison is same-stream.
+        eng = KernelEngine(
+            slots=slots, t_max=t_max, vocab=256, heads=args.heads,
+            head_dim=args.head_dim, prefill_chunk=8, seed=4,
+            decode_impl=(None if args.decode_impl == 'auto'
+                         else args.decode_impl))
+        reg = (tracing.get_registry()
+               if spec and getattr(args, 'metrics_out', None)
+               else MetricsRegistry())
+        sched = Scheduler(eng, ServeConfig(
+            queue_limit=max(8, 2 * n_requests), max_new_tokens=max_new,
+            watchdog=False, degrade_watermark=1.1,
+            spec=spec, spec_k=args.spec_k), registry=reg)
+        burst(sched, 'warm')                 # compile + warm every path
+        steps0 = reg.snapshot()['counters'].get('serve.decode_steps', 0)
+        t0 = _time.perf_counter()
+        with span('benchmark.spec_burst', spec=spec or 'off'):
+            results = burst(sched, 'r')
+        dt = _time.perf_counter() - t0
+        steps = (reg.snapshot()['counters']['serve.decode_steps']
+                 - steps0)
+        sched.close()
+        n_tok = sum(len(r.tokens) for r in results.values())
+        return results, n_tok / dt, steps, reg, eng
+
+    # 'off', not None: None would consult the DDP_TPU_SPEC env knob
+    # and — with it set — silently make the "baseline" speculative
+    # too, recording a spec-vs-spec row as if it were the comparison.
+    base, base_tps, base_steps, _, _ = measure('off')
+    spec, spec_tps, spec_steps, reg, eng = measure(args.spec)
+    for rid in base:
+        if spec[rid].tokens != base[rid].tokens:
+            raise SystemExit(
+                f'spec stream diverged from the non-spec stream for '
+                f'{rid} — greedy verification must be exact; this is '
+                f'a decode bug, not a measurable row')
+    acc = reg.histogram('serve.spec.accepted_per_step',
+                        buckets=()).summary()
+    prop = reg.histogram('serve.spec.proposed_per_step',
+                         buckets=()).summary()
+
+    from distributed_dot_product_tpu.models.decode import (
+        _resolve_decode_impl,
+    )
+    impl_resolved = _resolve_decode_impl(
+        None if eng.decode_impl == 'auto' else eng.decode_impl,
+        eng.cache, 1, None, None)
+    n_tok = sum(len(r.tokens) for r in spec.values())
+    record = {
+        'mode': 'decode', 'spec': args.spec, 'spec_k': args.spec_k,
+        'slots': slots, 't_max': t_max, 'heads': args.heads,
+        'head_dim': args.head_dim, 'requests': n_requests,
+        'prompt_len': prompt_len, 'max_new_tokens': max_new,
+        'decode_impl': impl_resolved,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'tokens': n_tok,
+        'tokens_per_s': spec_tps,
+        'baseline_tokens_per_s': base_tps,
+        'spec_speedup': spec_tps / base_tps,
+        'decode_steps': spec_steps,
+        'baseline_decode_steps': base_steps,
+        'accepted_per_step': acc['mean'],
+        'proposed_per_step': prop['mean'],
+        'completed': sum(r.status == 'completed'
+                         for r in spec.values()),
+    }
+    print(f"decode-spec[{args.spec} k={args.spec_k}/{impl_resolved}] "
+          f"B={slots} t_max={t_max}: {spec_tps:,.0f} tok/s vs "
+          f"{base_tps:,.0f} non-spec ({record['spec_speedup']:.2f}x), "
+          f"accepted {acc['mean']:.2f}/step of {prop['mean']:.2f} "
+          f"proposed, {spec_steps} vs {base_steps} dispatches "
+          f"for {n_tok} tokens")
+    _append_record(args.file, record)
+    return record
+
+
 def run(args):
     if args.mode == 'attn':
         return run_attn(args)
     if args.mode == 'train':
         return run_train(args)
+    if args.mode == 'decode' and args.spec != 'off':
+        return run_decode_spec(args)
     if args.mode == 'decode':
         return run_decode(args)
     if args.mode == 'decode-serve':

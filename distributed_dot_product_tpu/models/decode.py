@@ -58,9 +58,11 @@ from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'decode_attention', 'init_slot_cache', 'append_kv_slots',
            'reset_slot', 'slots_all_finite', 'decode_step',
-           'decode_kernel_eligible', 'PagedDecodeCache', 'PagePool',
+           'decode_kernel_eligible', 'rollback_slots',
+           'PagedDecodeCache', 'PagePool',
            'init_paged_cache', 'paged_gather', 'paged_append_kv_slots',
-           'paged_append_rows', 'paged_reset_slot', 'paged_copy_attach']
+           'paged_append_rows', 'paged_reset_slot',
+           'paged_rollback_slots', 'paged_copy_attach']
 
 
 class DecodeCache(NamedTuple):
@@ -375,6 +377,77 @@ def slots_all_finite(x):
     return jnp.all(jnp.isfinite(x.reshape(x.shape[0], -1)), axis=-1)
 
 
+def rollback_slots(cache: DecodeCache, lengths, span=None):
+    """Acceptance-prefix rollback (speculative decoding): truncate each
+    slot's length to ``lengths`` AND zero every row at or past it, so
+    the cache is BIT-IDENTICAL to having appended only the accepted
+    tokens — the rejected proposals' k/v (and int8-mirror rows) leave
+    no residue for a later query row, padded verify row, or recycled
+    position to read. ``lengths`` broadcasts against ``cache.length``
+    (a ``(B,)`` vector for slot caches, a scalar for scalar-clock
+    caches — including a layer-stacked generation cache, where both
+    carry a leading layer axis); a slot whose target is at or past its
+    current fill is untouched (``min(current, target)`` semantics, so
+    one batched call can roll back a FEW slots with a don't-touch
+    sentinel for the rest).
+
+    ``span`` (static, per-slot caches only): the most rows any slot
+    rolls back — a verify-k step rejects at most k proposals, so the
+    serving engine passes its verify width. With a span the zeroing is
+    a SURGICAL scatter over the ``span`` rows at each slot's new length
+    (O(B·span·d) traffic — the verify hot path must not rewrite the
+    whole cache to drop k rows); rows past a slot's old fill were
+    already zero, so over-zeroing the span is harmless and the result
+    is bit-identical to the full-mask path. Without a span the mask
+    covers the whole ``t_max`` axis (the general form scalar-clock and
+    layer-stacked generation caches use). Paged caches route through
+    :func:`paged_rollback_slots` — the pool needs the host allocator's
+    page release."""
+    if isinstance(cache, PagedDecodeCache):
+        raise ValueError(
+            'rollback_slots on a paged cache needs the bounded span '
+            'and the host page release — use paged_rollback_slots '
+            "with PagePool.truncate()'s bookkeeping")
+    new_len = jnp.minimum(cache.length,
+                          jnp.asarray(lengths, cache.length.dtype))
+    if span is not None:
+        if cache.length.ndim != 1:
+            raise ValueError('span needs a per-slot cache '
+                             '(init_slot_cache); scalar-clock caches '
+                             'take the full-mask path (span=None)')
+        b = cache.length.shape[0]
+        pos = new_len[:, None] + jnp.arange(span)[None, :]  # (B, span)
+        bi = jnp.arange(b)[:, None]
+
+        def trunc(buf):
+            zero = jnp.zeros((buf.shape[1], buf.shape[-1]), buf.dtype)
+            return buf.at[bi, :, pos, :].set(zero, mode='drop')
+
+        return cache._replace(
+            k=trunc(cache.k), v=trunc(cache.v), length=new_len,
+            k_q=None if cache.k_q is None else trunc(cache.k_q),
+            k_scale=(None if cache.k_scale is None
+                     else trunc(cache.k_scale)))
+
+    keep = (jnp.arange(cache.t_max) < new_len[..., None])
+
+    def trunc(buf):
+        # keep is length-shaped + (t_max,); pad singleton axes between
+        # the length dims and the time axis so it broadcasts against
+        # scalar (B, H, T, d·), per-slot (B, H, T, d·) and layer-
+        # stacked (L, B, H, T, d·) buffers alike.
+        extra = buf.ndim - new_len.ndim - 2
+        k = keep.reshape(keep.shape[:-1] + (1,) * extra
+                         + (cache.t_max, 1))
+        return jnp.where(k, buf, jnp.zeros((), buf.dtype))
+
+    return cache._replace(
+        k=trunc(cache.k), v=trunc(cache.v), length=new_len,
+        k_q=None if cache.k_q is None else trunc(cache.k_q),
+        k_scale=(None if cache.k_scale is None
+                 else trunc(cache.k_scale)))
+
+
 # -- paged KV cache -----------------------------------------------------
 #
 # The slab cache above reserves a dense t_max-length strip per slot, so
@@ -599,6 +672,44 @@ def paged_reset_slot(cache: PagedDecodeCache, slot, freed_pages):
         length=jnp.where(sel, 0, cache.length))
 
 
+def paged_rollback_slots(cache: PagedDecodeCache, lengths, span):
+    """Acceptance-prefix rollback over the paged pool: truncate each
+    slot's length to ``lengths`` (``min(current, target)`` — a
+    don't-touch slot passes a sentinel past its fill) and zero the
+    rejected rows, which live at logical positions ``lengths ..
+    lengths + span − 1`` of each rolled-back slot. ``span`` is STATIC
+    (one compiled program): the most rows any slot rolls back — a
+    verify-k step rejects at most k proposals, so the serving engine
+    compiles with ``span = k``. Rows are zeroed through the slot's
+    page table with the same drop-mode scatter as the appends
+    (unallocated / out-of-range rows write nowhere); rows past a
+    slot's CURRENT fill are already zero, so over-zeroing the span is
+    harmless — and the pages touched were written this step, hence
+    private (shared prefix/fork pages are always full pages below the
+    fill). The HOST side releases now-empty tail pages separately
+    (:meth:`PagePool.truncate`); the caller zeroes freed pages through
+    the reset program as usual."""
+    b, npg = cache.page_table.shape
+    ps = cache.page_size
+    new_len = jnp.minimum(cache.length,
+                          jnp.asarray(lengths, cache.length.dtype))
+    pos = new_len[:, None] + jnp.arange(span)[None, :]     # (B, span)
+    pi = pos // ps
+    pg = jnp.take_along_axis(cache.page_table,
+                             jnp.clip(pi, 0, npg - 1), axis=1)
+    pg = jnp.where(jnp.logical_and(pi < npg, pg >= 0),
+                   pg, cache.pages + 1)     # past the sink: dropped
+    rw = pos % ps
+
+    def clear(pool):
+        zero = jnp.zeros((pool.shape[1], pool.shape[-1]), pool.dtype)
+        return pool.at[pg, :, rw, :].set(zero, mode='drop')
+
+    return cache._replace(k_pool=clear(cache.k_pool),
+                          v_pool=clear(cache.v_pool),
+                          length=new_len)
+
+
 def paged_copy_attach(cache: PagedDecodeCache, src_page, dst_page, slot,
                       length_val):
     """The copy-on-write / attach primitive, one compiled program for
@@ -814,6 +925,30 @@ class PagePool:
         self.dirty = True
         return freed
 
+    def truncate(self, slot, new_length):
+        """Acceptance-prefix rollback, host side: shrink ``slot``'s fill
+        to ``new_length`` and release the tail pages no kept row lives
+        in (refcount−−; the returned list is the pages that hit 0 — the
+        caller zeroes them on device before reuse, the :meth:`alloc`
+        invariant, via the same reset program as eviction). The kept
+        partial tail page stays mapped; the device-side
+        :func:`paged_rollback_slots` zeroes its rejected rows. A
+        ``new_length`` at or past the current fill is a no-op."""
+        if new_length >= int(self.lengths[slot]):
+            return []
+        keep = self.pages_for_rows(int(new_length))
+        freed = []
+        for pi in range(keep, int(self.counts[slot])):
+            page = int(self.table[slot, pi])
+            if page >= 0:
+                if self._unref(page):
+                    freed.append(page)
+                self.table[slot, pi] = -1
+                self.dirty = True
+        self.counts[slot] = min(int(self.counts[slot]), keep)
+        self.lengths[slot] = new_length
+        return freed
+
     # -- sharing --------------------------------------------------------
     def attach(self, slot, pages, length):
         """Point an EMPTY slot at a registered prefix: share the full
@@ -856,34 +991,47 @@ class PagePool:
 
 def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None):
     """Can :func:`decode_step` take the fused Pallas kernel for this
-    call? The kernel covers the serving hot path — one new token per
-    slot, causal/window/ALiBi/GQA masking, the int8 mirror — and leaves
-    the long tail (packed segments, multi-row chunks, mirror-less int8,
-    K splits that don't divide ``t_max``) to the XLA formulation.
-    Paged caches are kernel-native (the page size IS the K split) minus
-    the int8 mirror, which the pool doesn't carry yet — and the page
-    size must sit under the same VMEM cap the slab split honors (an
-    oversized page would double-buffer a K+V stream past the budget;
-    those caches take the XLA path)."""
+    call? The kernel covers the serving hot path — ``1 <= n <= K split``
+    new rows per slot per step (n = 1 classic decode; n > 1 the fused
+    VERIFY-k step of speculative decoding, whose rows then span at most
+    two cache blocks), causal/window/ALiBi/GQA masking, and the int8
+    mirror at n = 1 — and leaves the long tail (packed segments,
+    quantized verify-k, mirror-less int8, K splits that don't divide
+    ``t_max``, verify widths past the split) to the XLA formulation.
+    Paged caches are kernel-native (the page size IS the K split, so
+    ``n <= page_size``) minus the int8 mirror, which the pool doesn't
+    carry yet — and the page size must sit under the same VMEM cap the
+    slab split honors (an oversized page would double-buffer a K+V
+    stream past the budget; those caches take the XLA path)."""
     from distributed_dot_product_tpu.ops.pallas_decode import (
         _BLOCK_K_CAP,
         decode_block_k,
     )
-    if n != 1 or segment_ids is not None:
+    if n < 1 or segment_ids is not None:
         return False
+    if qk_quant == 'int8' and n != 1:
+        return False            # quantized verify-k: XLA path only
     if isinstance(cache, PagedDecodeCache):
-        return qk_quant is None and cache.page_size <= _BLOCK_K_CAP
+        return (qk_quant is None and cache.page_size <= _BLOCK_K_CAP
+                and n <= cache.page_size)
     if qk_quant == 'int8' and cache.k_q is None:
         return False
-    return decode_block_k(cache.t_max) is not None
+    bk = decode_block_k(cache.t_max)
+    return bk is not None and n <= bk
 
 
-def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant):
+def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
+                         axis_name=None):
     if impl in (None, 'auto'):
         # Mirror the flash-kernel gating: the kernel is the TPU path;
         # elsewhere it would run interpreted (covered by tests that
         # force impl='kernel'), so the portable XLA step is the default.
+        # Sharded verify-k (axis_name + n > 1) is XLA-only — the
+        # kernel's flash-decoding merge carries one row per shard —
+        # so 'auto' must fall back rather than resolve to a path that
+        # raises.
         if (decode_kernel_eligible(cache, n, segment_ids, qk_quant)
+                and not (axis_name is not None and n != 1)
                 and jax.default_backend() == 'tpu'):
             return 'kernel'
         return 'xla'
@@ -894,15 +1042,17 @@ def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant):
             cache, n, segment_ids, qk_quant):
         raise ValueError(
             'decode_step: the fused kernel does not cover this call '
-            '(needs n=1, no segment_ids, an int8 mirror when '
-            "qk_quant='int8', a t_max the K split divides, and a "
-            'paged page size within the K-split VMEM cap) — use '
-            "impl='auto' to fall back")
+            '(needs 1 <= n <= the K split — the slab block from '
+            'decode_block_k, or the paged page size — so verify-k rows '
+            'span at most two blocks; no segment_ids; an int8 mirror '
+            "AND n=1 when qk_quant='int8'; a t_max the K split "
+            'divides; and a paged page size within the K-split VMEM '
+            "cap) — use impl='auto' to fall back")
     return impl
 
 
 def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
-                scale=None, window=None, alibi_slopes=None,
+                counts=None, scale=None, window=None, alibi_slopes=None,
                 segment_ids=None, seg_q=None, qk_quant=None,
                 axis_name=None, impl=None, interpret=None):
     """One fused decode step: append ``k_new``/``v_new`` to the cache
@@ -917,18 +1067,32 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     :func:`decode_kernel_eligible`) computes the identical math through
     the existing portable ops.
 
-    ``q (B, H, n, d)`` with ``n == 1`` on the kernel path; per-slot
-    caches (:func:`init_slot_cache`) take ``slot_mask`` exactly as
-    :func:`append_kv_slots` does (masked slots append nothing and their
-    queries attend their un-advanced prefix); ``axis_name`` runs the
-    sequence-sharded step (inside a ``shard_map``, slab-sharded cache —
-    the kernel path merges shards by the flash-decoding pmax/psum
-    rule). Overflow follows the append contracts: concrete lengths
-    raise eagerly, traced lengths write nothing while the length still
-    advances. Returns ``(cache, out (B, H, n, d_v))``.
+    ``q (B, H, n, d)``: n = 1 is the classic per-token step; n > 1 is
+    a VERIFY-k step (speculative decoding's fused verify): the n new
+    rows land at consecutive positions and query row ``j`` attends the
+    prefix plus appended rows ``<= j`` — bit-identical per row to n
+    sequential single-token steps. The kernel covers
+    ``n <= the K split`` (:func:`decode_kernel_eligible`); wider calls
+    take the XLA formulation.
+
+    Per-slot caches (:func:`init_slot_cache`) take ``slot_mask``
+    exactly as :func:`append_kv_slots` does (masked slots append
+    nothing and their queries attend their un-advanced prefix) and —
+    verify-k — ``counts (B,) int32``: per slot, how many of the n rows
+    are REAL (a mixed spec/non-spec batch rides one program; a slot
+    with ``counts[i] = c`` appends rows ``0..c-1`` and its query rows
+    ``>= c`` produce don't-care outputs the caller discards — they
+    attend at their nominal positions over never-written (zero)
+    columns). ``axis_name`` runs the sequence-sharded step (inside a
+    ``shard_map``, slab-sharded cache — the kernel path merges shards
+    by the flash-decoding pmax/psum rule; n == 1 only). Overflow
+    follows the append contracts: concrete lengths raise eagerly,
+    traced lengths write nothing while the length still advances.
+    Returns ``(cache, out (B, H, n, d_v))``.
     """
     n = q.shape[-2]
-    impl = _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant)
+    impl = _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
+                                axis_name=axis_name)
     paged = isinstance(cache, PagedDecodeCache)
     per_slot = cache.length.ndim == 1
     if paged and axis_name is not None:
@@ -944,14 +1108,24 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
         raise ValueError('slot_mask needs a per-slot cache '
                          '(init_slot_cache); scalar-length caches share '
                          'one sequence clock')
+    if counts is not None and not per_slot:
+        raise ValueError('counts needs a per-slot cache '
+                         '(init_slot_cache); scalar-length caches '
+                         'append all n rows — slice k_new/v_new '
+                         'instead')
+    if counts is not None and axis_name is not None:
+        raise ValueError('per-slot counts are a local serving '
+                         'construct; the sharded step appends whole '
+                         'rows')
 
     if impl == 'xla':
+        before = cache.length
         if axis_name is not None:
             cache = append_kv_sharded(cache, k_new, v_new,
                                       axis_name=axis_name)
         elif per_slot:
             cache = append_kv_slots(cache, k_new, v_new,
-                                    slot_mask=slot_mask)
+                                    slot_mask=slot_mask, counts=counts)
         else:
             cache = append_kv(cache, k_new, v_new)
         attend = cache
@@ -963,6 +1137,17 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
             # the attention read itself; the kernel path avoids it.
             gk, gv = paged_gather(cache)
             attend = DecodeCache(k=gk, v=gv, length=cache.length)
+        if per_slot and counts is not None:
+            # Verify-k masking base: query row j of slot i sits at
+            # position before[i] + j whatever the slot's REAL count —
+            # decode_attention's pos_q = length − n + j convention
+            # needs length = before + n per active slot (the tracked
+            # length advanced only by the real count; padded rows then
+            # attend never-written zero columns — don't-care outputs).
+            active = (jnp.ones(before.shape, bool) if slot_mask is None
+                      else jnp.asarray(slot_mask, bool))
+            attend = attend._replace(
+                length=jnp.where(active, before + n, before))
         out = decode_attention(
             q, attend, scale=scale, window=window,
             alibi_slopes=alibi_slopes, segment_ids=segment_ids,
@@ -974,7 +1159,13 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     )
     b = q.shape[0]
     t_max = cache.t_max
+    nn = None
     if axis_name is not None:
+        if n != 1:
+            raise ValueError(
+                'the sharded kernel step is single-token (its '
+                'flash-decoding merge carries one row per shard) — '
+                "use impl='xla' for sharded verify-k")
         # Sharded slab: the append lands on the owning shard only; the
         # masking bound is the query's GLOBAL position localized to
         # this slab (negative = slab wholly in the future).
@@ -991,48 +1182,51 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
                    else jnp.broadcast_to(cache.length, (b,)))
         active = (jnp.ones((b,), bool) if slot_mask is None
                   else jnp.asarray(slot_mask, bool))
+        eff = (jnp.full((b,), n, jnp.int32) if counts is None
+               else jnp.clip(jnp.asarray(counts, jnp.int32), 0, n))
+        eff = jnp.where(active, eff, 0)
         # Eager overflow raise when the lengths are concrete — same
         # contract (and message shape) as the append ops.
         host_len = _concrete_lengths(lengths)
-        try:
-            host_act = [bool(x) for x in active]
-        except (jax.errors.ConcretizationTypeError, TypeError):
-            host_act = None
-        if host_len is not None and host_act is not None:
-            for i, (cur, act) in enumerate(zip(host_len, host_act)):
-                if act and cur + 1 > t_max:
+        host_eff = _concrete_lengths(eff)
+        if host_len is not None and host_eff is not None:
+            for i, (cur, add) in enumerate(zip(host_len, host_eff)):
+                if add and cur + add > t_max:
                     where = f' on slot {i}' if per_slot else ''
                     raise ValueError(
-                        f'KV-cache overflow{where}: length {cur} + 1 '
-                        f'new position exceeds t_max {t_max} — evict '
-                        f'the slot (reset_slot) or stop the generation '
-                        f'loop')
-        fits = lengths + 1 <= t_max
-        ap = jnp.where(jnp.logical_and(active, fits), lengths, -1)
-        # Active queries sit AT the appended position; frozen slots'
-        # queries attend their un-advanced prefix (decode_attention's
-        # semantics after a slot-masked append). An overflowing append
-        # writes nothing but the query still masks at its advanced
-        # position — matching the traced-guard contract bit for bit.
-        vt = jnp.where(active, lengths, lengths - 1)
-        adv = active.astype(cache.length.dtype)
-        new_length = (cache.length + adv if per_slot
-                      else cache.length + 1)
+                        f'KV-cache overflow{where}: length {cur} + '
+                        f'{add} new position(s) exceeds t_max {t_max} '
+                        f'— evict the slot (reset_slot) or stop the '
+                        f'generation loop')
+        fits = lengths + eff <= t_max
+        writes = jnp.logical_and(jnp.logical_and(active, fits), eff > 0)
+        ap = jnp.where(writes, lengths, -1)
+        nn = jnp.where(writes, eff, 0)
+        # Active queries' row 0 sits AT the first appended position
+        # (row j at position + j); frozen slots' queries attend their
+        # un-advanced prefix (decode_attention's semantics after a
+        # slot-masked append). An overflowing append writes nothing
+        # but the queries still mask at their advanced positions —
+        # matching the traced-guard contract bit for bit.
+        vt = jnp.where(active, lengths, lengths - n)
+        new_length = (cache.length + eff if per_slot
+                      else cache.length + n)
 
     if paged:
         # Same fused program, page-table-redirected DMA: the BlockSpec
         # index maps read the prefetched page-table row, aliasing still
-        # writes only the single append page (ops/pallas_decode.py).
+        # writes only the append page(s) (ops/pallas_decode.py).
         out, new_k, new_v, _, _ = flash_decode(
             q, k_new, v_new, cache.k_pool, cache.v_pool, vt, ap,
-            page_table=cache.page_table, scale=scale, window=window,
-            alibi_slopes=alibi_slopes, interpret=interpret)
+            n_new=nn, page_table=cache.page_table, scale=scale,
+            window=window, alibi_slopes=alibi_slopes,
+            interpret=interpret)
         return PagedDecodeCache(k_pool=new_k, v_pool=new_v,
                                 page_table=cache.page_table,
                                 length=new_length), out
 
     res = flash_decode(
-        q, k_new, v_new, cache.k, cache.v, vt, ap,
+        q, k_new, v_new, cache.k, cache.v, vt, ap, n_new=nn,
         k_q=cache.k_q if qk_quant == 'int8' else None,
         k_scale=cache.k_scale if qk_quant == 'int8' else None,
         scale=scale, window=window, alibi_slopes=alibi_slopes,
@@ -1041,19 +1235,26 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     out, new_k, new_v, new_kq, new_ks = res
     if cache.k_q is not None and new_kq is None:
         # A non-int8 step on a mirror-carrying cache still has to keep
-        # the mirror exact — quantize the appended row the append-op
+        # the mirror exact — quantize the appended row(s) the append-op
         # way (rare path: mirrors exist for int8 decoding).
         from distributed_dot_product_tpu.ops.pallas_attention import (
             _quantize_rows,
         )
         bb, h_kv, _, d = cache.k.shape
         ki8, ks = _quantize_rows(k_new.astype(cache.k.dtype), bb * h_kv,
-                                 1, d)
+                                 n, d)
+        nvec = nn if nn is not None else jnp.where(ap >= 0, n, 0)
         g = jnp.arange(t_max)[None, :]
-        hit = (g == ap[:, None])[:, None, :, None]
-        new_kq = jnp.where(hit, ki8.reshape(bb, h_kv, 1, d), cache.k_q)
-        new_ks = jnp.where(hit, ks.reshape(bb, h_kv, 1, 1),
-                           cache.k_scale)
+        hit = jnp.logical_and(
+            jnp.logical_and(g >= ap[:, None], ap[:, None] >= 0),
+            g < ap[:, None] + nvec[:, None])[:, None, :, None]
+        src = jnp.clip(g - ap[:, None], 0, n - 1)[:, None, :, None]
+        new_kq = jnp.where(
+            hit, jnp.take_along_axis(ki8.reshape(bb, h_kv, n, d),
+                                     src, axis=-2), cache.k_q)
+        new_ks = jnp.where(
+            hit, jnp.take_along_axis(ks.reshape(bb, h_kv, n, 1),
+                                     src, axis=-2), cache.k_scale)
     elif cache.k_q is not None:
         pass                                    # kernel maintained it
     else:
@@ -1179,12 +1380,49 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
             expect_donation=True, donate_argnums=(1,), min_donated=2)
 
+    def step_verify_slab():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        b, h, t, d, k = 2, 2, 32, 8, 3
+        cache = init_slot_cache(b, h, t, d, dtype=jnp.bfloat16)
+        cache = cache._replace(length=jnp.array([5, 9], jnp.int32))
+        q = jnp.zeros((b, h, k, d), jnp.bfloat16)
+        counts = jnp.array([3, 1], jnp.int32)   # mixed spec/non-spec
+        return TraceSpec(
+            name='decode.step_verify_slab',
+            fn=partial(decode_step, impl='kernel', interpret=True,
+                       counts=counts),
+            args=(q, cache, q, q),
+            cache_in=lambda a: [a[1].k, a[1].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    def step_verify_paged():
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        cache, _ = _paged_args()
+        k = 3
+        q = jnp.zeros((2, 2, k, 8), jnp.bfloat16)
+        counts = jnp.array([3, 2], jnp.int32)
+        return TraceSpec(
+            name='decode.step_verify_paged',
+            fn=partial(decode_step, impl='kernel', interpret=True,
+                       counts=counts),
+            args=(q, cache, q, q),
+            cache_in=lambda a: [a[1].k_pool, a[1].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
     return {
         'decode.step_xla_slots': step_xla_slots,
         'decode.step_kernel_int8': step_kernel_int8,
         'decode.step_sharded': step_sharded,
         'decode.step_paged_xla': step_paged_xla,
         'decode.step_paged_kernel': step_paged_kernel,
+        'decode.step_verify_slab': step_verify_slab,
+        'decode.step_verify_paged': step_verify_paged,
     }
 
 

@@ -30,6 +30,9 @@ TPU-first notes:
   so sampling loops (greedy here; any sampler outside) stay trivial.
 """
 
+import dataclasses
+import warnings
+from collections import OrderedDict
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -237,10 +240,89 @@ class TransformerLM(nn.Module):
         return caches, self._head(x)
 
 
+# Compiled generation programs keyed by (module, donate, batch,
+# prompt_len, t_max) — every shape that forces a retrace is IN the key,
+# so each cached entry traces exactly once and repeated
+# greedy_generate calls reuse the compiled pair instead of rebuilding
+# fresh jit closures per invocation (the round-8 recompile finding:
+# every call paid a full prefill + step trace). BOUNDED like
+# models/attention.py's _DECODE_STEPS: LRU past the cap — eviction
+# costs a re-trace on revisit, never correctness.
+_GENERATE_PROGRAMS = OrderedDict()
+_GENERATE_PROGRAMS_CAP = 8
+_GENERATE_WARNED_UNHASHABLE = False
+
+
+def _build_generate_programs(model, donate):
+    from distributed_dot_product_tpu.analysis.retrace import (
+        watch_traces,
+    )
+
+    def prefill_fn(p, tok, c):
+        return model.apply(p, tok, c, method='prefill')
+
+    def step_fn(p, tok, c):
+        c, logits = model.apply(p, tok, c, method='decode')
+        return c, jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+
+    # Budget 2: the real trace plus one weak-type/registry respin —
+    # shapes live in the cache key, so a retrace past that is a storm.
+    prefill = jax.jit(
+        watch_traces(prefill_fn, 'lm.generate_prefill', budget=2))
+    step = jax.jit(
+        watch_traces(step_fn, 'lm.generate_step', budget=2),
+        donate_argnums=(2,) if donate else ())
+    return prefill, step
+
+
+def _freeze_for_key(x):
+    """Recursively turn dict/list values into hashable tuples so a
+    module carrying ``attn_kwargs={'window': 128}`` — the repo's normal
+    construction idiom — still keys the program cache. Array-valued
+    fields stay unhashable and take the warn-once fallback."""
+    if isinstance(x, dict):
+        return tuple(sorted((k, _freeze_for_key(v)) for k, v in x.items()))
+    if isinstance(x, (list, tuple)):
+        return tuple(_freeze_for_key(v) for v in x)
+    return x
+
+
+def _generate_programs(model, donate, b, n, t_max):
+    global _GENERATE_WARNED_UNHASHABLE
+    key = (type(model),
+           tuple((f.name, _freeze_for_key(getattr(model, f.name)))
+                 for f in dataclasses.fields(model)),
+           donate, b, n, t_max)
+    try:
+        entry = _GENERATE_PROGRAMS.get(key)
+        if entry is None:
+            entry = _GENERATE_PROGRAMS[key] = \
+                _build_generate_programs(model, donate)
+        else:
+            _GENERATE_PROGRAMS.move_to_end(key)
+        while len(_GENERATE_PROGRAMS) > _GENERATE_PROGRAMS_CAP:
+            _GENERATE_PROGRAMS.popitem(last=False)
+    except TypeError:   # unhashable module field (e.g. array slopes)
+        if not _GENERATE_WARNED_UNHASHABLE:
+            _GENERATE_WARNED_UNHASHABLE = True
+            warnings.warn(
+                'greedy_generate: model is unhashable (an array-valued '
+                'field such as alibi_slopes?) — the compiled '
+                'prefill/step pair cannot be cached and EVERY call '
+                're-traces both. Use hashable fields (e.g. a tuple of '
+                'slopes).', stacklevel=3)
+        entry = _build_generate_programs(model, donate)
+    return entry
+
+
 def greedy_generate(model, params, prompt, steps, t_max, donate=True):
     """Greedy sampling through the KV caches: prefill the prompt, then
     ``steps`` jitted decode steps (cache donated so appends write in
     place — see models/decode.py). Returns ``(B, steps) int32``.
+
+    The compiled prefill/step pair is cached per (model, shapes) —
+    LRU-bounded, retrace-budgeted — so calling this in a loop traces
+    once, not per call.
 
     A deliberately simple reference sampler (argmax); the
     ``prefill``/``decode`` surface returns full logits, so temperature /
@@ -257,17 +339,10 @@ def greedy_generate(model, params, prompt, steps, t_max, donate=True):
         raise ValueError(f'prompt {n} + steps {steps} needs '
                          f'{n + steps - 1} cache rows but t_max is '
                          f'{t_max}')
+    prefill, step = _generate_programs(model, donate, b, n, t_max)
     caches = model.make_decode_caches(b, t_max)
-    caches, logits = jax.jit(
-        lambda p, tok, c: model.apply(p, tok, c, method='prefill')
-    )(params, prompt, caches)
+    caches, logits = prefill(params, prompt, caches)
     tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
-
-    def step(p, tok, c):
-        c, logits = model.apply(p, tok, c, method='decode')
-        return c, jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
-
-    step = jax.jit(step, donate_argnums=(2,) if donate else ())
     out = [tok]
     for _ in range(steps - 1):
         caches, tok = step(params, tok, caches)

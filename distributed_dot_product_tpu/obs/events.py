@@ -70,6 +70,15 @@ EVENT_SCHEMA = {
     # Paged pool ran dry under this slot mid-stream: slot freed, request
     # requeued (True) or terminally evicted CACHE_EXHAUSTED (False).
     'serve.preempt': ('request_id', 'slot', 'requeued'),
+    # -- speculative decoding (serve/scheduler.py spec ticks) ----------
+    # A proposer guessed `proposed` continuation tokens for the slot
+    # this tick (`proposer` names which: ngram/draft/custom).
+    'spec.propose': ('request_id', 'slot', 'proposed'),
+    # One fused verify step resolved the guesses: `accepted` of the
+    # `proposed` survived greedy verification; accepted + 1 tokens
+    # committed (the free token) unless a terminal condition truncated
+    # the commit — the serve.decode events alongside carry the tokens.
+    'spec.verify': ('request_id', 'slot', 'proposed', 'accepted'),
     # -- training driver (train_loop.py via utils.tracing.log_step) ----
     'train.step': ('step', 'loss'),
     'train.bad_step': ('step',),

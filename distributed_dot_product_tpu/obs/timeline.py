@@ -31,7 +31,8 @@ __all__ = ['Timeline', 'timeline', 'reconstruct']
 _TERMINAL = {'serve.retire', 'serve.reject'}
 # Events legal only while the request holds a slot.
 _RUNNING_ONLY = {'serve.prefill', 'serve.decode', 'serve.evict',
-                 'serve.quarantine', 'serve.preempt'}
+                 'serve.quarantine', 'serve.preempt',
+                 'spec.propose', 'spec.verify'}
 
 
 @dataclasses.dataclass
@@ -53,6 +54,14 @@ class Timeline:
     quarantines: int = 0
     preempts: int = 0
     tokens: int = 0
+    # Speculative-decoding arcs (spec.propose / spec.verify): how many
+    # verify steps served this request, how many tokens its proposers
+    # guessed and how many of those greedy verification accepted — the
+    # amortization record (committed tokens per verify step =
+    # accepted/spec_steps + 1), reconstructed from the log alone.
+    spec_steps: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def phases(self):
         """Compact ``{phase: seconds}`` view for printing."""
@@ -93,6 +102,10 @@ def _validate(tl: Timeline):
                     tl.ttft = rec['ttft']
                 if rec.get('gap') is not None:
                     tl.token_gaps.append(rec['gap'])
+            elif ev == 'spec.verify':
+                tl.spec_steps += 1
+                tl.spec_proposed += rec.get('proposed', 0)
+                tl.spec_accepted += rec.get('accepted', 0)
             elif ev == 'serve.quarantine':
                 tl.quarantines += 1
                 # Quarantine frees the slot: a requeued request must be
@@ -138,7 +151,9 @@ def reconstruct(source) -> Dict[str, Timeline]:
     per_request: Dict[str, List[dict]] = {}
     for rec in read_events(source):
         rid = rec.get('request_id')
-        if rid is not None and rec.get('event', '').startswith('serve.'):
+        ev = rec.get('event', '')
+        if rid is not None and (ev.startswith('serve.')
+                                or ev.startswith('spec.')):
             per_request.setdefault(rid, []).append(rec)
     return {rid: _validate(Timeline(request_id=rid, events=evs))
             for rid, evs in per_request.items()}

@@ -1,6 +1,11 @@
 # -*- coding: utf-8 -*-
 """
-Fused single-token KV-cache decode kernel (the serving hot path).
+Fused KV-cache decode kernel (the serving hot path): one token per
+slot per step, or — VERIFY-k — up to k new rows per slot in one
+program, the fused verify step of draft-verify speculative decoding
+(Leviathan et al.; each of the k query rows keeps its own online-
+softmax state and masks the intra-step causal triangle among the k
+appended rows).
 
 ``models/decode.py``'s XLA formulation runs a decode step as two ops —
 ``append_kv_slots`` (a masked gather over the whole ``t_max`` axis) and
@@ -99,11 +104,24 @@ def _pad_rows(x, mult):
     return jnp.pad(x, pad)
 
 
-def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
-                        has_alibi, paged=False):
+def _make_decode_kernel(bk, ns, n, group, g_pad, h_kv, window,
+                        quantized, has_alibi, paged=False):
     """Kernel body; refs are ordered to match ``flash_decode``'s spec
     list below. Grid = (B·H_kv, ns) with the K split innermost; the
     running softmax state lives in scratch across splits.
+
+    VERIFY-k: ``n`` is the static number of new rows per step (1 =
+    classic decode). The per-(b, h_kv) query block carries ``n · group``
+    rows laid out new-row-major (row ``j·group + g`` is query head ``g``
+    of new row ``j``), so per-row masking reads the row's intra-step
+    index ``j = row // group`` — new row ``j`` attends columns
+    ``<= vt + j``, which is exactly the intra-step causal triangle among
+    the k new rows plus the shared prefix. A third scalar-prefetch
+    vector ``nn`` carries the PER-SLOT number of rows actually appended
+    (mixed spec/non-spec batches: a non-spec slot rides the same program
+    with ``nn = 1``); rows ``m >= nn`` are never substituted into scores
+    or written back, and query rows past a slot's real count only ever
+    produce don't-care outputs the caller discards.
 
     The PAGED variant is the same body verbatim: grid step ``ki`` is the
     LOGICAL page ordinal, so every mask/score/append computation below
@@ -112,17 +130,25 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
     live in ``flash_decode``. The page-table prefetch ref is consumed
     by the index maps alone."""
 
-    def kernel_body(vt_ref, ap_ref, *refs):
+    def kernel_body(vt_ref, ap_ref, nn_ref, *refs):
         b = pl.program_id(0)
         ki = pl.program_id(1)
         br = b // h_kv                          # cache batch row
-        vt = vt_ref[br]                         # last valid local column
+        vt = vt_ref[br]                         # first new row's column
         ap = ap_ref[br]                         # append column (−1 none)
-        # The block the append write targets — must equal the k/v OUT
+        nn = nn_ref[br]                         # rows appended (0..n)
+        # The block(s) the append write targets — must equal the k/v OUT
         # BlockSpec index maps exactly (ap < 0 ⇒ a copy-through of
         # block 0, because Pallas writes every output block back and an
         # unwritten one would clobber the aliased cache with garbage).
-        wsplit = jnp.where(ap >= 0, jnp.clip(ap // bk, 0, ns - 1), 0)
+        # n rows span at most TWO consecutive blocks (n <= bk is
+        # enforced by flash_decode): the write index map clamps ki into
+        # [wfirst, wlast], so the kernel writes the ref exactly when ki
+        # lands on each physical block, right before Pallas flushes it.
+        wfirst = jnp.where(ap >= 0, jnp.clip(ap // bk, 0, ns - 1), 0)
+        wlast = jnp.where(
+            ap >= 0,
+            jnp.clip((ap + jnp.maximum(nn, 1) - 1) // bk, 0, ns - 1), 0)
 
         it = iter(refs)
         q_ref = next(it)
@@ -148,9 +174,12 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
             l_s[:] = jnp.zeros_like(l_s)
             acc_s[:] = jnp.zeros_like(acc_s)
 
-        # Block-skip: no valid column in this split (strictly past the
-        # slot's fill, or — with a window — wholly before the lookback).
-        run = ki * bk <= vt
+        # Block-skip: no valid column in this split — strictly past the
+        # LAST new row's fill (row n−1 attends up to vt + n − 1), or —
+        # with a window — wholly before row 0's lookback (later rows
+        # look back from later positions, so row 0's bound is the
+        # earliest column any row can attend).
+        run = ki * bk <= vt + (n - 1)
         if window is not None:
             run = jnp.logical_and(run, ki * bk + bk - 1 > vt - window)
 
@@ -158,6 +187,11 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
         def _():
             cols = (ki * bk
                     + jax.lax.broadcasted_iota(jnp.int32, (g_pad, bk), 1))
+            # Intra-step row index: row j·group + g is new row j's head
+            # g, so j = row // group (padded rows land past n — fully
+            # masked below).
+            jrow = (jax.lax.broadcasted_iota(jnp.int32, (g_pad, bk), 0)
+                    // group)
             if quantized:
                 # ks_ref blocks are (1, BK): the K-row scales already
                 # laid out as a row vector (the training kernels'
@@ -177,10 +211,14 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
                 s_new = jax.lax.dot_general(
                     q_ref[0], kn_ref[0], (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            # The appended row's score replaces whatever the buffer held
-            # at its column (ap == −1 matches no column: cols are ≥ 0).
-            s = jnp.where(cols == ap, s_new, s)
-            rel = cols - vt                       # ≤ 0 on valid columns
+            # The appended rows' scores replace whatever the buffer held
+            # at their columns (new row m lands at ap + m; the nn guard
+            # keeps rows a mixed-batch slot did NOT append from leaking
+            # in; ap == −1 matches no column: cols are ≥ 0 and nn is 0).
+            for m in range(n):
+                sel = jnp.logical_and(cols == ap + m, m < nn)
+                s = jnp.where(sel, s_new[:, m:m + 1], s)
+            rel = cols - vt - jrow                # ≤ 0 on valid columns
             if alibi_ref is not None:
                 s = s + alibi_ref[0] * rel.astype(jnp.float32)
             masked = rel > 0
@@ -191,7 +229,10 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
             rows_v = (ki * bk
                       + jax.lax.broadcasted_iota(
                           jnp.int32, v_ref.shape[1:], 0))
-            v = jnp.where(rows_v == ap, vn_ref[0], v_ref[0])
+            v = v_ref[0]
+            for m in range(n):
+                sel = jnp.logical_and(rows_v == ap + m, m < nn)
+                v = jnp.where(sel, vn_ref[0, m], v)
 
             m_prev = m_s[:]
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -203,27 +244,42 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-        # In-place append: substitute the new row into the resident
-        # block and write it back — the ONLY cache block written this
-        # step (every other aliased block keeps its bits untouched).
-        @pl.when(ki == wsplit)
+        # In-place append: substitute the new rows into the resident
+        # block(s) and write them back — the ONLY cache blocks written
+        # this step (every other aliased block keeps its bits
+        # untouched). With n > 1 the rows may straddle one block
+        # boundary; the write index map clamps ki into [wfirst, wlast],
+        # so writing at both gives each physical block its substituted
+        # content before Pallas flushes it.
+        @pl.when(jnp.logical_or(ki == wfirst, ki == wlast))
         def _():
             rows_k = (ki * bk
                       + jax.lax.broadcasted_iota(
                           jnp.int32, k_ref.shape[1:], 0))
-            ko_ref[0] = jnp.where(rows_k == ap, kn_ref[0], k_ref[0])
             rows_v = (ki * bk
                       + jax.lax.broadcasted_iota(
                           jnp.int32, v_ref.shape[1:], 0))
-            vo_ref[0] = jnp.where(rows_v == ap, vn_ref[0], v_ref[0])
+            ko, vo = k_ref[0], v_ref[0]
+            for m in range(n):
+                ink = jnp.logical_and(rows_k == ap + m, m < nn)
+                inv = jnp.logical_and(rows_v == ap + m, m < nn)
+                ko = jnp.where(ink, kn_ref[0, m], ko)
+                vo = jnp.where(inv, vn_ref[0, m], vo)
+            ko_ref[0] = ko
+            vo_ref[0] = vo
             if quantized:
-                kqo_ref[0] = jnp.where(rows_k == ap, kqn_ref[0],
-                                       kq_ref[0])
                 cols_s = (ki * bk
                           + jax.lax.broadcasted_iota(
                               jnp.int32, ks_ref.shape[1:], 1))
-                kso_ref[0] = jnp.where(cols_s == ap, ksn_ref[0, 0, 0],
-                                       ks_ref[0])
+                kqo, kso = kq_ref[0], ks_ref[0]
+                for m in range(n):
+                    sel = jnp.logical_and(rows_k == ap + m, m < nn)
+                    kqo = jnp.where(sel, kqn_ref[0, m], kqo)
+                    kso = jnp.where(
+                        jnp.logical_and(cols_s == ap + m, m < nn),
+                        ksn_ref[0, 0, m], kso)
+                kqo_ref[0] = kqo
+                kso_ref[0] = kso
 
         @pl.when(ki == ns - 1)
         def _():
@@ -234,33 +290,51 @@ def _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
     if not paged:
         return kernel_body
 
-    def kernel_paged(vt_ref, ap_ref, pt_ref, *refs):
+    def kernel_paged(vt_ref, ap_ref, nn_ref, pt_ref, *refs):
         del pt_ref                      # index maps' operand, not ours
-        kernel_body(vt_ref, ap_ref, *refs)
+        kernel_body(vt_ref, ap_ref, nn_ref, *refs)
 
     return kernel_paged
 
 
 def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
-                 *, page_table=None, k_q=None, k_scale=None, scale=None,
-                 window=None, alibi_slopes=None, qk_quant=None,
-                 interpret=None, block_k=None, partials=False):
+                 *, n_new=None, page_table=None, k_q=None, k_scale=None,
+                 scale=None, window=None, alibi_slopes=None,
+                 qk_quant=None, interpret=None, block_k=None,
+                 partials=False):
     """One fused decode step: in-place cache append + masked online-
-    softmax attention of each slot's query against its own prefix.
+    softmax attention of each slot's queries against its own prefix.
 
-    ``q (B, H, 1, d)``; ``k_new/v_new (B, H_kv, 1, d·)`` the step's new
-    row per slot; ``cache_k/cache_v (B, H_kv, t_max, d·)`` the (static-
+    ``q (B, H, k, d)``; ``k_new/v_new (B, H_kv, k, d·)`` the step's new
+    rows per slot; ``cache_k/cache_v (B, H_kv, t_max, d·)`` the (static-
     shape) cache buffers, returned UPDATED — aliased in place on TPU,
     so jit callers should donate them. GQA is native: each group of
     ``H/H_kv`` query heads attends its cache head.
 
+    VERIFY-k: ``k = q.shape[-2]`` may exceed 1 (draft-verify decoding's
+    fused verify step): the k new rows append at consecutive columns
+    ``append_at .. append_at + k − 1`` and query row ``j`` attends
+    columns ``<= valid_to + j`` — the shared prefix plus the intra-step
+    causal triangle among the new rows, each row with its own online-
+    softmax state. ``k`` must not exceed the K split (the rows then
+    span at most two blocks — both written in place, everything else
+    untouched); the int8 mirror stays single-token (``qk_quant='int8'``
+    requires ``k == 1`` — the XLA path covers quantized verify-k).
+    ``n_new (B,) int32`` (optional): per-slot count of rows ACTUALLY
+    appended (mixed spec/non-spec batches — a slot with ``n_new = 1``
+    rides the verify program as a classic decode step; rows past a
+    slot's count are neither appended nor scored into it, and its query
+    rows past the count produce don't-care outputs). Default: k rows
+    wherever ``append_at >= 0``.
+
     ``valid_to (B,) int32``: per slot, the highest cache column its
-    query attends (its own global position, localized by the caller for
-    sharded slabs; −1 or less = fully masked row → zero output).
-    ``append_at (B,) int32``: the local column where ``k_new/v_new``
-    land, or −1 to append nothing (inactive slot / non-owning shard).
-    When ``append_at[i] >= 0`` it must equal ``valid_to[i]`` (standard
-    causal decode ordering: the query attends the row it appends).
+    FIRST query row attends (its own global position, localized by the
+    caller for sharded slabs; −1 or less = fully masked row → zero
+    output). ``append_at (B,) int32``: the local column where
+    ``k_new/v_new`` row 0 lands, or −1 to append nothing (inactive
+    slot / non-owning shard). When ``append_at[i] >= 0`` it must equal
+    ``valid_to[i]`` (standard causal decode ordering: each query row
+    attends the rows at and before its own append column).
 
     ``qk_quant='int8'`` requires the cache's append-time mirror
     (``k_q``/``k_scale``) and scores s8×s8→s32 with in-kernel
@@ -281,19 +355,20 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     mirror is not carried on the pool (XLA path covers paged int8).
 
     Returns ``(out, cache_k, cache_v, k_q, k_scale)`` with
-    ``out (B, H, 1, dv)`` in ``cache_v.dtype`` — or, with
+    ``out (B, H, k, dv)`` in ``cache_v.dtype`` — or, with
     ``partials=True``, ``((num, m, l), cache_k, cache_v, k_q, k_scale)``
-    where ``num (B, H, 1, dv) f32`` is the un-normalized context and
-    ``m/l (B, H, 1, 1)`` the base-2 running max / denominator, for the
-    flash-decoding cross-shard merge (pmax the maxes, rescale, psum).
+    where ``num (B, H, k, dv) f32`` is the un-normalized context and
+    ``m/l (B, H, k, 1)`` the base-2 running max / denominator per query
+    row, for the flash-decoding cross-shard merge (pmax the maxes,
+    rescale, psum).
     """
     b, h, n, d = q.shape
     h_kv = cache_k.shape[1]
     dv = cache_v.shape[-1]
     paged = page_table is not None
-    if n != 1:
-        raise ValueError(f'flash_decode is a single-token kernel; got '
-                         f'{n} query rows (use prefill for chunks)')
+    if n < 1:
+        raise ValueError(f'flash_decode needs at least one query row, '
+                         f'got {n}')
     if h % h_kv:
         raise ValueError(f'query heads {h} must be a multiple of cache '
                          f'kv heads {h_kv}')
@@ -301,6 +376,11 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     if qk_quant not in (None, 'int8'):
         raise ValueError(f"qk_quant must be None or 'int8', "
                          f'got {qk_quant!r}')
+    if quantized and n != 1:
+        raise ValueError(
+            f"qk_quant='int8' is single-token in the fused kernel "
+            f'(got {n} rows) — the XLA decode path covers quantized '
+            f'verify-k')
     if quantized and paged:
         raise ValueError('the paged pool carries no int8 mirror — use '
                          "the XLA decode path for qk_quant='int8'")
@@ -323,20 +403,30 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
                 f'divide it); use the XLA decode path for this cache '
                 f'shape')
         ns = t_max // bk
+    if n > bk:
+        raise ValueError(
+            f'verify-k width {n} exceeds the K split {bk} '
+            f'({"page size" if paged else "block"}) — k rows must span '
+            f'at most two blocks; use the XLA decode path for wider '
+            f'verify steps')
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     group = h // h_kv
     nb = b * h_kv
 
-    # Query rows grouped per cache head, padded to the sublane multiple
-    # of their kernel dtype; padded rows are sliced off the output.
-    qg = q.reshape(nb, group, d)
+    # Query rows grouped per cache head, NEW-ROW-major (row j·group + g
+    # = new row j, query head g — the layout the kernel's per-row
+    # intra-step mask assumes), padded to the sublane multiple of their
+    # kernel dtype; padded rows are sliced off the output.
+    qg = jnp.swapaxes(q.reshape(b, h_kv, group, n, d), 2, 3
+                      ).reshape(nb, n * group, d)
+    rows = n * group
     sub = 32 if quantized else (16 if cache_k.dtype == jnp.bfloat16
                                 else 8)
-    g_pad = -(-group // sub) * sub
+    g_pad = -(-rows // sub) * sub
     if quantized:
-        qi, sq = _quantize_rows(qg, nb, group, d)
+        qi, sq = _quantize_rows(qg, nb, rows, d)
         qf = _pad_rows(qi, sub)
         sqf = _pad_rows(sq * (scale * _LOG2E), sub)
         kni, kns = _quantize_rows(
@@ -346,8 +436,8 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
             (qg.astype(jnp.float32) * (scale * _LOG2E)
              ).astype(cache_k.dtype), sub)
 
-    knf = k_new.astype(cache_k.dtype).reshape(nb, 1, d)
-    vnf = v_new.astype(cache_v.dtype).reshape(nb, 1, dv)
+    knf = k_new.astype(cache_k.dtype).reshape(nb, n, d)
+    vnf = v_new.astype(cache_v.dtype).reshape(nb, n, dv)
     if paged:
         # Pool flattening mirrors the slab's (B, H_kv) fold: pool page
         # p's head hh lives at flat row p·H_kv + hh, so one BlockSpec
@@ -369,67 +459,83 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         vf = cache_v.reshape(nb, t_max, dv)
     valid_to = jnp.asarray(valid_to, jnp.int32)
     append_at = jnp.asarray(append_at, jnp.int32)
+    # Per-slot appended-row count: callers without mixed batches get
+    # the full k wherever an append happens at all.
+    if n_new is None:
+        nnv = jnp.where(append_at >= 0, n, 0).astype(jnp.int32)
+    else:
+        nnv = jnp.asarray(n_new, jnp.int32)
 
     def const_idx(bi, ki, *rs):
         return (bi, 0, 0)
 
     def _stream_blk(bi, ki, vt):
-        # Never DMA past a slot's last useful block: beyond-fill splits
-        # alias the resident block (skipped in-kernel), so a half-empty
-        # slot streams half the bytes.
-        last = jnp.clip(vt[bi // h_kv] // bk, 0, ns - 1)
+        # Never DMA past a slot's last useful block (the LAST new row
+        # attends up to vt + n − 1): beyond-fill splits alias the
+        # resident block (skipped in-kernel), so a half-empty slot
+        # streams half the bytes.
+        last = jnp.clip((vt[bi // h_kv] + (n - 1)) // bk, 0, ns - 1)
         return jnp.minimum(ki, last)
 
-    def _write_blk(bi, ap):
-        a = ap[bi // h_kv]
-        return jnp.where(a >= 0, jnp.clip(a // bk, 0, ns - 1), 0)
+    def _write_blk(bi, ki, ap, nn):
+        # The k appended rows span blocks [first, last] (at most two,
+        # n <= bk); clamping ki into the span walks the write ref over
+        # each physical block exactly when the kernel body writes it.
+        br = bi // h_kv
+        a = ap[br]
+        first = jnp.clip(a // bk, 0, ns - 1)
+        last = jnp.clip((a + jnp.maximum(nn[br], 1) - 1) // bk,
+                        0, ns - 1)
+        return jnp.where(a >= 0, jnp.clip(ki, first, last), 0)
 
     if paged:
         # The tentpole redirect: the index map translates the LOGICAL
         # block ordinal through the prefetched page-table row instead
         # of using it as the physical block — the gather that makes
         # paging nearly free (same DMA skip, same aliasing).
-        def stream_idx(bi, ki, vt, ap, pt):
+        def stream_idx(bi, ki, vt, ap, nn, pt):
             blk = _stream_blk(bi, ki, vt)
             return (pt[(bi // h_kv) * ns + blk] * h_kv + bi % h_kv,
                     0, 0)
 
-        def write_idx(bi, ki, vt, ap, pt):
+        def write_idx(bi, ki, vt, ap, nn, pt):
             # Appending nothing → write-back lands on the sink page,
-            # never on a page some other slot is appending into.
+            # never on a page some other slot is appending into. (The
+            # prefetched table is pre-clamped: unallocated entries
+            # already point at the sink.)
             br = bi // h_kv
             a = ap[br]
-            blk = jnp.clip(a // bk, 0, ns - 1)
+            blk = _write_blk(bi, ki, ap, nn)
             page = jnp.where(a >= 0, pt[br * ns + blk], sink)
             return (page * h_kv + bi % h_kv, 0, 0)
     else:
-        def stream_idx(bi, ki, vt, ap):
+        def stream_idx(bi, ki, vt, ap, nn):
             return (bi, _stream_blk(bi, ki, vt), 0)
 
-        def write_idx(bi, ki, vt, ap):
-            return (bi, _write_blk(bi, ap), 0)
+        def write_idx(bi, ki, vt, ap, nn):
+            return (bi, _write_blk(bi, ki, ap, nn), 0)
 
     # The int8 scale mirror rides as a (nb, 1, t_max) ROW vector (a
     # size-1-axis reshape — a bitcast, not a transpose), blocked on the
     # LAST axis, so the kernel consumes (1, BK) scale rows directly.
-    def stream_idx_row(bi, ki, vt, ap):
+    def stream_idx_row(bi, ki, vt, ap, nn):
         return (bi, 0, _stream_blk(bi, ki, vt))
 
-    def write_idx_row(bi, ki, vt, ap):
-        return (bi, 0, _write_blk(bi, ap))
+    def write_idx_row(bi, ki, vt, ap, nn):
+        return (bi, 0, _write_blk(bi, ki, ap, nn))
 
     in_specs = [pl.BlockSpec((1, g_pad, d), const_idx)]
     args = [qf]
     if quantized:
         in_specs.append(pl.BlockSpec((1, g_pad, 1), const_idx))
         args.append(sqf)
-    in_specs.append(pl.BlockSpec((1, 1, d), const_idx))
+    in_specs.append(pl.BlockSpec((1, n, d), const_idx))
     args.append(knf)
     if quantized:
         in_specs += [pl.BlockSpec((1, 1, d), const_idx),
                      pl.BlockSpec((1, 1, 1), const_idx)]
         args += [kni, kns.reshape(nb, 1, 1)]
-    in_specs.append(pl.BlockSpec((1, 1, dv), const_idx))
+    in_specs.append(pl.BlockSpec((1, n, dv), const_idx))
     args.append(vnf)
     # The bf16 K buffer: streamed for scoring in the plain path; in the
     # quantized path scoring reads the mirror instead, so K is fetched
@@ -455,12 +561,14 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     if has_alibi:
         # Per-query-head slopes, pre-folded by log2e (the kernel's
         # logits are in log2 units), laid out (nb, g_pad, 1) so slope
-        # rows align with their grouped query rows.
+        # rows align with their grouped query rows (tiled over the n
+        # new rows — row j·group + g carries head g's slope).
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(
             h_kv, group, 1) * _LOG2E
-        slopes = jnp.broadcast_to(slopes[None], (b, h_kv, group, 1))
+        slopes = jnp.broadcast_to(slopes[None, :, None],
+                                  (b, h_kv, n, group, 1))
         in_specs.append(pl.BlockSpec((1, g_pad, 1), const_idx))
-        args.append(_pad_rows(slopes.reshape(nb, group, 1), sub))
+        args.append(_pad_rows(slopes.reshape(nb, n * group, 1), sub))
 
     out_specs = [
         pl.BlockSpec((1, g_pad, dv), const_idx),   # num
@@ -477,8 +585,9 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         jax.ShapeDtypeStruct(vf.shape, vf.dtype),
     ]
     # +n_prefetch: alias indices count the scalar-prefetch operands
-    # (valid_to, append_at, and — paged — the flattened page table).
-    n_prefetch = 3 if paged else 2
+    # (valid_to, append_at, n_new, and — paged — the flattened page
+    # table).
+    n_prefetch = 4 if paged else 3
     aliases = {n_prefetch + k_in_pos: 3, n_prefetch + v_in_pos: 4}
     if quantized:
         out_specs += [pl.BlockSpec((1, bk, d), write_idx),
@@ -488,10 +597,10 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         aliases[n_prefetch + kq_in_pos] = 5
         aliases[n_prefetch + ks_in_pos] = 6
 
-    kernel = _make_decode_kernel(bk, ns, g_pad, h_kv, window, quantized,
-                                 has_alibi, paged=paged)
-    prefetch = ((valid_to, append_at, ptf) if paged
-                else (valid_to, append_at))
+    kernel = _make_decode_kernel(bk, ns, n, group, g_pad, h_kv, window,
+                                 quantized, has_alibi, paged=paged)
+    prefetch = ((valid_to, append_at, nnv, ptf) if paged
+                else (valid_to, append_at, nnv))
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -515,7 +624,10 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     new_v = new_v.reshape(cache_v.shape)
 
     def head_shape(x):
-        return x[:, :group].reshape(b, h, 1, x.shape[-1])
+        # Rows are new-row-major per kv head: undo the (n, group) fold
+        # back to (B, H, n, ·).
+        x = x[:, :n * group].reshape(b, h_kv, n, group, x.shape[-1])
+        return jnp.swapaxes(x, 2, 3).reshape(b, h, n, x.shape[-1])
 
     num, m, l = head_shape(num), head_shape(m), head_shape(l)
     if partials:

@@ -51,7 +51,8 @@ import jax.numpy as jnp
 from distributed_dot_product_tpu.models.decode import (
     PagePool, append_kv_slots, decode_step, init_paged_cache,
     init_slot_cache, paged_append_rows, paged_copy_attach,
-    paged_reset_slot, reset_slot, slots_all_finite,
+    paged_reset_slot, paged_rollback_slots, reset_slot, rollback_slots,
+    slots_all_finite,
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
@@ -135,6 +136,7 @@ class KernelEngine:
         self.heads = heads
         self.head_dim = head_dim
         self.prefill_chunk = prefill_chunk
+        self.seed = seed
         dim = heads * head_dim
         ks = jax.random.split(jax.random.key(seed), 5)
         scale = 1.0 / np.sqrt(dim)
@@ -201,6 +203,12 @@ class KernelEngine:
             self._reset = jax.jit(
                 watch_traces(reset_slot, 'engine.reset', budget=2),
                 donate_argnums=(0,))
+        # Speculative decoding programs, built LAZILY (a non-spec
+        # engine never pays their traces): one verify program per
+        # width W = k+1 and one rollback program per span, each a
+        # fixed compiled shape under its own retrace budget.
+        self._verifies = {}
+        self._rollbacks = {}
 
     # -- compiled bodies ------------------------------------------------
     def _project(self, tokens):
@@ -228,6 +236,37 @@ class KernelEngine:
         next_tok = jnp.argmax(
             jnp.where(jnp.isfinite(logits), logits, -jnp.inf),
             axis=-1).astype(jnp.int32)
+        return cache, next_tok, finite
+
+    def _verify_impl(self, cache, tokens, counts, active, poison):
+        """Verify-k body (speculative decoding's fused verify):
+        ``tokens (S, W)`` — per slot, row 0 the committed input token
+        and rows 1.. the proposed continuation, ``counts[i]`` of the W
+        rows real (1 = a plain non-spec slot riding the same program).
+        Projections, head reshapes and the logits dot all run PER
+        COLUMN with the exact ``(S, dim)`` shapes of the n=1 program —
+        XLA lowers an (S, dim) and an (S·W, dim) matmul with different
+        accumulation orders, and the committed stream must be the n=1
+        stream bit for bit wherever the math allows it. The fused
+        append+attend step keeps the same per-row identity
+        (models/decode.py: a verify-k step == counts sequential
+        steps)."""
+        w = tokens.shape[1]
+        qs, ks, vs = zip(*(self._project(tokens[:, j])
+                           for j in range(w)))
+        q = jnp.concatenate(qs, axis=2)            # (S, H, W, D)
+        k = jnp.concatenate(ks, axis=2)
+        v = jnp.concatenate(vs, axis=2)
+        cache, out = decode_step(q, cache, k, v, slot_mask=active,
+                                 counts=counts, impl=self.decode_impl)
+        logits = jnp.stack(
+            [out[:, :, j].reshape(self.slots, -1) @ self._wo
+             for j in range(w)], axis=1)           # (S, W, vocab)
+        logits = jnp.where(poison[:, None, None], jnp.nan, logits)
+        finite = slots_all_finite(logits)
+        next_tok = jnp.argmax(
+            jnp.where(jnp.isfinite(logits), logits, -jnp.inf),
+            axis=-1).astype(jnp.int32)             # (S, W)
         return cache, next_tok, finite
 
     def _project_kv(self, tokens):
@@ -307,6 +346,119 @@ class KernelEngine:
                 self.pool.lengths[np.asarray(active, bool)] += 1
             return np.asarray(tok), np.asarray(finite)
 
+    def _verify_program(self, w):
+        """One compiled verify program per width W = k+1, built lazily
+        under its own retrace budget (the width is a compile-time
+        shape; a serving run uses ONE k, so one program — the dict
+        exists for benchmarks sweeping k in-process)."""
+        prog = self._verifies.get(w)
+        if prog is None:
+            from distributed_dot_product_tpu.analysis.retrace import (
+                watch_traces,
+            )
+            prog = self._verifies[w] = jax.jit(
+                watch_traces(self._verify_impl, f'engine.verify_w{w}',
+                             budget=2),
+                donate_argnums=(0,))
+        return prog
+
+    def verify_step(self, tokens, counts, active, poison=None,
+                    request_ids=None):
+        """One fused verify-k step for all slots: ``tokens (S, W)
+        int`` — per ACTIVE slot, ``[input_token, p_1, .., p_c, pad]``
+        with ``counts[i] = c_i + 1`` rows real (1 = plain decode: a
+        mixed spec/non-spec batch rides one program). Returns
+        ``(next_tokens (S, W), finite (S,))``: ``next_tokens[i, j]``
+        is the greedy target token AFTER consuming input row j — the
+        caller accepts the longest prefix with ``p_{j+1} ==
+        next_tokens[i, j]``, commits one extra "free" token, and rolls
+        the cache back to the accepted prefix (:meth:`rollback`).
+        Rows past ``counts[i]`` are don't-care outputs.
+
+        The cache appends ``counts[i]`` rows per active slot (paged
+        engines auto-reserve the pages, raising on exhaustion — the
+        Scheduler reserves through its evict/preempt ladder instead)."""
+        tokens = np.asarray(tokens, np.int32)
+        s, w = tokens.shape
+        if s != self.slots:
+            raise ValueError(f'tokens rows {s} != slots {self.slots}')
+        counts = np.clip(np.asarray(counts, np.int64), 0, w)
+        act = np.asarray(active, bool)
+        poison = (np.zeros(self.slots, bool) if poison is None
+                  else np.asarray(poison, bool))
+        if self.cache_mode == 'paged':
+            for i in np.nonzero(act)[0]:
+                c = int(counts[i])
+                if c and not self.reserve_rows(int(i), c):
+                    raise RuntimeError(
+                        f'page pool exhausted reserving {c} verify '
+                        f'rows for slot {int(i)} '
+                        f'({self.pool.free_pages} pages free) — '
+                        f'retire or evict sequences (the Scheduler '
+                        f'ladder does), or size the pool larger')
+            self._sync_page_table()
+        ids = (tuple(r for r in (request_ids or ()) if r)
+               if obs_spans.enabled() else ())
+        with span('engine.verify_step', requests=ids, width=w):
+            self.cache, tok, finite = self._verify_program(w)(
+                self.cache, jnp.asarray(tokens),
+                jnp.asarray(counts, jnp.int32), jnp.asarray(act),
+                jnp.asarray(poison))
+            if self.cache_mode == 'paged':
+                self.pool.lengths[act] += counts[act]
+            return np.asarray(tok), np.asarray(finite)
+
+    def _rollback_program(self, span_rows):
+        prog = self._rollbacks.get(span_rows)
+        if prog is None:
+            from distributed_dot_product_tpu.analysis.retrace import (
+                watch_traces,
+            )
+            if self.cache_mode == 'paged':
+                def body(cache, lengths):
+                    return paged_rollback_slots(cache, lengths,
+                                                span_rows)
+            else:
+                def body(cache, lengths):
+                    return rollback_slots(cache, lengths,
+                                          span=span_rows)
+            prog = self._rollbacks[span_rows] = jax.jit(
+                watch_traces(body, f'engine.rollback_s{span_rows}',
+                             budget=2),
+                donate_argnums=(0,))
+        return prog
+
+    def rollback(self, lengths):
+        """Acceptance-prefix rollback: truncate each slot to
+        ``lengths[i]`` rows and zero the rejected tail —
+        ``min(current, target)`` semantics, so a past-fill sentinel
+        (e.g. ``np.iinfo(np.int32).max``) leaves a slot untouched and
+        ONE batched call serves a mixed tick. The zeroing is surgical
+        (a span-bounded scatter, not a cache rewrite); spans compile
+        per power-of-two bucket, so a whole serving run uses one or
+        two programs. Paged engines additionally return now-empty tail
+        pages to the pool (refcount--, freed pages zeroed — the alloc
+        invariant) and resync the device page table."""
+        tgt = np.asarray(lengths, np.int64)
+        cur = (self.pool.lengths.astype(np.int64)
+               if self.cache_mode == 'paged'
+               else np.asarray(self.cache.length, np.int64))
+        new = np.minimum(cur, tgt)
+        need = int((cur - new).max()) if cur.size else 0
+        if need == 0:
+            return
+        bucket = 1 << (need - 1).bit_length()
+        with span('engine.rollback', rows=need):
+            self.cache = self._rollback_program(bucket)(
+                self.cache, jnp.asarray(new, jnp.int32))
+        if self.cache_mode == 'paged':
+            freed = []
+            for i in np.nonzero(cur > new)[0]:
+                freed += self.pool.truncate(int(i), int(new[i]))
+            if freed:
+                self._zero_freed(freed)
+            self._sync_page_table()
+
     def prefill(self, slot, tokens, request_id=None):
         """Append one prompt chunk (``len(tokens) <= prefill_chunk``)
         into ``slot``. Pads to the compiled chunk width; padded rows
@@ -357,7 +509,14 @@ class KernelEngine:
             self.cache = self._reset(self.cache, jnp.int32(slot))
 
     def lengths(self):
-        return np.asarray(self.cache.length)
+        # np.array, NOT np.asarray: on the CPU backend asarray is a
+        # ZERO-COPY view of the device buffer, and every engine program
+        # donates the cache — the next step would recycle the buffer
+        # under the caller's snapshot. The verify-k commit loop anchors
+        # its rollback targets on this vector across exactly such a
+        # donating call, so a view here silently inflates every target
+        # by the committed width (one token per slot per step leaks).
+        return np.array(self.cache.length)
 
     # -- paged-pool surface (cache_mode='paged') ------------------------
     def _sync_page_table(self):

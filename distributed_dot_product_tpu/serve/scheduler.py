@@ -53,6 +53,7 @@ of these paths deterministically in CPU tests.
 
 import dataclasses
 import enum
+import os
 import time
 from typing import Callable, Dict, Optional
 
@@ -106,6 +107,19 @@ class ServeConfig:
     profile_ttft_p99: Optional[float] = None
     profile_seconds: float = 2.0
     profile_cooldown: float = 60.0
+    # Speculative decoding (serve/spec.py): 'ngram' (self-drafting
+    # suffix lookup — no extra model) or 'draft' (a small draft-model
+    # twin with its own cache + rollback); None consults the
+    # DDP_TPU_SPEC env knob, 'off' disables even when the knob is set.
+    # Greedy verification keeps the committed stream token-for-token
+    # IDENTICAL to the non-spec stream (per decode impl) — a proposer
+    # is an untrusted accelerator, never a correctness input.
+    # `spec_k`: most proposals per slot per verify step (verify width
+    # k+1 — ONE compiled program per k). `spec_max_ngram`: longest
+    # suffix the ngram proposer matches on.
+    spec: Optional[str] = None
+    spec_k: int = 4
+    spec_max_ngram: int = 3
 
 
 class _SlotState(enum.Enum):
@@ -153,7 +167,7 @@ class Scheduler:
                  registry: Optional[tracing.MetricsRegistry] = None,
                  health: Optional[HealthMonitor] = None,
                  on_tick: Optional[Callable] = None, event_log=None,
-                 profiler=None):
+                 profiler=None, proposer=None):
         self.engine = engine
         # Paged engines gate admission by FREE PAGES, not free slots,
         # and join page exhaustion into the degrade→evict→reject
@@ -223,6 +237,23 @@ class Scheduler:
                 'serve.cache.request_pages', buckets=())
         self._c_profile = reg.counter('serve.profile_triggers')
         self._h_step = reg.histogram('serve.step_seconds')
+        # Speculative decoding: an explicit `proposer` object wins,
+        # else cfg.spec names a built-in, else the DDP_TPU_SPEC env
+        # knob (the smoke/CI hook). A spec tick runs the fused
+        # verify-k program; ticks with no proposals ride the plain
+        # n=1 program — mixed batches share one verify dispatch with
+        # per-slot counts.
+        self._proposer = (proposer if proposer is not None
+                          else self._resolve_proposer())
+        if self._proposer is not None:
+            # Token-count histograms (time buckets make no sense):
+            # proposed vs accepted per verify step — the amortization
+            # the whole scheme is judged by (committed tokens/step =
+            # accepted mean + 1).
+            self._h_spec_prop = reg.histogram(
+                'serve.spec.proposed_per_step', buckets=())
+            self._h_spec_acc = reg.histogram(
+                'serve.spec.accepted_per_step', buckets=())
         # Request-timeline histograms: the latency decomposition a
         # continuous-batching server is judged by. All measured on the
         # scheduler's own clock and ALSO stamped into the event log, so
@@ -231,6 +262,34 @@ class Scheduler:
         self._h_ttft = reg.histogram('serve.ttft_seconds')
         self._h_token = reg.histogram('serve.token_seconds')
         self._h_request = reg.histogram('serve.request_seconds')
+
+    def _resolve_proposer(self):
+        """Build the configured proposer: cfg.spec wins, else the
+        DDP_TPU_SPEC env knob; 'off'/'none' explicitly disables."""
+        name = self.cfg.spec
+        if name is None:
+            name = os.environ.get('DDP_TPU_SPEC', '').strip().lower() \
+                or None
+        if name in (None, '', 'off', 'none', '0'):
+            return None
+        from distributed_dot_product_tpu.serve.spec import (
+            DraftEngineProposer, NgramProposer, make_draft_engine,
+        )
+        if name == 'ngram':
+            return NgramProposer(max_ngram=self.cfg.spec_max_ngram)
+        if name == 'draft':
+            return DraftEngineProposer(make_draft_engine(self.engine))
+        raise ValueError(f"spec must be 'ngram', 'draft' or 'off', "
+                         f'got {name!r}')
+
+    def _spec_start(self, slot: _Slot):
+        """A request began (or resumed) decoding in ``slot``: hand the
+        proposer the full committed history (prompt + any tokens a
+        fork inherited)."""
+        if self._proposer is not None:
+            self._proposer.start(slot.index,
+                                 list(slot.request.prompt)
+                                 + slot.request.tokens)
 
     def _emit(self, event, **fields):
         """Into the explicit event log, else the active one, else
@@ -341,6 +400,8 @@ class Scheduler:
         occupancy at RETIREMENT only — a requeued request's mid-flight
         partial fills would skew the distribution low."""
         self.engine.reset(slot.index)
+        if self._proposer is not None:
+            self._proposer.reset(slot.index)
         slot.state = _SlotState.FREE
         slot.request = None
         slot.produced = 0
@@ -470,6 +531,7 @@ class Scheduler:
         free.prefill_pos = src.prefill_pos
         free.last_progress = now
         free.last_token_at = src.last_token_at
+        self._spec_start(free)
         self._emit('serve.admit', request_id=req.id, slot=free.index,
                    queue_wait=0.0, prompt_len=len(req.prompt),
                    requeues=0, fork_of=orig.id)
@@ -610,6 +672,7 @@ class Scheduler:
             if len(req.prompt) == 1:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
+                self._spec_start(slot)
             else:
                 slot.state = _SlotState.PREFILL
 
@@ -635,6 +698,155 @@ class Scheduler:
                                       'queue or page-pool pressure')
         else:
             self.health.set_readiness(Readiness.READY, 'serving')
+
+    def _commit_token(self, slot: _Slot, tok: int, now) -> bool:
+        """Append ONE committed token to the slot's stream with the
+        full per-token bookkeeping — counters, TTFT/gap observations
+        stamped into the serve.decode event, abandon/deadline/eos/
+        budget terminal checks. Shared verbatim by the plain n=1 tick
+        and the verify-k commit loop, so the two paths' bookkeeping
+        cannot drift. Returns True when the token finished the request
+        (slot freed) — a verify commit stops there."""
+        req = slot.request
+        req.tokens.append(tok)
+        slot.produced += 1
+        slot.input_token = tok
+        slot.last_progress = now
+        self._c['tokens_generated'].inc()
+        # Timeline observations, stamped into the decode event: TTFT
+        # on the stream's first token, inter-token gap on the rest
+        # (both on the scheduler clock). Tokens a verify step commits
+        # together stamp zero gaps — that IS the amortization.
+        token_fields = dict(request_id=req.id, slot=slot.index,
+                            token_index=slot.produced - 1, token=tok)
+        if req.first_token_at is None:
+            req.first_token_at = now
+            ttft = max(0.0, now - req.submitted_at)
+            self._h_ttft.observe(ttft)
+            self._ttft_dirty = True
+            token_fields['ttft'] = ttft
+        elif slot.last_token_at is not None:
+            gap = max(0.0, now - slot.last_token_at)
+            self._h_token.observe(gap)
+            token_fields['gap'] = gap
+        slot.last_token_at = now
+        self._emit('serve.decode', **token_fields)
+        if req.cancelled or (
+                self.injector is not None
+                and self.injector.should_abandon(
+                    req.admit_index, slot.produced)):
+            self._finish(slot, 'abandoned')
+        elif req.deadline is not None and req.deadline <= now:
+            self._finish(slot, 'deadline_expired')
+        elif (self.cfg.eos_id is not None
+                and tok == self.cfg.eos_id):
+            self._finish(slot, 'completed')
+        elif slot.produced >= req.max_new_tokens:
+            self._finish(slot, 'completed')
+        else:
+            return False
+        return True
+
+    def _propose(self, lens):
+        """Collect this tick's proposals: per ACTIVE slot, cap the
+        verify width by the remaining token budget (a verify commits
+        up to cap+1 tokens — never past max_new_tokens) and the cache
+        headroom, hand the proposer the committed history, and emit a
+        spec.propose event per slot that got guesses. Returns
+        ``{slot_index: [token, ...]}``."""
+        k = self.cfg.spec_k
+        reqs = []
+        for slot in self._slots:
+            if slot.state is not _SlotState.ACTIVE:
+                continue
+            req = slot.request
+            cap = min(k, req.max_new_tokens - slot.produced - 1,
+                      self.engine.t_max - int(lens[slot.index]) - 1)
+            if cap <= 0:
+                continue
+            reqs.append((slot.index,
+                         list(req.prompt) + req.tokens, cap))
+        if not reqs:
+            return {}
+        caps = {s: c for s, _, c in reqs}
+        props = self._proposer.propose_batch(reqs, k) or {}
+        props = {s: list(p)[:caps[s]] for s, p in props.items()
+                 if s in caps and len(p)}
+        if self._paged:
+            # Reserve each spec slot's verify-width pages up front; on
+            # exhaustion DROP the slot's proposals (it rides the tick
+            # as a plain n=1 decode, whose single append the
+            # _ensure_pages ladder already made writable) — spec is an
+            # accelerator, never a reason to preempt someone.
+            for s in list(props):
+                if not self.engine.reserve_rows(s, len(props[s]) + 1):
+                    del props[s]
+        for slot in self._slots:
+            p = props.get(slot.index)
+            if p:
+                self._emit('spec.propose', request_id=slot.request.id,
+                           slot=slot.index, proposed=len(p),
+                           proposer=type(self._proposer).__name__)
+        return props
+
+    def _spec_tick(self, active, poison, request_ids, props, lens):
+        """One mixed spec/non-spec verify tick: every active slot
+        rides ONE fused verify program — row 0 its input token, rows
+        1.. its proposals (none for non-spec slots, counts[i] = 1).
+        Greedy acceptance commits the longest matching prefix plus the
+        free token through the SAME per-token bookkeeping as a plain
+        tick, then one batched rollback truncates every continuing
+        slot's cache to its accepted prefix."""
+        eng = self.engine
+        w = self.cfg.spec_k + 1
+        tokens = np.zeros((eng.slots, w), np.int32)
+        counts = np.zeros(eng.slots, np.int64)
+        for slot in self._slots:
+            if slot.state is not _SlotState.ACTIVE:
+                continue
+            p = props.get(slot.index, [])
+            tokens[slot.index, 0] = slot.input_token
+            tokens[slot.index, 1:1 + len(p)] = p
+            counts[slot.index] = 1 + len(p)
+        toks, finite = eng.verify_step(tokens, counts, active, poison,
+                                       request_ids=request_ids)
+        self.health.beat()   # the step returned: not stuck
+        self._c['decode_steps'].inc()
+        now = self.clock()
+        targets = np.full(eng.slots, np.iinfo(np.int32).max, np.int64)
+        for slot in self._slots:
+            if slot.state is not _SlotState.ACTIVE:
+                continue
+            req = slot.request
+            if not finite[slot.index]:
+                self._quarantine(slot)
+                continue
+            p = props.get(slot.index, [])
+            row = toks[slot.index]
+            acc = 0
+            while acc < len(p) and p[acc] == int(row[acc]):
+                acc += 1
+            if p:
+                self._h_spec_prop.observe(len(p))
+                self._h_spec_acc.observe(acc)
+                self._emit('spec.verify', request_id=req.id,
+                           slot=slot.index, proposed=len(p),
+                           accepted=acc)
+            committed = []
+            finished = False
+            for tok in row[:acc + 1]:
+                committed.append(int(tok))
+                if self._commit_token(slot, int(tok), now):
+                    finished = True
+                    break
+            if not finished:
+                # Truncate the cache to the accepted prefix: the next
+                # input token (the free one) is appended by the NEXT
+                # step, like every committed token before it.
+                targets[slot.index] = int(lens[slot.index]) + 1 + acc
+                self._proposer.commit(slot.index, committed, acc)
+        eng.rollback(targets)
+        self._proposer.end_step()
 
     # -- the loop -------------------------------------------------------
     def step(self) -> bool:
@@ -669,6 +881,7 @@ class Scheduler:
             if slot.prefill_pos >= len(req.prompt) - 1:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
+                self._spec_start(slot)
 
         if self._paged:
             self._ensure_pages()
@@ -680,65 +893,57 @@ class Scheduler:
             poison = (self.injector.poison_slots(self._step_idx,
                                                  len(self._slots))
                       if self.injector is not None else None)
-            tokens_in = np.array([s.input_token for s in self._slots],
-                                 np.int32)
             # Request-id labels only materialize when spans are on —
             # the disabled default must stay allocation-free per step.
             request_ids = ([s.request.id if s.request is not None
                             else None for s in self._slots]
                            if obs_spans.enabled() else None)
+            # Speculative tick: collect proposals first; a tick where
+            # no slot got a guess rides the plain n=1 program (zero
+            # verify overhead when the proposer has nothing).
+            props = None
+            if self._proposer is not None:
+                lens = self.engine.lengths()
+                props = self._propose(lens)
             t0 = time.perf_counter()
-            with span('serve.decode_step', step=self._step_idx):
-                toks, finite = self.engine.step(tokens_in, active,
-                                                poison,
-                                                request_ids=request_ids)
-            self._h_step.observe(time.perf_counter() - t0)
-            self.health.beat()   # the step returned: not stuck
-            self._c['decode_steps'].inc()
-            now = self.clock()
-            for slot in self._slots:
-                if slot.state is not _SlotState.ACTIVE:
-                    continue
-                req = slot.request
-                if not finite[slot.index]:
-                    self._quarantine(slot)
-                    continue
-                tok = int(toks[slot.index])
-                req.tokens.append(tok)
-                slot.produced += 1
-                slot.input_token = tok
-                slot.last_progress = now
-                self._c['tokens_generated'].inc()
-                # Timeline observations, stamped into the decode event:
-                # TTFT on the stream's first token, inter-token gap on
-                # the rest (both on the scheduler clock).
-                token_fields = dict(request_id=req.id, slot=slot.index,
-                                    token_index=slot.produced - 1,
-                                    token=tok)
-                if req.first_token_at is None:
-                    req.first_token_at = now
-                    ttft = max(0.0, now - req.submitted_at)
-                    self._h_ttft.observe(ttft)
-                    self._ttft_dirty = True
-                    token_fields['ttft'] = ttft
-                elif slot.last_token_at is not None:
-                    gap = max(0.0, now - slot.last_token_at)
-                    self._h_token.observe(gap)
-                    token_fields['gap'] = gap
-                slot.last_token_at = now
-                self._emit('serve.decode', **token_fields)
-                if req.cancelled or (
-                        self.injector is not None
-                        and self.injector.should_abandon(
-                            req.admit_index, slot.produced)):
-                    self._finish(slot, 'abandoned')
-                elif req.deadline is not None and req.deadline <= now:
-                    self._finish(slot, 'deadline_expired')
-                elif (self.cfg.eos_id is not None
-                        and tok == self.cfg.eos_id):
-                    self._finish(slot, 'completed')
-                elif slot.produced >= req.max_new_tokens:
-                    self._finish(slot, 'completed')
+            if props:
+                with span('serve.decode_step', step=self._step_idx,
+                          spec=True):
+                    self._spec_tick(active, poison, request_ids, props,
+                                    lens)
+                self._h_step.observe(time.perf_counter() - t0)
+            else:
+                tokens_in = np.array(
+                    [s.input_token for s in self._slots], np.int32)
+                with span('serve.decode_step', step=self._step_idx):
+                    toks, finite = self.engine.step(
+                        tokens_in, active, poison,
+                        request_ids=request_ids)
+                self._h_step.observe(time.perf_counter() - t0)
+                self.health.beat()   # the step returned: not stuck
+                self._c['decode_steps'].inc()
+                now = self.clock()
+                for slot in self._slots:
+                    if slot.state is not _SlotState.ACTIVE:
+                        continue
+                    if not finite[slot.index]:
+                        self._quarantine(slot)
+                        continue
+                    tok = int(toks[slot.index])
+                    finished = self._commit_token(slot, tok, now)
+                    # props == {} (not None) means the proposer DID
+                    # draft this tick but every proposal was dropped
+                    # (nothing guessed, or paged reservation shed them
+                    # all): a stateful proposer (the draft engine) has
+                    # speculatively appended rows it must roll back to
+                    # the committed stream — the same commit/end_step
+                    # protocol a verify tick runs, with 0 accepted.
+                    # Finished slots skip it: retirement already reset
+                    # the proposer's slot state.
+                    if props is not None and not finished:
+                        self._proposer.commit(slot.index, [tok], 0)
+                if props is not None:
+                    self._proposer.end_step()
             self._step_idx += 1
 
         self._g_active.set(sum(s.state is not _SlotState.FREE

@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/5] ruff (generic hygiene) ==='
+echo '=== [1/6] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,10 +27,10 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/5] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/6] graphlint (jaxpr/domain contracts) ==='
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/5] tier-1 tests ==='
+echo '=== [3/6] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -38,7 +38,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/5] smoke serve + event-log schema validation ==='
+echo '=== [4/6] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -48,7 +48,65 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/5] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [5/6] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+# Speculative decoding's exactness guarantee, proven on a real burst
+# through the ENV knob a deployment would flip: the same traffic served
+# with the n-gram proposer (verify-k steps) and without (plain n=1
+# steps) must produce token-for-token identical streams and statuses.
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping spec-smoke stage'
+else
+    JAX_PLATFORMS=cpu DDP_TPU_SPEC=ngram python - <<'PY' || rc=1
+import numpy as np
+
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, Scheduler, ServeConfig,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+
+def burst(spec):
+    """spec=None resolves the DDP_TPU_SPEC env knob; 'off' overrides
+    it — so the spec run exercises the deployment path and the
+    baseline run the explicit opt-out."""
+    eng = KernelEngine(slots=2, t_max=128, vocab=32, seed=4,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng, ServeConfig(queue_limit=16, max_new_tokens=24,
+                         watchdog=False, spec=spec, spec_k=4),
+        registry=MetricsRegistry())
+    rng = np.random.RandomState(11)
+    # Mixed traffic: cyclic prompts (speculation's win case) and
+    # random ones (its miss case) in one batch.
+    for i in range(6):
+        if i % 2:
+            p = [(j % 3) + 1 for j in range(8)]
+        else:
+            p = [int(x) for x in rng.randint(1, 32, size=6)]
+        sched.submit(p, request_id=f'r{i}')
+    results = sched.run_until_idle()
+    sched.close()
+    steps = sched.registry.snapshot()['counters']['serve.decode_steps']
+    return results, steps
+
+
+spec, spec_steps = burst(None)        # DDP_TPU_SPEC=ngram applies
+base, base_steps = burst('off')
+assert set(spec) == set(base)
+for rid in base:
+    assert spec[rid].status == base[rid].status, rid
+    assert spec[rid].tokens == base[rid].tokens, (
+        f'{rid}: spec stream diverged from non-spec — the greedy '
+        f'verify exactness guarantee is broken')
+assert spec_steps < base_steps, (
+    f'spec burst took {spec_steps} dispatches vs {base_steps} non-spec'
+    ' — the verify-k path never amortized a step')
+print(f'spec smoke OK: {len(base)} streams bit-identical, '
+      f'{spec_steps} vs {base_steps} decode dispatches')
+PY
+fi
+
+echo '=== [6/6] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for

@@ -221,6 +221,21 @@ SWEEPS = [
         '--serve-requests', '64', '--decode-impl', impl,
         '--cache-mode', 'paged', '--page-size', '256'])
       for impl in ('xla', 'kernel')],
+    # --- round-8: speculative decoding B=1 twins — each row times a
+    # non-spec scheduler burst AND the proposer-driven verify-k burst
+    # on the same engine/prompts (baseline_tokens_per_s rides the
+    # record), so the ISSUE-8 hardware acceptance (>2× tokens/s over
+    # the measured non-spec rate on the repetitive stream) reads
+    # straight off the spec_speedup column; accepted-tokens/step is
+    # the amortization telemetry. The draft row is the self-draft
+    # twin (machinery cost ceiling) until a distilled checkpoint
+    # lands. ---
+    *[(f'decode_spec_{name}_{impl}',
+       ['--mode', 'decode', '--spec', name, '--seq-len', '4096',
+        '--serve-requests', '4', '--spec-k', '4',
+        '--heads', '2', '--head-dim', '8', '--decode-impl', impl])
+      for name in ('ngram', 'draft')
+      for impl in ('xla', 'kernel')],
     # --- round-5: LM capstone training (embed → scanned+remat stack →
     # tied head → chunked cross-entropy, one SPMD program) ---
     ('lm_32k',

@@ -174,6 +174,25 @@ def test_decode_mode_kernel_vs_xla_rows(tmp_path):
     assert rec_k['decode_impl'] == 'kernel'
 
 
+def test_decode_spec_row(tmp_path):
+    """--mode decode --spec ngram: the draft-verify generation row —
+    spec and non-spec tokens/s on the same engine/prompts plus the
+    amortization telemetry, and the ISSUE-8 CPU acceptance numbers
+    (accepted-tokens/step > 2, fewer dispatches than tokens) on the
+    repetitive stream. The run itself asserts stream identity before
+    recording, so a passing row IS an exactness check."""
+    rec = _run(tmp_path, 'dspec', '--mode', 'decode', '--spec', 'ngram',
+               '--seq-len', '128', '--heads', '2', '--head-dim', '8')
+    assert rec['mode'] == 'decode' and rec['spec'] == 'ngram'
+    assert rec['spec_k'] == 4
+    assert rec['tokens_per_s'] > 0
+    assert rec['baseline_tokens_per_s'] > 0
+    assert rec['accepted_per_step'] > 2.0
+    assert rec['proposed_per_step'] >= rec['accepted_per_step']
+    assert rec['decode_steps'] < rec['baseline_decode_steps']
+    assert rec['completed'] == rec['requests'] == 2
+
+
 def test_train_mode_window(tmp_path):
     rec = _run(tmp_path, 'train_w', '--mode', 'train', '--attn-impl',
                'flash', '--seq-len', '64', '--no-mask', '--causal',

@@ -75,7 +75,8 @@ def test_registry_covers_every_layer(devices):
         'attention.fwd_ulysses', 'decode.seq_parallel_step',
         'decode.step_xla_slots', 'decode.step_kernel_int8',
         'decode.step_sharded', 'decode.step_paged_xla',
-        'decode.step_paged_kernel', 'lm.head_bf16', 'lm.loss_f32',
+        'decode.step_paged_kernel', 'decode.step_verify_slab',
+        'decode.step_verify_paged', 'lm.head_bf16', 'lm.loss_f32',
         'serve.engine_decode', 'serve.engine_decode_paged',
         'train.lm_step', 'obs.spanned_decode',
     }

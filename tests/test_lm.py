@@ -154,6 +154,31 @@ def test_greedy_generate_exact_capacity_boundary():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(roomy))
 
 
+def test_greedy_generate_reuses_compiled_programs():
+    """Two greedy_generate calls with identical (model, shapes) trace
+    the prefill and step programs ONCE each — the round-8 recompile
+    finding: the old implementation wrapped both in fresh jax.jit
+    closures per invocation, so every call paid a full trace. The
+    compiled pair now lives in an LRU cache keyed by every
+    shape-determining input, and the watchers are retrace-budgeted so
+    a regression raises rather than silently rebuilding."""
+    from distributed_dot_product_tpu import greedy_generate
+    from distributed_dot_product_tpu.analysis import retrace
+    m = _model(attn_kwargs=dict(distributed=False))
+    # Shapes unique to this test: the program cache is module-global,
+    # so reusing another test's (b, n, t_max) would read its entry and
+    # vacuously count zero traces.
+    toks = jnp.arange(6, dtype=jnp.int32).reshape(2, 3) % VOCAB
+    params = m.init(jax.random.key(0), toks)
+    before_p = retrace.total('lm.generate_prefill')
+    before_s = retrace.total('lm.generate_step')
+    first = greedy_generate(m, params, toks, steps=4, t_max=12)
+    second = greedy_generate(m, params, toks, steps=4, t_max=12)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(second))
+    assert retrace.total('lm.generate_prefill') - before_p == 1
+    assert retrace.total('lm.generate_step') - before_s == 1
+
+
 def test_lm_dropout_requires_seed():
     mesh = seq_mesh(8)
     m = _model(attn_kwargs=dict(dropout_rate=0.1))

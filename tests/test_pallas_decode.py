@@ -213,10 +213,18 @@ def test_kernel_eligibility_and_fallback():
     assert decode_block_k(1027) is None              # prime > cap
     cache = init_slot_cache(B, 2, T, D, dtype=jnp.float32)
     assert decode_kernel_eligible(cache)
-    assert not decode_kernel_eligible(cache, n=2)
+    # Verify-k: n up to the K split is kernel-native; wider calls and
+    # quantized verify-k fall back to the XLA formulation.
+    assert decode_kernel_eligible(cache, n=2)
+    assert decode_kernel_eligible(cache, n=decode_block_k(T))
+    assert not decode_kernel_eligible(cache, n=decode_block_k(T) + 1)
+    assert not decode_kernel_eligible(cache, n=0)
     assert not decode_kernel_eligible(cache, segment_ids=jnp.zeros(
         (B, T), jnp.int32))
     assert not decode_kernel_eligible(cache, qk_quant='int8')  # no mirror
+    mirror = init_cache(B, 2, T, D, dtype=jnp.float32, qk_quant='int8')
+    assert decode_kernel_eligible(mirror, qk_quant='int8')
+    assert not decode_kernel_eligible(mirror, n=2, qk_quant='int8')
     q, kn, vn, kf, vf = _operands(2, 2, key=8)
     seg = jnp.zeros((B, T), jnp.int32)
     seg_q = jnp.zeros((B, 1), jnp.int32)
