@@ -49,7 +49,7 @@ def parse_args():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--mode', choices=['nt', 'all', 'tn', 'attn',
                                            'train', 'decode', 'lm',
-                                           'decode-serve'],
+                                           'decode-serve', 'serve-load'],
                         default='nt')
     parser.add_argument('--serve-requests', type=int, default=None,
                         help='decode-serve mode: burst size (default '
@@ -147,6 +147,44 @@ def parse_args():
     parser.add_argument('--spec-k', type=int, default=4,
                         help='--spec: most proposals per slot per '
                              'verify step (verify width k+1)')
+    # serve-load mode (the SLO observatory row, ROADMAP item 5): the
+    # DEFAULTS here ARE the CI smoke config — scripts/ci.sh runs this
+    # mode bare and gates its event log against the committed
+    # SLO_BASELINE.json, so changing a default is a baseline refresh.
+    parser.add_argument('--load-seed', type=int, default=7,
+                        help='serve-load mode: trace seed (same seed = '
+                             'identical trace and goodput report)')
+    parser.add_argument('--load-rate', type=float, default=600.0,
+                        help='serve-load mode: aggregate offered rate, '
+                             'requests per VIRTUAL second (the default '
+                             'runs the stock engine at ~85%% goodput — '
+                             'contended enough that scheduling policy '
+                             'moves the number)')
+    parser.add_argument('--load-requests', type=int, default=48,
+                        help='serve-load mode: trace length')
+    parser.add_argument('--load-tenants', type=int, default=2,
+                        help='serve-load mode: tenant count (stock '
+                             'interactive/batchy mix)')
+    parser.add_argument('--arrival', choices=['poisson', 'bursty'],
+                        default='poisson',
+                        help='serve-load mode: arrival process (bursty '
+                             '= ON/OFF modulated Poisson)')
+    parser.add_argument('--load-tick', type=float, default=0.002,
+                        help='serve-load mode: virtual seconds one '
+                             'scheduler tick costs (the simulated '
+                             'decode-step duration)')
+    parser.add_argument('--slo-ttft', type=float, default=0.25,
+                        help='serve-load mode: TTFT deadline (s)')
+    parser.add_argument('--slo-token', type=float, default=0.05,
+                        help='serve-load mode: max inter-token gap (s)')
+    parser.add_argument('--queue-limit', type=int, default=12,
+                        help='serve-load mode: admission queue bound '
+                             '(the overload ladder input)')
+    parser.add_argument('--event-log', default=None,
+                        help='serve-load mode: write the run\'s JSONL '
+                             'event log here (the goodput report is '
+                             'computed from it ALONE; default: a '
+                             'temp file)')
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -1083,6 +1121,138 @@ def run_decode_serve(args):
     return record
 
 
+def run_serve_load(args):
+    """``--mode serve-load``: goodput under SLO for a seeded open-loop
+    trace (ROADMAP item 5's measurement half). The loadgen drives the
+    scheduler in VIRTUAL time (Poisson or bursty arrivals, heavy-tailed
+    per-tenant length mixes), the run's JSONL event log is written, and
+    the goodput report is computed FROM THE LOG ALONE (obs/slo.py) —
+    the row a scheduling-policy change will be graded on, per tenant.
+    The flag defaults are the CI smoke config: scripts/ci.sh runs this
+    bare and gates the log against SLO_BASELINE.json."""
+    import tempfile
+
+    from distributed_dot_product_tpu import obs
+    from distributed_dot_product_tpu.obs import slo as obs_slo
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, LoadGenConfig, ServeConfig, VirtualClock,
+        default_tenants, run_load,
+    )
+    from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+    slots = args.batch if args.batch > 1 else 4
+    t_max = args.seq_len or 96
+    paged = args.cache_mode == 'paged'
+    extra = {}
+    if paged:
+        if t_max % args.page_size:
+            raise SystemExit(f'--page-size {args.page_size} must '
+                             f'divide the cache length {t_max}')
+        extra = dict(cache_mode='paged', page_size=args.page_size,
+                     pages=slots * (t_max // args.page_size))
+    engine = KernelEngine(
+        slots=slots, t_max=t_max, vocab=64, heads=args.heads,
+        head_dim=args.head_dim, prefill_chunk=8, seed=0,
+        decode_impl=(None if args.decode_impl == 'auto'
+                     else args.decode_impl), **extra)
+    cfg = LoadGenConfig(
+        seed=args.load_seed, rate=args.load_rate,
+        requests=args.load_requests, arrival=args.arrival,
+        tenants=default_tenants(args.load_tenants), vocab=64,
+        tick_seconds=args.load_tick)
+    serve_cfg = ServeConfig(
+        queue_limit=args.queue_limit,
+        max_new_tokens=max(t.new_hi for t in cfg.tenants),
+        watchdog=False, spec=args.spec, spec_k=args.spec_k)
+    log_path = args.event_log or os.path.join(
+        tempfile.gettempdir(), f'ddp_serve_load_{os.getpid()}.jsonl')
+    # A fresh log per run: EventLog APPENDS (resuming seq), so a stale
+    # file from a previous run would double every timeline.
+    obs.remove_log(log_path)
+    clock = VirtualClock()
+    event_log = obs.EventLog(log_path, clock=clock)
+    registry = (tracing.get_registry()
+                if getattr(args, 'metrics_out', None)
+                else MetricsRegistry())
+    with span('benchmark.serve_load', seed=args.load_seed):
+        res = run_load(cfg, engine=engine, serve_config=serve_cfg,
+                       registry=registry, event_log=event_log,
+                       clock=clock)
+    event_log.close()
+
+    spec = obs_slo.SloSpec(ttft=args.slo_ttft,
+                           per_token=args.slo_token)
+    # Read + decode the log ONCE; goodput and the churn reconstruction
+    # below both accept the decoded records.
+    records = obs.read_events(log_path)
+    report = obs_slo.goodput(records, spec)
+    if not res.accounted:
+        raise SystemExit('serve-load: a submitted request has no '
+                         'terminal record — scheduler accounting bug, '
+                         'not a measurable row')
+    if report.requests != len(res.submitted):
+        raise SystemExit(
+            f'serve-load: {report.requests} requests classified from '
+            f'the log vs {len(res.submitted)} submitted — the event '
+            f'log is not a complete record')
+    # Per-tenant churn counters the policy follow-up will be graded
+    # on, reconstructed from the same log.
+    preempts, requeues = {}, {}
+    for tl in obs.reconstruct(records).values():
+        tenant = tl.tenant or 'default'
+        preempts[tenant] = preempts.get(tenant, 0) + tl.preempts
+        requeues[tenant] = requeues.get(tenant, 0) + max(
+            0, tl.admits - 1)
+    per_tenant = {
+        t: {'requests': tb['requests'],
+            'goodput_pct': tb['goodput_pct'],
+            'met': tb['counts']['met'],
+            'rejected': tb['counts']['rejected'],
+            'preempts': preempts.get(t, 0),
+            'requeues': requeues.get(t, 0)}
+        for t, tb in sorted(report.per_tenant.items())}
+    record = {
+        'mode': 'serve-load', 'seed': args.load_seed,
+        'arrival': cfg.arrival, 'rate_requested': cfg.rate,
+        'rate_offered': res.offered_rate,
+        'requests': report.requests, 'slots': slots, 't_max': t_max,
+        'cache_mode': args.cache_mode, 'spec': args.spec,
+        'decode_impl': args.decode_impl,
+        'queue_limit': serve_cfg.queue_limit,
+        'tick_seconds': cfg.tick_seconds,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'slo': spec.to_dict(),
+        'goodput_pct': report.goodput_pct,
+        'counts': report.counts,
+        'per_tenant': per_tenant,
+        'ttft_ms': {k: (None if v is None else v * 1e3)
+                    for k, v in report.percentiles['ttft'].items()
+                    if k != 'count'},
+        'gap_ms': {k: (None if v is None else v * 1e3)
+                   for k, v in report.percentiles['gap'].items()
+                   if k != 'count'},
+        'queue_wait_ms': {k: (None if v is None else v * 1e3)
+                          for k, v in
+                          report.percentiles['queue_wait'].items()
+                          if k != 'count'},
+        'virtual_seconds': res.virtual_seconds,
+        'wall_seconds': res.wall_seconds,
+        'ticks': res.ticks,
+        'event_log': log_path,
+    }
+    print(f"serve-load[{args.cache_mode}/"
+          f"{args.spec}] seed={args.load_seed} "
+          f"{cfg.arrival}@{cfg.rate:.0f}/s x{report.requests}: "
+          f"goodput {report.goodput_pct:.1f}% under "
+          f"ttft<{args.slo_ttft * 1e3:.0f}ms "
+          f"gap<{args.slo_token * 1e3:.0f}ms")
+    print(obs_slo.render_report(report))
+    print(f'event log: {log_path}')
+    _append_record(args.file, record)
+    return record
+
+
 def run_decode_spec(args):
     """``--mode decode --spec {ngram,draft}``: what draft-verify
     decoding BUYS over plain one-token-per-dispatch generation. Two
@@ -1221,6 +1391,8 @@ def run(args):
         return run_decode(args)
     if args.mode == 'decode-serve':
         return run_decode_serve(args)
+    if args.mode == 'serve-load':
+        return run_serve_load(args)
     if args.mode == 'lm':
         return run_lm(args)
     mesh = seq_mesh(args.devices)

@@ -13,7 +13,11 @@ functions.py:24-41) and the in-process ``MetricsRegistry``
   schema-versioned JSONL event log (serve/train/health/fault lifecycle
   vocabulary), crash-safe flushing, size-based rotation.
 - :mod:`~distributed_dot_product_tpu.obs.timeline` — per-request
-  lifecycle reconstruction over the event log.
+  lifecycle reconstruction over the event log (multi-replica log sets
+  merge through ``events.merge_events``).
+- :mod:`~distributed_dot_product_tpu.obs.slo` — goodput-under-SLO
+  accounting from the event log alone (SloSpec, per-tenant breakdowns,
+  the ``slo check`` CI gate against ``SLO_BASELINE.json``).
 - :mod:`~distributed_dot_product_tpu.obs.exporter` — Prometheus-text
   rendering of the metrics registry plus the optional ``/metrics`` +
   ``/healthz`` + ``/profile`` HTTP thread (off by default).
@@ -37,7 +41,11 @@ from distributed_dot_product_tpu.obs.devmon import (  # noqa: F401
 )
 from distributed_dot_product_tpu.obs.events import (  # noqa: F401
     EVENT_SCHEMA, SCHEMA_VERSION, EventLog, activate, emit, get_active,
-    open_from_env, read_events, set_active, validate_file,
+    merge_events, open_from_env, read_events, remove_log, set_active,
+    validate_file,
+)
+from distributed_dot_product_tpu.obs.slo import (  # noqa: F401
+    SloReport, SloSpec, check_baseline, goodput,
 )
 from distributed_dot_product_tpu.obs.exporter import (  # noqa: F401
     MetricsServer, render_prometheus,
@@ -52,8 +60,9 @@ from distributed_dot_product_tpu.obs.timeline import (  # noqa: F401
 
 __all__ = [
     'EVENT_SCHEMA', 'SCHEMA_VERSION', 'EventLog', 'activate', 'emit',
-    'get_active', 'open_from_env', 'read_events', 'set_active',
-    'validate_file', 'MetricsServer', 'render_prometheus',
+    'get_active', 'merge_events', 'open_from_env', 'read_events',
+    'remove_log', 'set_active', 'validate_file', 'SloReport', 'SloSpec',
+    'check_baseline', 'goodput', 'MetricsServer', 'render_prometheus',
     'SpanCollector', 'SpanRecord', 'collecting', 'enable', 'enabled',
     'get_collector', 'span', 'spanned', 'Timeline', 'reconstruct',
     'timeline', 'CaptureInFlight', 'DeviceMonitor', 'ProfileCapture',

@@ -4,9 +4,15 @@ Offline event-log tooling::
 
     python -m distributed_dot_product_tpu.obs validate LOG [LOG...]
         [--require event[,event...]] [--timelines]
-    python -m distributed_dot_product_tpu.obs stats LOG [LOG...] [--json]
+    python -m distributed_dot_product_tpu.obs stats LOG [LOG...]
+        [--percentiles] [--json]
     python -m distributed_dot_product_tpu.obs timeline LOG REQUEST_ID
         [--json]
+    python -m distributed_dot_product_tpu.obs slo report LOG [LOG...]
+        [--ttft S] [--per-token S] [--e2e S] [--spec SPEC.json]
+        [--baseline-out SLO_BASELINE.json] [--json]
+    python -m distributed_dot_product_tpu.obs slo check LOG [LOG...]
+        --against SLO_BASELINE.json [--json]
 
 ``validate`` schema-checks every record of each log's rotated set
 against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
@@ -19,6 +25,18 @@ reconstructs every request and fails on incomplete lifecycles.
 wall-clock span and sustained events/sec, and the rotated-file
 accounting (which files exist, their sizes and record counts) —
 ``--json`` emits the same as one machine-readable object.
+``--percentiles`` additionally reconstructs every request and prints
+p50/p95/p99 of TTFT, queue wait and inter-token gap — latency
+distributions without writing python.
+
+``slo`` is the goodput observatory (obs/slo.py): ``report`` classifies
+every submitted request against an :class:`~distributed_dot_product_tpu
+.obs.slo.SloSpec` (met / missed_ttft / missed_token / missed_e2e /
+rejected / incomplete) with per-tenant breakdowns; ``check`` gates a
+log against the committed ``SLO_BASELINE.json`` with tolerances (exit 1
+on violation, each naming the metric and tenant) — scripts/ci.sh runs
+it over the seeded serve-load smoke. Multi-replica log sets merge:
+pass several paths, optionally labeled ``replica=path``.
 
 ``timeline`` prints one request's reconstructed lifecycle; ``--json``
 switches to compact machine-readable output with the FULL event
@@ -33,10 +51,29 @@ import json
 import os
 import sys
 
+from distributed_dot_product_tpu.obs import slo as obs_slo
 from distributed_dot_product_tpu.obs.events import (
     _log_files, read_events, validate_file,
 )
 from distributed_dot_product_tpu.obs.timeline import reconstruct, timeline
+
+
+def _parse_log_args(logs):
+    """CLI log args → a reconstruct() source: one bare path stays a
+    path; several (or any ``replica=path`` labeled one) become a
+    multi-source list with per-replica labels."""
+    parsed = []
+    labeled = False
+    for i, arg in enumerate(logs):
+        if '=' in arg and not os.path.exists(arg):
+            label, path = arg.split('=', 1)
+            labeled = True
+        else:
+            label, path = f'r{i}', arg
+        parsed.append((label, path))
+    if len(parsed) == 1 and not labeled:
+        return parsed[0][1]
+    return parsed
 
 
 def _cmd_validate(args):
@@ -92,7 +129,7 @@ def _cmd_stats(args):
             files.append({'path': fname,
                           'bytes': os.path.getsize(fname),
                           'lines': n_lines})
-        reports.append({
+        rep = {
             'log': path, 'events': len(records),
             'wall_span_seconds': span_s,
             'events_per_second': (len(records) / span_s if span_s
@@ -102,7 +139,24 @@ def _cmd_stats(args):
             'by_event': dict(sorted(counts.items(),
                                     key=lambda kv: str(kv[0]))),
             'files': files,
-        })
+        }
+        if args.percentiles:
+            # Latency distributions over every reconstructed request:
+            # the stamped observations (ttft/queue_wait/gap), not ts
+            # arithmetic — same numbers obs/slo.py's report carries.
+            ttfts, waits, gaps = [], [], []
+            for tl in reconstruct(records).values():
+                if tl.ttft is not None:
+                    ttfts.append(tl.ttft)
+                if tl.queue_wait is not None:
+                    waits.append(tl.queue_wait)
+                gaps.extend(tl.token_gaps)
+            rep['latency_percentiles'] = {
+                name: obs_slo._percentile_block(vals)
+                for name, vals in (('ttft', ttfts),
+                                   ('queue_wait', waits),
+                                   ('gap', gaps))}
+        reports.append(rep)
     if args.json:
         # Always a list — one element per readable log — so consumers
         # get a stable shape regardless of how many paths were passed.
@@ -119,17 +173,76 @@ def _cmd_stats(args):
         for fi in rep['files']:
             print(f'  file {fi["path"]}: {fi["lines"]} lines, '
                   f'{fi["bytes"]} bytes')
+        for name, blk in rep.get('latency_percentiles', {}).items():
+            def _ms(v):
+                return 'n/a' if v is None else f'{v * 1e3:.1f}ms'
+            print(f'  {name:11} p50={_ms(blk["p50"])} '
+                  f'p95={_ms(blk["p95"])} p99={_ms(blk["p99"])} '
+                  f'over {blk["count"]}')
     return rc
+
+
+def _load_spec(args):
+    spec = obs_slo.SloSpec(ttft=args.ttft, per_token=args.per_token,
+                           e2e=args.e2e)
+    if getattr(args, 'spec', None):
+        with open(args.spec, encoding='utf-8') as f:
+            d = json.load(f)
+        # Accept a bare SloSpec dict OR a whole SLO_BASELINE.json
+        # (whose contract lives under 'spec') — so the refresh loop is
+        # `slo report LOG --spec SLO_BASELINE.json --baseline-out
+        # SLO_BASELINE.json` with no spec duplication.
+        if isinstance(d.get('spec'), dict):
+            d = d['spec']
+        spec = obs_slo.SloSpec.from_dict(d)
+    return spec
+
+
+def _cmd_slo_report(args):
+    report = obs_slo.goodput(_parse_log_args(args.logs),
+                             _load_spec(args))
+    if args.baseline_out:
+        with open(args.baseline_out, 'w', encoding='utf-8') as f:
+            json.dump(obs_slo.make_baseline(report), f, indent=2)
+            f.write('\n')
+        print(f'baseline written to {args.baseline_out}')
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2, default=str))
+    else:
+        print(obs_slo.render_report(report))
+    return 0
+
+
+def _cmd_slo_check(args):
+    baseline = obs_slo.load_baseline(args.against)
+    # The spec under test is the BASELINE's: a check must measure the
+    # same contract the baseline recorded, or the comparison is moot.
+    spec = obs_slo.SloSpec.from_dict(baseline.get('spec', {}))
+    report = obs_slo.goodput(_parse_log_args(args.logs), spec)
+    violations = obs_slo.check_baseline(report, baseline)
+    if args.json:
+        print(json.dumps({'violations': violations,
+                          'report': report.to_dict(brief=True)},
+                         indent=2, default=str))
+    else:
+        for v in violations:
+            print(f'SLO VIOLATION: {v}')
+        print(obs_slo.render_report(report))
+        print(f'slo check vs {args.against}: '
+              f'{"FAIL" if violations else "OK"} '
+              f'({len(violations)} violation(s))')
+    return 1 if violations else 0
 
 
 def _cmd_timeline(args):
     tl = timeline(args.request_id, args.log)
     payload = {
         'request_id': tl.request_id, 'status': tl.status,
-        'reason': tl.reason, 'complete': tl.complete,
+        'reason': tl.reason, 'tenant': tl.tenant,
+        'complete': tl.complete,
         'errors': tl.errors, 'phases': tl.phases(),
         'admits': tl.admits, 'quarantines': tl.quarantines,
-        'tokens': tl.tokens,
+        'preempts': tl.preempts, 'tokens': tl.tokens,
     }
     if args.json:
         # Machine-readable: full event records, compact encoding.
@@ -159,10 +272,46 @@ def main(argv=None):
     s = sub.add_parser('stats', help='operational summary of a log '
                                      '(counts, rate, rotation files)')
     s.add_argument('logs', nargs='+')
+    s.add_argument('--percentiles', action='store_true',
+                   help='also reconstruct requests and print p50/p95/'
+                        'p99 of ttft, queue wait and inter-token gap')
     s.add_argument('--json', action='store_true',
                    help='one machine-readable JSON object instead of '
                         'the human table')
     s.set_defaults(fn=_cmd_stats)
+
+    slo = sub.add_parser(
+        'slo', help='goodput-under-SLO accounting over the event log')
+    slo_sub = slo.add_subparsers(dest='slo_cmd', required=True)
+    r = slo_sub.add_parser(
+        'report', help='classify every request against an SloSpec')
+    r.add_argument('logs', nargs='+',
+                   help='log path(s); several merge as replicas '
+                        '(optionally labeled replica=path)')
+    r.add_argument('--ttft', type=float, default=None,
+                   help='TTFT deadline, seconds')
+    r.add_argument('--per-token', type=float, default=None,
+                   help='max inter-token gap, seconds')
+    r.add_argument('--e2e', type=float, default=None,
+                   help='end-to-end deadline, seconds')
+    r.add_argument('--spec', default=None,
+                   help='JSON SloSpec file (overrides the flags; may '
+                        'carry per-tenant overrides)')
+    r.add_argument('--baseline-out', default=None,
+                   help='also write an SLO_BASELINE.json payload here '
+                        '(the refresh path scripts/ci.sh documents)')
+    r.add_argument('--json', action='store_true')
+    r.set_defaults(fn=_cmd_slo_report)
+    c = slo_sub.add_parser(
+        'check', help='gate a log against a committed SLO baseline '
+                      '(exit 1 on violations, each naming metric and '
+                      'tenant)')
+    c.add_argument('logs', nargs='+')
+    c.add_argument('--against', required=True,
+                   help='committed SLO_BASELINE.json (its embedded '
+                        'spec is the contract checked)')
+    c.add_argument('--json', action='store_true')
+    c.set_defaults(fn=_cmd_slo_check)
 
     t = sub.add_parser('timeline', help='print one request lifecycle')
     t.add_argument('log')

@@ -40,12 +40,16 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ['SCHEMA_VERSION', 'EVENT_SCHEMA', 'EventLog', 'emit',
-           'get_active', 'set_active', 'activate', 'open_from_env',
-           'read_events', 'validate_record', 'validate_file',
-           'ENV_VAR']
+__all__ = ['SCHEMA_VERSION', 'SUPPORTED_SCHEMAS', 'EVENT_SCHEMA',
+           'EventLog', 'emit', 'get_active', 'set_active', 'activate',
+           'open_from_env', 'read_events', 'merge_events',
+           'remove_log', 'validate_record', 'validate_file', 'ENV_VAR']
 
-SCHEMA_VERSION = 1
+# v2 added the required `tenant` field on serve.admit / serve.reject
+# (multi-tenant SLO accounting); v1 logs predate tenancy and stay
+# readable — validation exempts them from the v2-only fields.
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = (1, 2)
 
 ENV_VAR = 'DDP_TPU_EVENT_LOG'
 
@@ -60,8 +64,11 @@ EVENT_SCHEMA = {
     # KV-pool exhaustion — static impossibility at submit, or spent
     # preemption retries stamped on the terminal evict/retire),
     # prefix_unregistered (unknown/unregistered shared prefix).
-    'serve.admit': ('request_id', 'slot'),
-    'serve.reject': ('request_id', 'reason'),
+    # `tenant` (schema >= 2): the tenant label load/SLO accounting
+    # groups by — every admit/reject carries it, so per-tenant goodput
+    # is derivable from the log alone (obs/slo.py).
+    'serve.admit': ('request_id', 'slot', 'tenant'),
+    'serve.reject': ('request_id', 'reason', 'tenant'),
     'serve.evict': ('request_id', 'slot'),
     'serve.prefill': ('request_id', 'slot', 'pos'),
     'serve.decode': ('request_id', 'slot', 'token_index'),
@@ -97,8 +104,22 @@ EVENT_SCHEMA = {
     # `perf check` found a per-entry tolerance violation against the
     # committed baseline (entry = registry name, metric = which gate).
     'perf.regression': ('entry', 'metric'),
+    # -- SLO observatory (obs/slo.py) ----------------------------------
+    # `slo check` found goodput below the committed SLO_BASELINE.json
+    # tolerance (`metric` names the gate; `tenant` is present on
+    # per-tenant violations, None on the aggregate one).
+    'slo.violation': ('metric',),
     # -- swallowed exceptions (utils.tracing.log_exception) ------------
     'exception': ('context', 'type'),
+}
+
+
+# Fields that became REQUIRED at schema v2: records stamped with an
+# older version are exempt (a pre-tenancy log stays schema-clean), new
+# emits are not.
+_V2_FIELDS = {
+    'serve.admit': ('tenant',),
+    'serve.reject': ('tenant',),
 }
 
 
@@ -106,14 +127,16 @@ def validate_record(rec):
     """Schema-check one decoded record; returns a list of error strings
     (empty = valid). Shared by :meth:`EventLog.emit` and the offline
     validator CLI, so the write-side and read-side contracts cannot
-    drift apart."""
+    drift apart. Records from any :data:`SUPPORTED_SCHEMAS` version
+    validate against THAT version's requirements — old logs don't rot
+    when the vocabulary grows."""
     errors = []
     if not isinstance(rec, dict):
         return [f'record is not an object: {rec!r}']
     schema = rec.get('schema')
-    if schema != SCHEMA_VERSION:
+    if schema not in SUPPORTED_SCHEMAS:
         errors.append(f'unknown schema version {schema!r} '
-                      f'(expected {SCHEMA_VERSION})')
+                      f'(supported: {SUPPORTED_SCHEMAS})')
     event = rec.get('event')
     if event not in EVENT_SCHEMA:
         errors.append(f'unknown event {event!r}')
@@ -121,8 +144,10 @@ def validate_record(rec):
     for field in ('seq', 'ts'):
         if field not in rec:
             errors.append(f'{event}: missing envelope field {field!r}')
+    exempt = (_V2_FIELDS.get(event, ())
+              if isinstance(schema, int) and schema < 2 else ())
     for field in EVENT_SCHEMA[event]:
-        if field not in rec:
+        if field not in rec and field not in exempt:
             errors.append(f'{event}: missing required field {field!r}')
     return errors
 
@@ -310,6 +335,17 @@ def emit(event, _log: Optional[EventLog] = None, **fields):
     return log.emit(event, **fields)
 
 
+def remove_log(path):
+    """Delete a log AND its rotated set — the fresh-file guarantee a
+    one-shot run wants before opening its EventLog (which otherwise
+    APPENDS, resuming the seq series; a stale previous run would then
+    double every reconstructed timeline). Owns the rotation naming so
+    callers don't hardcode it."""
+    path = os.fspath(path)
+    for p in _log_files(path):
+        os.remove(p)
+
+
 def open_from_env(environ=None) -> Optional[EventLog]:
     """An :class:`EventLog` at ``$DDP_TPU_EVENT_LOG``, or None when the
     knob is unset — how shell drivers (scripts/smoke_serve.sh) attach a
@@ -364,6 +400,50 @@ def read_events(source):
                         f'{fname}:{li + 1}: corrupt event line '
                         f'(not the crash-torn tail): {line[:80]!r}')
     return sorted(records, key=lambda r: r.get('seq', 0))
+
+
+def merge_events(sources):
+    """Merge the event streams of several logs — one per serving
+    replica (ROADMAP item 2: a request's prefill and decode happen in
+    different pools, so its lifecycle spans two JSONL files) — into ONE
+    seq-consistent record list.
+
+    ``sources`` is an iterable of log paths (each read through
+    :func:`read_events`, so rotated sets and a crash-torn tail on any
+    source are handled) or ``(replica, path)`` pairs naming the source;
+    bare paths get ``r0, r1, ...`` labels. Every returned record is
+    annotated with its ``replica`` label.
+
+    Ordering contract: within one source, per-source ``seq`` stays
+    authoritative (records of a source never reorder relative to each
+    other, whatever their timestamps — a replica's own clock can
+    stutter). Across sources, heads are merged by ``(ts, source
+    index)`` — a stable k-way merge, so equal timestamps resolve in
+    source order and the merge is deterministic."""
+    streams = []
+    for i, src in enumerate(sources):
+        if isinstance(src, (tuple, list)) and len(src) == 2:
+            label, path = src
+        else:
+            label, path = f'r{i}', src
+        recs = read_events(path)
+        for rec in recs:
+            rec.setdefault('replica', str(label))
+        streams.append(recs)
+    merged = []
+    heads = [0] * len(streams)
+    while True:
+        best = None
+        for si, recs in enumerate(streams):
+            if heads[si] >= len(recs):
+                continue
+            key = (recs[heads[si]].get('ts', 0), si)
+            if best is None or key < best:
+                best, bi = key, si
+        if best is None:
+            return merged
+        merged.append(streams[bi][heads[bi]])
+        heads[bi] += 1
 
 
 def validate_file(path):

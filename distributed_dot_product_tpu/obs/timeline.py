@@ -21,9 +21,12 @@ alone" a standing contract rather than a hope.
 """
 
 import dataclasses
+import os
 from typing import Dict, List, Optional
 
-from distributed_dot_product_tpu.obs.events import read_events
+from distributed_dot_product_tpu.obs.events import (
+    EventLog, merge_events, read_events,
+)
 
 __all__ = ['Timeline', 'timeline', 'reconstruct']
 
@@ -44,6 +47,13 @@ class Timeline:
     events: List[dict]
     status: Optional[str] = None       # terminal status, None if absent
     reason: Optional[str] = None
+    # Tenant label (schema v2 admit/reject/retire events carry it) —
+    # what per-tenant goodput accounting (obs/slo.py) groups by.
+    tenant: Optional[str] = None
+    # Replica labels this request's events came from (multi-source
+    # merge_events reconstruction): a disaggregated request's timeline
+    # legitimately spans a prefill pool and a decode pool.
+    replicas: List[str] = dataclasses.field(default_factory=list)
     complete: bool = False
     errors: List[str] = dataclasses.field(default_factory=list)
     queue_wait: Optional[float] = None
@@ -77,12 +87,29 @@ class Timeline:
         return out
 
 
+def _reset_delivered_latency(tl: Timeline):
+    """A requeue (quarantine or preemption) DISCARDS the attempt's
+    stream — the retry regenerates it from scratch. The timeline's
+    latency verdict describes the DELIVERED stream, so the aborted
+    attempt's TTFT and gaps are dropped here; the next stamped TTFT
+    (still measured from the ORIGINAL submit on the scheduler's clock
+    — _commit_token anchors at submitted_at) wins. ``tokens`` stays
+    cumulative: work done is work done, delivered or not."""
+    tl.ttft = None
+    tl.token_gaps = []
+
+
 def _validate(tl: Timeline):
     """Run the lifecycle automaton over ``tl.events`` (already
     seq-sorted), populating status/errors/derived fields."""
     state = 'submitted'     # submitted -> running -> (queued ->) done
     for rec in tl.events:
         ev = rec['event']
+        if tl.tenant is None and rec.get('tenant') is not None:
+            tl.tenant = rec['tenant']
+        replica = rec.get('replica')
+        if replica is not None and replica not in tl.replicas:
+            tl.replicas.append(replica)
         if state == 'done':
             tl.errors.append(f'event {ev} after terminal state')
             continue
@@ -111,12 +138,16 @@ def _validate(tl: Timeline):
                 # Quarantine frees the slot: a requeued request must be
                 # re-admitted; an exhausted one goes straight to retire.
                 state = 'queued' if rec.get('requeued') else 'running'
+                if rec.get('requeued'):
+                    _reset_delivered_latency(tl)
             elif ev == 'serve.preempt':
                 # Page-pool preemption: same slot-freeing arc as a
                 # quarantine (requeued → re-admit; exhausted retries →
                 # the terminal evict/retire follows while 'running').
                 tl.preempts += 1
                 state = 'queued' if rec.get('requeued') else 'running'
+                if rec.get('requeued'):
+                    _reset_delivered_latency(tl)
         elif ev == 'serve.retire':
             tl.status = rec.get('status')
             tl.reason = rec.get('reason')
@@ -144,12 +175,31 @@ def _validate(tl: Timeline):
     return tl
 
 
+def _is_multi_source(source):
+    """A list/tuple of log paths (or ``(replica, path)`` pairs) — as
+    opposed to a list of already-decoded records, which read_events
+    handles directly."""
+    if not isinstance(source, (list, tuple)) or not source:
+        return False
+    first = source[0]
+    if isinstance(first, (str, os.PathLike, EventLog)):
+        return True
+    return (isinstance(first, (tuple, list)) and len(first) == 2
+            and isinstance(first[1], (str, os.PathLike)))
+
+
 def reconstruct(source) -> Dict[str, Timeline]:
     """Rebuild EVERY request's timeline from ``source`` (an EventLog, a
-    log path — rotated set included — or decoded records). Returns
+    log path — rotated set included — or decoded records). A LIST of
+    paths (or ``(replica, path)`` pairs) reconstructs across the merged
+    multi-replica stream (:func:`~distributed_dot_product_tpu.obs
+    .events.merge_events`): one request's timeline may then span a
+    prefill pool's log and a decode pool's. Returns
     ``{request_id: Timeline}``."""
+    records = (merge_events(source) if _is_multi_source(source)
+               else read_events(source))
     per_request: Dict[str, List[dict]] = {}
-    for rec in read_events(source):
+    for rec in records:
         rid = rec.get('request_id')
         ev = rec.get('event', '')
         if rid is not None and (ev.startswith('serve.')

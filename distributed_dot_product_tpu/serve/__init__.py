@@ -16,6 +16,10 @@ Composition (each piece standalone-testable):
   retire) with the evict-before-reject ladder and quarantine/requeue.
 - :mod:`~distributed_dot_product_tpu.serve.health` — heartbeat
   watchdog, liveness/readiness transitions, metrics snapshot.
+- :mod:`~distributed_dot_product_tpu.serve.loadgen` — seeded open-loop
+  traffic generator (Poisson/bursty arrivals, heavy-tailed length
+  mixes, tenant shares) driving the scheduler on a virtual clock; the
+  measurement substrate for SLO/goodput accounting (obs/slo.py).
 """
 
 from distributed_dot_product_tpu.serve.admission import (  # noqa: F401
@@ -28,10 +32,17 @@ from distributed_dot_product_tpu.serve.engine import (  # noqa: F401
 from distributed_dot_product_tpu.serve.health import (  # noqa: F401
     HealthMonitor, Liveness, Readiness,
 )
+from distributed_dot_product_tpu.serve.loadgen import (  # noqa: F401
+    Arrival, LoadGenConfig, LoadResult, TenantSpec, VirtualClock,
+    default_tenants, generate_trace, run_load, run_trace,
+)
 from distributed_dot_product_tpu.serve.scheduler import (  # noqa: F401
     Scheduler, ServeConfig,
 )
 
 __all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
            'Request', 'RequestResult', 'KernelEngine', 'HealthMonitor',
-           'Liveness', 'Readiness', 'Scheduler', 'ServeConfig']
+           'Liveness', 'Readiness', 'Scheduler', 'ServeConfig',
+           'Arrival', 'LoadGenConfig', 'LoadResult', 'TenantSpec',
+           'VirtualClock', 'default_tenants', 'generate_trace',
+           'run_load', 'run_trace']

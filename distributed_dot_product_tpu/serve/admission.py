@@ -77,6 +77,11 @@ class Request:
     deadline: Optional[float] = None
     id: str = ''
     submitted_at: float = 0.0
+    # Tenant label for multi-tenant accounting: stamped on every
+    # admit/reject event (EVENT_SCHEMA v2) and keyed into the
+    # tenant-labeled metrics series, so per-tenant goodput is derivable
+    # both live (/metrics) and offline (obs/slo.py).
+    tenant: str = 'default'
     # Paged serving: id of a registered shared prefix the prompt
     # CONTINUES (the prompt tokens come after it), and its length —
     # admission budgets against prefix_len + len(prompt).
@@ -97,6 +102,7 @@ class Request:
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if not self.id:
             self.id = f'req-{next(_ids)}'
+        self.tenant = str(self.tenant or 'default')
 
 
 @dataclasses.dataclass
@@ -115,6 +121,7 @@ class RequestResult:
     requeues: int = 0
     degraded: bool = False
     finished_at: float = 0.0
+    tenant: str = 'default'
 
 
 class AdmissionController:
@@ -140,6 +147,7 @@ class AdmissionController:
         self.clock = clock
         self.event_log = event_log
         self._queue = collections.deque()
+        self._registry = registry
         if registry is not None:
             self._c_admit = registry.counter('serve.admitted')
             self._c_degraded = registry.counter('serve.degraded')
@@ -149,6 +157,14 @@ class AdmissionController:
         else:
             self._c_admit = self._c_degraded = self._g_depth = None
             self._c_reject = {}
+
+    def _count_tenant(self, name, tenant):
+        """Bump the tenant-labeled twin of an admit/reject counter —
+        same family name, ``tenant=`` label (the exporter renders both;
+        external Prometheus computes per-tenant goodput from the
+        labeled series)."""
+        if self._registry is not None and tenant is not None:
+            self._registry.counter(name, labels={'tenant': tenant}).inc()
 
     # -- introspection --------------------------------------------------
     @property
@@ -175,34 +191,40 @@ class AdmissionController:
             log.emit(event, **fields)
 
     def _reject(self, reason: RejectReason, message: str,
-                request_id=None):
+                request_id=None, tenant=None):
         if reason in self._c_reject:
             self._c_reject[reason].inc()
+        self._count_tenant(f'serve.rejected.{reason.value}',
+                           tenant or 'default')
         if request_id is not None:
             # Submit-time shed: the request's entire recorded lifecycle
             # is this one typed event.
             self._emit('serve.reject', request_id=request_id,
-                       reason=reason.value, queued=False)
+                       reason=reason.value, queued=False,
+                       tenant=tenant or 'default')
         raise RejectedError(reason, message)
 
     def reject(self, reason: RejectReason, message: str,
-               request_id=None):
+               request_id=None, tenant=None):
         """Public typed shed: counter + submit-time event + raise —
         for reject conditions the CALLER owns (the scheduler's paged
         checks), so they account exactly like queue/deadline sheds."""
-        self._reject(reason, message, request_id=request_id)
+        self._reject(reason, message, request_id=request_id,
+                     tenant=tenant)
 
     def reject_count(self, reason: RejectReason):
         c = self._c_reject.get(reason)
         return c.value if c is not None else 0
 
-    def count_reject(self, reason: RejectReason):
+    def count_reject(self, reason: RejectReason, tenant=None):
         """Count a scheduler-owned shed that is FINALIZED rather than
         raised (tick-time rejects of already-queued requests): same
         counters as submit-time sheds, no exception — dashboards see
         every typed reject however it was delivered."""
         if reason in self._c_reject:
             self._c_reject[reason].inc()
+        self._count_tenant(f'serve.rejected.{reason.value}',
+                           tenant or 'default')
 
     # -- admission ------------------------------------------------------
     def validate(self, request: Request, now=None):
@@ -214,7 +236,8 @@ class AdmissionController:
         if request.deadline is not None and request.deadline <= now:
             self._reject(RejectReason.DEADLINE_EXCEEDED,
                          f'request {request.id}: deadline already passed '
-                         f'at submit', request_id=request.id)
+                         f'at submit', request_id=request.id,
+                         tenant=request.tenant)
         full_len = request.prefix_len + len(request.prompt)
         room = self.t_max - full_len
         if len(request.prompt) < 1 or room < 1:
@@ -222,7 +245,8 @@ class AdmissionController:
                          f'request {request.id}: prompt of '
                          f'{full_len} tokens (prefix included) leaves '
                          f'no room to generate in a t_max={self.t_max} '
-                         f'cache', request_id=request.id)
+                         f'cache', request_id=request.id,
+                         tenant=request.tenant)
         if self.capacity_tokens is not None \
                 and full_len + 1 > self.capacity_tokens:
             # Statically impossible however long it waits: the POOL
@@ -231,7 +255,7 @@ class AdmissionController:
                          f'request {request.id}: {full_len} prompt rows '
                          f'+ 1 exceed the page pool\'s '
                          f'{self.capacity_tokens}-row capacity',
-                         request_id=request.id)
+                         request_id=request.id, tenant=request.tenant)
         self.clamp_budget(request)
 
     def clamp_budget(self, request: Request):
@@ -248,13 +272,14 @@ class AdmissionController:
         request.max_new_tokens = max(1, min(request.max_new_tokens,
                                             self.max_new_tokens, room))
 
-    def count_admit(self):
+    def count_admit(self, tenant=None):
         """Count an admission that never crossed the queue (the
         scheduler's ``fork`` places the branch straight into a slot):
         same counter as queued admissions, so in-flight accounting over
         admitted − terminal stays balanced when fork is used."""
         if self._c_admit is not None:
             self._c_admit.inc()
+        self._count_tenant('serve.admitted', tenant)
 
     def maybe_degrade(self, request: Request, pressure=None):
         """Above the pressure watermark, cap the request's token budget
@@ -276,11 +301,13 @@ class AdmissionController:
         if self.full:
             self._reject(RejectReason.QUEUE_FULL,
                          f'request {request.id}: queue at limit '
-                         f'{self.queue_limit}', request_id=request.id)
+                         f'{self.queue_limit}', request_id=request.id,
+                         tenant=request.tenant)
         request.queued_since = self.clock()
         self._queue.append(request)
         if self._c_admit is not None:
             self._c_admit.inc()
+        self._count_tenant('serve.admitted', request.tenant)
         self._update_depth()
 
     def push_front(self, request: Request):

@@ -262,6 +262,21 @@ class Scheduler:
         self._h_ttft = reg.histogram('serve.ttft_seconds')
         self._h_token = reg.histogram('serve.token_seconds')
         self._h_request = reg.histogram('serve.request_seconds')
+        # Tenant-labeled twins of the latency histograms, created
+        # lazily per tenant seen and cached here (registry get-or-
+        # create takes a lock — not a per-token cost we want).
+        self._tenant_series: Dict[tuple, object] = {}
+
+    def _tenant_hist(self, name, tenant):
+        """The ``tenant=``-labeled series of a latency family — same
+        family name as the aggregate, so /metrics renders per-tenant
+        quantiles/buckets an external Prometheus can alert on."""
+        key = (name, tenant)
+        h = self._tenant_series.get(key)
+        if h is None:
+            h = self._tenant_series[key] = self.registry.histogram(
+                name, labels={'tenant': tenant})
+        return h
 
     def _resolve_proposer(self):
         """Build the configured proposer: cfg.spec wins, else the
@@ -301,13 +316,15 @@ class Scheduler:
 
     # -- submission surface --------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, deadline=None,
-               request_id=None, prefix_id=None) -> Request:
+               request_id=None, prefix_id=None, tenant=None) -> Request:
         """Admit one request or raise a typed
         :class:`~distributed_dot_product_tpu.serve.admission
         .RejectedError`. Applies the full backpressure ladder (degrade →
         evict → reject). ``prefix_id`` (paged engines): a registered
         shared prefix the prompt CONTINUES — its pages are shared, the
-        budget math covers prefix + prompt."""
+        budget math covers prefix + prompt. ``tenant`` labels the
+        request for multi-tenant accounting (admit/reject events,
+        tenant-labeled metrics; default tenant ``'default'``)."""
         if prefix_id is not None and not self._paged:
             raise ValueError("prefix_id needs a paged engine "
                              "(cache_mode='paged')")
@@ -315,7 +332,7 @@ class Scheduler:
                       max_new_tokens=max_new_tokens
                       or self.cfg.max_new_tokens,
                       deadline=deadline, id=request_id or '',
-                      prefix_id=prefix_id)
+                      prefix_id=prefix_id, tenant=tenant or 'default')
         req.submitted_at = self.clock()
         try:
             if prefix_id is not None:
@@ -326,7 +343,8 @@ class Scheduler:
                     self.admission.reject(
                         RejectReason.PREFIX_UNREGISTERED,
                         f'request {req.id}: prefix id {prefix_id!r} '
-                        f'is not registered', request_id=req.id)
+                        f'is not registered', request_id=req.id,
+                        tenant=req.tenant)
             self.admission.validate(req)
             self.admission.maybe_degrade(req, pressure=self._pressure())
             if self.admission.full and self.cfg.evict_before_reject:
@@ -365,16 +383,17 @@ class Scheduler:
             # never a retire (it never held a slot).
             self._emit('serve.reject', request_id=req.id,
                        reason=reason.value if reason else None,
-                       queued=True)
+                       queued=True, tenant=req.tenant)
         else:
             self._emit('serve.retire', request_id=req.id, status=status,
                        reason=reason.value if reason else None,
-                       tokens=len(req.tokens), total_seconds=total)
+                       tokens=len(req.tokens), total_seconds=total,
+                       tenant=req.tenant)
         self.results[req.id] = RequestResult(
             id=req.id, status=status, tokens=list(req.tokens),
             prompt_len=len(req.prompt), reason=reason,
             requeues=req.requeues, degraded=req.degraded,
-            finished_at=finished_at)
+            finished_at=finished_at, tenant=req.tenant)
 
     def _observe_slot_pages(self, slot: _Slot):
         if self._paged:
@@ -510,11 +529,11 @@ class Scheduler:
                       or orig.max_new_tokens,
                       deadline=orig.deadline, id=request_id_new or '',
                       prefix_id=orig.prefix_id,
-                      prefix_len=orig.prefix_len)
+                      prefix_len=orig.prefix_len, tenant=orig.tenant)
         # Same budget policy admission applies at submit — one clamp,
         # shared, so the two entry points can never drift.
         self.admission.clamp_budget(req)
-        self.admission.count_admit()
+        self.admission.count_admit(tenant=req.tenant)
         req.submitted_at = now
         req.queued_since = now
         req.admitted_at = now
@@ -534,7 +553,7 @@ class Scheduler:
         self._spec_start(free)
         self._emit('serve.admit', request_id=req.id, slot=free.index,
                    queue_wait=0.0, prompt_len=len(req.prompt),
-                   requeues=0, fork_of=orig.id)
+                   requeues=0, fork_of=orig.id, tenant=req.tenant)
         return req
 
     def _evict_longest_idle(self, exclude=()):
@@ -596,7 +615,8 @@ class Scheduler:
             # see the pin — it only knows raw pool capacity). Waiting
             # would stall the head of the line for every later
             # request; reject with the typed reason instead.
-            self.admission.count_reject(RejectReason.CACHE_EXHAUSTED)
+            self.admission.count_reject(RejectReason.CACHE_EXHAUSTED,
+                                        tenant=req.tenant)
             self._finalize_request(req, 'rejected',
                                    RejectReason.CACHE_EXHAUSTED)
             return 'rejected'
@@ -610,7 +630,7 @@ class Scheduler:
                 # Unregistered while the request sat queued: a typed
                 # terminal, never a KeyError crashing the tick.
                 self.admission.count_reject(
-                    RejectReason.PREFIX_UNREGISTERED)
+                    RejectReason.PREFIX_UNREGISTERED, tenant=req.tenant)
                 self._finalize_request(
                     req, 'rejected', RejectReason.PREFIX_UNREGISTERED)
                 return 'rejected'
@@ -665,10 +685,12 @@ class Scheduler:
             wait = max(0.0, now - queued_since)
             req.admitted_at = now
             self._h_queue.observe(wait)
+            self._tenant_hist('serve.queue_wait_seconds',
+                              req.tenant).observe(wait)
             self._emit('serve.admit', request_id=req.id,
                        slot=slot.index, queue_wait=wait,
                        prompt_len=len(req.prompt),
-                       requeues=req.requeues)
+                       requeues=req.requeues, tenant=req.tenant)
             if len(req.prompt) == 1:
                 slot.state = _SlotState.ACTIVE
                 slot.input_token = int(req.prompt[-1])
@@ -723,11 +745,15 @@ class Scheduler:
             req.first_token_at = now
             ttft = max(0.0, now - req.submitted_at)
             self._h_ttft.observe(ttft)
+            self._tenant_hist('serve.ttft_seconds',
+                              req.tenant).observe(ttft)
             self._ttft_dirty = True
             token_fields['ttft'] = ttft
         elif slot.last_token_at is not None:
             gap = max(0.0, now - slot.last_token_at)
             self._h_token.observe(gap)
+            self._tenant_hist('serve.token_seconds',
+                              req.tenant).observe(gap)
             token_fields['gap'] = gap
         slot.last_token_at = now
         self._emit('serve.decode', **token_fields)

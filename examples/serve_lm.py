@@ -117,6 +117,55 @@ def run_burst(args, *, fault_injector, deadline_every=0):
     return sched, registry, submitted, rejected, results, wall
 
 
+def run_load_demo(args):
+    """``--load SEED``: a seeded open-loop trace (serve/loadgen.py)
+    through the scheduler on a virtual clock, goodput report printed
+    at exit. Exit 0 iff every submitted request is classified exactly
+    once from the event log alone."""
+    import tempfile
+
+    from distributed_dot_product_tpu.obs import slo as obs_slo
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, LoadGenConfig, ServeConfig, VirtualClock,
+        run_load,
+    )
+
+    clock = VirtualClock()
+    log_path = args.event_log or os.path.join(
+        tempfile.gettempdir(), f'serve_lm_load_{os.getpid()}.jsonl')
+    obs.remove_log(log_path)    # EventLog appends; a demo wants fresh
+    event_log = obs.EventLog(log_path, clock=clock)
+    cfg = LoadGenConfig(seed=args.load, rate=args.load_rate,
+                        requests=args.requests, vocab=args.vocab)
+    engine = KernelEngine(slots=args.slots, t_max=args.t_max,
+                          vocab=args.vocab,
+                          prefill_chunk=args.prefill_chunk,
+                          seed=args.seed)
+    res = run_load(cfg, engine=engine,
+                   serve_config=ServeConfig(
+                       queue_limit=args.queue_limit,
+                       max_new_tokens=max(t.new_hi
+                                          for t in cfg.tenants),
+                       watchdog=False),
+                   registry=MetricsRegistry(), event_log=event_log,
+                   clock=clock)
+    event_log.close()
+    spec = obs_slo.SloSpec(ttft=0.25, per_token=0.05)
+    report = obs_slo.goodput(log_path, spec)
+    print(f'loadgen seed={args.load}: {len(res.submitted)} requests '
+          f'over {res.virtual_seconds:.2f} virtual seconds '
+          f'({res.wall_seconds:.2f}s wall, {res.ticks} ticks)')
+    print(obs_slo.render_report(report))
+    print(f'event log: {log_path}')
+    ok = (res.accounted
+          and report.requests == len(res.submitted)
+          and sum(report.counts.values()) == report.requests)
+    print(f'serve_lm --load {"OK" if ok else "AUDIT FAILED"}: '
+          f'{report.requests}/{len(res.submitted)} requests '
+          f'classified from the event log alone')
+    return 0 if ok else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument('--slots', type=int, default=4)
@@ -142,7 +191,21 @@ def main(argv=None):
                         '(default: $DDP_TPU_EVENT_LOG); the audit then '
                         'additionally requires every request timeline '
                         'to be reconstructable from the log alone')
+    p.add_argument('--load', type=int, default=None, metavar='SEED',
+                   help='instead of the fixed burst, run a small '
+                        'seeded open-loop loadgen trace (virtual '
+                        'clock, two tenants) through the scheduler '
+                        'and print the goodput-under-SLO report at '
+                        'exit — the runnable demo of the load/SLO '
+                        'observatory (README "Load testing & SLO '
+                        'accounting")')
+    p.add_argument('--load-rate', type=float, default=600.0,
+                   help='--load: offered rate, requests per VIRTUAL '
+                        'second')
     args = p.parse_args(argv)
+
+    if args.load is not None:
+        return run_load_demo(args)
 
     plan = faults_lib.serve_plan_from_env()
     if plan.burst:

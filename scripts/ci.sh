@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/6] ruff (generic hygiene) ==='
+echo '=== [1/7] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,10 +27,10 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/6] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/7] graphlint (jaxpr/domain contracts) ==='
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/6] tier-1 tests ==='
+echo '=== [3/7] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -38,7 +38,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/6] smoke serve + event-log schema validation ==='
+echo '=== [4/7] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -48,7 +48,7 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/6] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+echo '=== [5/7] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
 # Speculative decoding's exactness guarantee, proven on a real burst
 # through the ENV knob a deployment would flip: the same traffic served
 # with the n-gram proposer (verify-k steps) and without (plain n=1
@@ -106,7 +106,32 @@ print(f'spec smoke OK: {len(base)} streams bit-identical, '
 PY
 fi
 
-echo '=== [6/6] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [6/7] serve-load smoke + SLO goodput gate ==='
+# A seeded open-loop trace (virtual clock — minutes of simulated
+# traffic in seconds of wall time, CPU-deterministic) drives the
+# scheduler, then the goodput report computed FROM THE EVENT LOG ALONE
+# is gated against the committed SLO_BASELINE.json (generous
+# tolerances; every violation names the metric and tenant). The
+# benchmark's serve-load flag DEFAULTS are the smoke config — on an
+# intentional serving/load change refresh the baseline in the same
+# diff:
+#   python benchmark.py --mode serve-load --event-log /tmp/slo.jsonl
+#   python -m distributed_dot_product_tpu.obs slo report /tmp/slo.jsonl \
+#       --spec SLO_BASELINE.json --baseline-out SLO_BASELINE.json
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping serve-load stage'
+else
+    slo_log="$(mktemp -u /tmp/ddp_slo_smoke.XXXXXX).jsonl"
+    slo_row="$(mktemp /tmp/ddp_slo_row.XXXXXX.json)"
+    rm -f "$slo_row"    # benchmark.py appends into a fresh JSON file
+    { JAX_PLATFORMS=cpu python benchmark.py --mode serve-load \
+          --event-log "$slo_log" --file "$slo_row" \
+      && JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.obs \
+          slo check "$slo_log" --against SLO_BASELINE.json; } || rc=1
+    rm -f "$slo_log" "$slo_row"
+fi
+
+echo '=== [7/7] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for

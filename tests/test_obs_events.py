@@ -36,7 +36,7 @@ def _log(tmp_path, **kw):
 def test_emit_envelope_and_readback(tmp_path):
     with _log(tmp_path) as log:
         rec = log.emit('serve.admit', request_id='r0', slot=1,
-                       queue_wait=0.25)
+                       tenant='default', queue_wait=0.25)
     (got,) = read_events(tmp_path / 'events.jsonl')
     assert got == rec
     assert got['schema'] == events.SCHEMA_VERSION
@@ -49,9 +49,11 @@ def test_unknown_event_and_missing_field_raise(tmp_path):
         with pytest.raises(ValueError, match='unknown event'):
             log.emit('serve.frobnicate', request_id='r0')
         with pytest.raises(ValueError, match='required field'):
-            log.emit('serve.admit', request_id='r0')   # no slot
+            log.emit('serve.admit', request_id='r0',
+                     tenant='default')   # no slot
         # Failed emits consume no seq and write no line.
-        log.emit('serve.admit', request_id='r0', slot=0)
+        log.emit('serve.admit', request_id='r0', slot=0,
+                 tenant='default')
     (got,) = read_events(tmp_path / 'events.jsonl')
     assert got['seq'] == 0
 
@@ -272,10 +274,14 @@ def test_cli_stats_counts_rate_and_files(tmp_path, capsys):
         t[0] += 0.5
         return t[0]
 
-    with EventLog(path, clock=clock, rotate_bytes=256,
+    # rotate_bytes sized so the whole run FITS in the rotated set
+    # (keep_rotations + live): schema v2 admit lines carry `tenant`,
+    # and a dropped oldest file would shrink the counted events.
+    with EventLog(path, clock=clock, rotate_bytes=512,
                   keep_rotations=3) as log:
         for i in range(12):
-            log.emit('serve.admit', request_id=f'r{i}', slot=0)
+            log.emit('serve.admit', request_id=f'r{i}', slot=0,
+                     tenant='default')
         log.emit('serve.retire', request_id='r0', status='completed')
     rc, out = _cli_main(['stats', str(path)], capsys)
     assert rc == 0
@@ -303,7 +309,8 @@ def test_cli_stats_unreadable_log_exits_nonzero(tmp_path, capsys):
 def test_cli_timeline_json_full_records(tmp_path, capsys):
     path = tmp_path / 'events.jsonl'
     with EventLog(path) as log:
-        log.emit('serve.admit', request_id='r1', slot=0, queue_wait=0.0)
+        log.emit('serve.admit', request_id='r1', slot=0,
+                 tenant='default', queue_wait=0.0)
         log.emit('serve.decode', request_id='r1', slot=0,
                  token_index=0, ttft=0.01)
         log.emit('serve.retire', request_id='r1', status='completed',
@@ -315,3 +322,57 @@ def test_cli_timeline_json_full_records(tmp_path, capsys):
     # Machine-readable form carries the FULL records, not (seq, event).
     assert payload['events'][0]['event'] == 'serve.admit'
     assert payload['events'][0]['request_id'] == 'r1'
+
+
+def test_schema_v2_tenant_requirement_and_v1_backcompat(tmp_path):
+    # Emit side writes v2: tenant is REQUIRED on admit/reject.
+    with _log(tmp_path) as log:
+        with pytest.raises(ValueError, match='tenant'):
+            log.emit('serve.admit', request_id='r0', slot=0)
+        with pytest.raises(ValueError, match='tenant'):
+            log.emit('serve.reject', request_id='r0',
+                     reason='queue_full')
+        log.emit('serve.admit', request_id='r0', slot=0, tenant='t0')
+    # A v1 record WITHOUT tenant still validates (old logs don't rot)…
+    v1 = {'schema': 1, 'seq': 0, 'ts': 0.0, 'event': 'serve.admit',
+          'request_id': 'r0', 'slot': 0}
+    assert validate_record(v1) == []
+    # …while the same shape stamped v2 does not.
+    v2 = dict(v1, schema=2)
+    assert any('tenant' in e for e in validate_record(v2))
+    # Unsupported versions are named with the supported set.
+    errs = validate_record(dict(v1, schema=3))
+    assert any('unknown schema version' in e for e in errs)
+    # slo.violation joined the closed vocabulary.
+    assert events.EVENT_SCHEMA['slo.violation'] == ('metric',)
+
+
+def test_cli_stats_percentiles(tmp_path, capsys):
+    path = tmp_path / 'lat.jsonl'
+    with EventLog(path) as log:
+        for i, (ttft, gap) in enumerate([(0.01, 0.002), (0.03, 0.004),
+                                         (0.05, 0.006)]):
+            rid = f'r{i}'
+            log.emit('serve.admit', request_id=rid, slot=0,
+                     tenant='t0', queue_wait=0.1 * (i + 1))
+            log.emit('serve.decode', request_id=rid, slot=0,
+                     token_index=0, ttft=ttft)
+            log.emit('serve.decode', request_id=rid, slot=0,
+                     token_index=1, gap=gap)
+            log.emit('serve.retire', request_id=rid,
+                     status='completed', total_seconds=1.0)
+    rc, out = _cli_main(['stats', str(path), '--percentiles',
+                         '--json'], capsys)
+    assert rc == 0
+    [rep] = json.loads(out)
+    lat = rep['latency_percentiles']
+    assert lat['ttft']['count'] == 3
+    assert lat['ttft']['p50'] == pytest.approx(0.03)
+    assert lat['ttft']['p99'] == pytest.approx(0.05)
+    assert lat['queue_wait']['p50'] == pytest.approx(0.2)
+    assert lat['gap']['count'] == 3
+    assert lat['gap']['p95'] == pytest.approx(0.006)
+    # Human rendering carries the same numbers in ms.
+    rc, out = _cli_main(['stats', str(path), '--percentiles'], capsys)
+    assert rc == 0
+    assert 'ttft' in out and 'p95=' in out and 'queue_wait' in out

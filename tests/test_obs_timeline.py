@@ -140,7 +140,8 @@ def test_fault_cocktail_fully_reconstructable_from_event_log(tmp_path,
 
 def test_timeline_helper_on_missing_request(tmp_path):
     log = EventLog(tmp_path / 'x.jsonl')
-    log.emit('serve.admit', request_id='r0', slot=0)
+    log.emit('serve.admit', request_id='r0', slot=0,
+             tenant='default')
     log.close()
     tl = timeline('never-submitted', log.path)
     assert not tl.complete and tl.errors == ['no events recorded']
@@ -193,7 +194,11 @@ def test_timeline_validator_rejects_broken_lifecycles():
     tl = tls['d']
     assert tl.complete, tl.errors
     assert tl.admits == 2 and tl.quarantines == 1 and tl.tokens == 3
-    assert tl.queue_wait == 0.1 and tl.ttft == 0.5
+    # The quarantine DISCARDED the first attempt's stream, so the
+    # timeline reports the DELIVERED stream's TTFT (0.9 — stamped by
+    # the retry, still measured from the original submit), not the
+    # aborted attempt's 0.5.
+    assert tl.queue_wait == 0.1 and tl.ttft == 0.9
     assert tl.token_gaps == [0.01]
     assert tl.phases()['total'] == 1.0
 
@@ -241,3 +246,140 @@ def test_scheduler_uses_active_log_when_none_passed(tmp_path, devices):
         sched.close()
     log.close()
     assert reconstruct(log.path)['r'].complete
+
+
+def test_multi_source_merge_spans_prefill_and_decode_pools(tmp_path):
+    """ROADMAP item 2 prereq: one request whose lifecycle spans a
+    prefill pool's log and a decode pool's must reconstruct from the
+    merged pair — per-source seq order preserved, cross-source order
+    by (ts, source), replica labels annotated — with a crash-torn
+    tail on one source tolerated."""
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    pre = EventLog(tmp_path / 'prefill.jsonl', clock=clock)
+    pre.emit('serve.admit', request_id='x', slot=0, tenant='t0',
+             queue_wait=0.01)                                  # ts 1
+    pre.emit('serve.prefill', request_id='x', slot=0, pos=4)   # ts 2
+    dec = EventLog(tmp_path / 'decode.jsonl', clock=clock)
+    dec.emit('serve.decode', request_id='x', slot=2,
+             token_index=0, ttft=0.03)                         # ts 3
+    # Interleave: another prefill-pool request lands BETWEEN the
+    # decode pool's records.
+    pre.emit('serve.admit', request_id='y', slot=1, tenant='t1',
+             queue_wait=0.0)                                   # ts 4
+    dec.emit('serve.decode', request_id='x', slot=2,
+             token_index=1, gap=0.002)                         # ts 5
+    dec.emit('serve.retire', request_id='x', status='completed',
+             total_seconds=0.05, tenant='t0')                  # ts 6
+    pre.emit('serve.retire', request_id='y', status='abandoned',
+             tenant='t1')                                      # ts 7
+    pre.close()
+    dec.close()
+    # Torn tail on the decode source (crash mid-write): tolerated on
+    # read, exactly like the single-log contract.
+    with open(dec.path, 'a', encoding='utf-8') as f:
+        f.write('{"schema": 2, "seq": 99, "ev')
+
+    tls = reconstruct([('prefill', pre.path), ('decode', dec.path)])
+    x = tls['x']
+    assert x.complete, x.errors
+    assert x.status == 'completed' and x.tenant == 't0'
+    assert x.ttft == 0.03 and x.token_gaps == [0.002]
+    assert x.replicas == ['prefill', 'decode']
+    # Merge order: the automaton saw admit -> prefill -> decode ->
+    # decode -> retire (any other order would have errored), and the
+    # merged per-request stream is ts-sorted.
+    assert [r['event'] for r in x.events] == [
+        'serve.admit', 'serve.prefill', 'serve.decode', 'serve.decode',
+        'serve.retire']
+    assert [r['replica'] for r in x.events] == [
+        'prefill', 'prefill', 'decode', 'decode', 'decode']
+    y = tls['y']
+    assert y.complete and y.status == 'abandoned'
+    assert y.replicas == ['prefill']
+
+
+def test_merge_events_stable_on_ts_ties(tmp_path):
+    """Equal timestamps resolve in source order, and records of one
+    source never reorder against each other (seq stays authoritative
+    within a source even when its clock stands still)."""
+    from distributed_dot_product_tpu.obs.events import merge_events
+
+    frozen = lambda: 5.0  # noqa: E731
+    a = EventLog(tmp_path / 'a.jsonl', clock=frozen)
+    a.emit('health.liveness', state='alive')
+    a.emit('health.liveness', state='stalled')
+    b = EventLog(tmp_path / 'b.jsonl', clock=frozen)
+    b.emit('health.readiness', state='ready')
+    a.close()
+    b.close()
+    recs = merge_events([a.path, b.path])
+    assert [(r['replica'], r['seq']) for r in recs] == [
+        ('r0', 0), ('r0', 1), ('r1', 0)]
+
+
+def test_preempt_requeue_spec_completion_arc(tmp_path, devices):
+    """Combined-arc satellite: a request preempted by page exhaustion,
+    requeued, then completed via speculative ticks must reconstruct
+    from the JSONL alone with the preempt + re-admit counted, spec
+    acceptance recorded, and a nonzero TTFT measured from the ORIGINAL
+    submit (the requeue does not reset the request's clock to its
+    first token)."""
+    from distributed_dot_product_tpu.serve import VirtualClock
+
+    clock = VirtualClock()
+    log = EventLog(tmp_path / 'arc.jsonl', clock=clock)
+    eng = KernelEngine(slots=2, t_max=16, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=2, pages=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng,
+        ServeConfig(queue_limit=4, max_new_tokens=8, watchdog=False,
+                    evict_before_reject=False, max_requeues=6,
+                    spec='ngram', spec_k=3),
+        registry=MetricsRegistry(), fault_injector=False,
+        event_log=log, clock=clock,
+        on_tick=lambda s: clock.advance(0.01))
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    results = sched.run_until_idle()
+    sched.close()
+    log.close()
+
+    # Both requests eventually completed (pool frees as the winner
+    # retires; max_requeues is generous enough for the loser).
+    assert {r.status for r in results.values()} == {'completed'}
+    _, errors = validate_file(log.path)
+    assert errors == [], errors
+    tls = reconstruct(log.path)
+    arcs = [t for t in tls.values() if t.preempts]
+    assert arcs, 'page exhaustion never preempted anyone'
+    tl = arcs[0]
+    assert tl.complete, tl.errors
+    assert tl.status == 'completed'
+    assert tl.admits == 1 + tl.preempts     # re-admitted per preempt
+    # The retried stream completed through verify ticks with real
+    # acceptance — the spec arcs fold into the same lifecycle.
+    assert tl.spec_steps > 0
+    assert tl.spec_accepted > 0
+    # TTFT anchored at the ORIGINAL submit: the first committed token
+    # arrived only AFTER the preempt (whose virtual time is the event
+    # ts — same clock), so the stamped TTFT must cover that wait.
+    assert tl.ttft is not None and tl.ttft > 0
+    preempt_ts = min(r['ts'] for r in tl.events
+                     if r['event'] == 'serve.preempt')
+    submit_like = [r for r in tl.events if r['event'] == 'serve.admit']
+    first_admit_ts = min(r['ts'] for r in submit_like)
+    # The DELIVERED stream's first token = the last stamped TTFT (the
+    # earlier attempt's was discarded by the requeue).
+    ttft_decode = [r for r in tl.events
+                   if r['event'] == 'serve.decode'
+                   and r.get('ttft') is not None][-1]
+    assert tl.ttft == ttft_decode['ttft']
+    assert ttft_decode['ts'] >= preempt_ts
+    assert tl.ttft >= ttft_decode['ts'] - first_admit_ts > 0
