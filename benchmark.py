@@ -1060,17 +1060,28 @@ def run_decode_serve(args):
     # --metrics-out: route the serve metrics (TTFT/queue-wait/per-token
     # histograms, counters) into the process registry the snapshot
     # serializes; otherwise keep them isolated from other runs.
-    sched = Scheduler(eng, cfg, on_tick=_on_tick,
-                      registry=(tracing.get_registry()
-                                if getattr(args, 'metrics_out', None)
-                                else MetricsRegistry()))
+    registry = (tracing.get_registry()
+                if getattr(args, 'metrics_out', None)
+                else MetricsRegistry())
+    sched = Scheduler(eng, cfg, on_tick=_on_tick, registry=registry)
+    # Live device telemetry across the scheduled burst (the serving
+    # row, not just a one-shot snapshot at artifact-write time):
+    # device.memory.* gauges land in the row's registry — and so in
+    # --metrics-out — polled while the burst runs.
+    from distributed_dot_product_tpu.obs import DeviceMonitor
+    devmon = DeviceMonitor(registry=registry, interval=0.2).start()
     t0 = _time.perf_counter()
-    with span('benchmark.scheduler_burst', mode='decode-serve'):
-        for i, p in enumerate(prompts):
-            sched.submit(p, request_id=f'b{i}')
-        results = sched.run_until_idle()
-    sched_s = _time.perf_counter() - t0
+    try:
+        with span('benchmark.scheduler_burst', mode='decode-serve'):
+            for i, p in enumerate(prompts):
+                sched.submit(p, request_id=f'b{i}')
+            results = sched.run_until_idle()
+        sched_s = _time.perf_counter() - t0
+    finally:
+        devmon.stop()
     sched.close()
+    devmon.poll_once()      # final poll: end-of-burst device state
+    device_polls = registry.counter('device.memory.polls').value
     n_tok = sum(len(r.tokens) for r in results.values())
     sched_tps = n_tok / sched_s
 
@@ -1098,6 +1109,9 @@ def run_decode_serve(args):
         'completed': sum(r.status == 'completed'
                          for r in results.values()),
         'perf_model': step_model,
+        'device_polls': device_polls,
+        'devices_reporting': registry.gauge(
+            'device.memory.devices_reporting').value,
     }
     if paged:
         record.update({
@@ -1174,10 +1188,19 @@ def run_serve_load(args):
     registry = (tracing.get_registry()
                 if getattr(args, 'metrics_out', None)
                 else MetricsRegistry())
-    with span('benchmark.serve_load', seed=args.load_seed):
-        res = run_load(cfg, engine=engine, serve_config=serve_cfg,
-                       registry=registry, event_log=event_log,
-                       clock=clock)
+    # Device telemetry across the load run (wall time — the monitor
+    # polls real devices however fast the virtual clock spins); the
+    # gauges ride the same registry --metrics-out snapshots.
+    from distributed_dot_product_tpu.obs import DeviceMonitor
+    devmon = DeviceMonitor(registry=registry, interval=0.2).start()
+    try:
+        with span('benchmark.serve_load', seed=args.load_seed):
+            res = run_load(cfg, engine=engine, serve_config=serve_cfg,
+                           registry=registry, event_log=event_log,
+                           clock=clock)
+    finally:
+        devmon.stop()
+    devmon.poll_once()      # end-of-run device state
     event_log.close()
 
     spec = obs_slo.SloSpec(ttft=args.slo_ttft,
@@ -1240,6 +1263,9 @@ def run_serve_load(args):
         'wall_seconds': res.wall_seconds,
         'ticks': res.ticks,
         'event_log': log_path,
+        'device_polls': registry.counter('device.memory.polls').value,
+        'devices_reporting': registry.gauge(
+            'device.memory.devices_reporting').value,
     }
     print(f"serve-load[{args.cache_mode}/"
           f"{args.spec}] seed={args.load_seed} "

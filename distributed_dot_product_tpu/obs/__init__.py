@@ -27,6 +27,19 @@ functions.py:24-41) and the in-process ``MetricsRegistry``
   perf {snapshot,check,report}``; scripts/ci.sh stage [5/5]).
 - :mod:`~distributed_dot_product_tpu.obs.devmon` — live device-memory
   telemetry gauges and guarded on-demand ``jax.profiler`` captures.
+- :mod:`~distributed_dot_product_tpu.obs.flight` — the incident flight
+  recorder: a hard-bounded black-box ring teeing the event log +
+  metric/device samples, dumped as schema-versioned post-mortem
+  bundles on stall / exception / NaN-storm / anomaly / SIGTERM /
+  ``GET /dump``.
+- :mod:`~distributed_dot_product_tpu.obs.anomaly` — pluggable online
+  detectors (EWMA z-score, static threshold, rate-of-change) over the
+  registry's metric streams, emitting ``anomaly.detected`` events and
+  chaining profile captures / flight dumps.
+- :mod:`~distributed_dot_product_tpu.obs.doctor` — post-mortem bundle
+  diagnosis (``python -m distributed_dot_product_tpu.obs doctor
+  BUNDLE``): classify the incident and name affected tenants/requests
+  from the bundle alone.
 
 CLI: ``python -m distributed_dot_product_tpu.obs validate <log.jsonl>``
 schema-checks a log offline; ``... stats <log.jsonl>`` summarizes it
@@ -39,10 +52,17 @@ from distributed_dot_product_tpu.obs.devmon import (  # noqa: F401
     CaptureInFlight, DeviceMonitor, ProfileCapture,
     device_stats_snapshot,
 )
+from distributed_dot_product_tpu.obs.anomaly import (  # noqa: F401
+    AnomalyWatchdog, EwmaZScore, RateOfChange, StaticThreshold, Watch,
+    default_watches,
+)
 from distributed_dot_product_tpu.obs.events import (  # noqa: F401
     EVENT_SCHEMA, SCHEMA_VERSION, EventLog, activate, emit, get_active,
     merge_events, open_from_env, read_events, remove_log, set_active,
     validate_file,
+)
+from distributed_dot_product_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder, load_bundle,
 )
 from distributed_dot_product_tpu.obs.slo import (  # noqa: F401
     SloReport, SloSpec, check_baseline, goodput,
@@ -66,7 +86,9 @@ __all__ = [
     'SpanCollector', 'SpanRecord', 'collecting', 'enable', 'enabled',
     'get_collector', 'span', 'spanned', 'Timeline', 'reconstruct',
     'timeline', 'CaptureInFlight', 'DeviceMonitor', 'ProfileCapture',
-    'device_stats_snapshot',
+    'device_stats_snapshot', 'FlightRecorder', 'load_bundle',
+    'AnomalyWatchdog', 'EwmaZScore', 'RateOfChange', 'StaticThreshold',
+    'Watch', 'default_watches',
 ]
 
 

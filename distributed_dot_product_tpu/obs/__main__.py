@@ -13,6 +13,7 @@ Offline event-log tooling::
         [--baseline-out SLO_BASELINE.json] [--json]
     python -m distributed_dot_product_tpu.obs slo check LOG [LOG...]
         --against SLO_BASELINE.json [--json]
+    python -m distributed_dot_product_tpu.obs doctor BUNDLE [--json]
 
 ``validate`` schema-checks every record of each log's rotated set
 against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
@@ -41,6 +42,14 @@ pass several paths, optionally labeled ``replica=path``.
 ``timeline`` prints one request's reconstructed lifecycle; ``--json``
 switches to compact machine-readable output with the FULL event
 records (the default renders ``(seq, event)`` pairs for humans).
+
+``doctor`` diagnoses a flight-recorder post-mortem bundle
+(obs/flight.py) FROM THE BUNDLE ALONE: classifies the incident
+(stuck_step / nan_storm / cache_exhaustion / deadline_storm /
+overload) from the ring's events, the metric samples and the thread
+stacks, and names the affected tenants and request ids — exit 1 only
+on an unreadable/invalid bundle (scripts/smoke_serve.sh greps its
+classification against the injected fault cocktail).
 
 Runs on plain files — no devices touched, safe in any CI stage.
 """
@@ -234,6 +243,22 @@ def _cmd_slo_check(args):
     return 1 if violations else 0
 
 
+def _cmd_doctor(args):
+    from distributed_dot_product_tpu.obs import doctor as obs_doctor
+    from distributed_dot_product_tpu.obs import flight as obs_flight
+    try:
+        bundle = obs_flight.load_bundle(args.bundle)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f'{args.bundle}: unreadable bundle: {e}', file=sys.stderr)
+        return 1
+    incident = obs_doctor.diagnose(bundle)
+    if args.json:
+        print(json.dumps(incident.to_dict(), indent=2, default=str))
+    else:
+        print(obs_doctor.render_incident(incident))
+    return 0
+
+
 def _cmd_timeline(args):
     tl = timeline(args.request_id, args.log)
     payload = {
@@ -312,6 +337,16 @@ def main(argv=None):
                         'spec is the contract checked)')
     c.add_argument('--json', action='store_true')
     c.set_defaults(fn=_cmd_slo_check)
+
+    d = sub.add_parser(
+        'doctor', help='diagnose a flight-recorder post-mortem bundle '
+                       '(classify the incident, name affected '
+                       'tenants/requests) from the bundle alone')
+    d.add_argument('bundle', help='bundle directory (MANIFEST.json + '
+                                  'ring JSONL + snapshots)')
+    d.add_argument('--json', action='store_true',
+                   help='machine-readable incident object')
+    d.set_defaults(fn=_cmd_doctor)
 
     t = sub.add_parser('timeline', help='print one request lifecycle')
     t.add_argument('log')

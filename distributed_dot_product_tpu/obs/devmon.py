@@ -207,12 +207,55 @@ class ProfileCapture:
         # would let two concurrent start() calls both pass the guard.
         self._in_flight = False
         self._n = 0
+        self._warmed = False
         self._captures = self.registry.counter('profile.captures')
         self._g_busy = self.registry.gauge('profile.capture_in_flight')
 
     @property
     def busy(self) -> bool:
         return self._in_flight
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def warmup(self):
+        """Pay the profiler's one-time native init NOW (the first
+        ``start_trace`` in a process costs ~14 s on this container —
+        PR 6's measurement; every later capture is milliseconds). An
+        anomaly- or ttft-triggered capture taken before warmup would
+        spend its whole bounded window inside init and record nothing
+        of the regression it fired on. Synchronous, idempotent
+        (returns False when already warmed), guarded like a capture
+        (raises :class:`CaptureInFlight` while one runs — warming
+        would wedge the active trace). The throwaway trace lands in
+        ``base_dir/warmup``; no ``profile.capture`` event or counter —
+        it observed nothing."""
+        if self._warmed:
+            return False
+        with self._lock:
+            if self._in_flight:
+                raise CaptureInFlight(
+                    'cannot warm up while a capture is in flight')
+            self._in_flight = True
+            self._g_busy.set(1)
+        path = os.path.join(self.base_dir, 'warmup')
+        try:
+            os.makedirs(path, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(path)
+            jax.profiler.stop_trace()
+            self._warmed = True
+        except Exception as e:
+            # A backend without a profiler must not fail startup —
+            # the later real capture will report its own failure.
+            tracing.log_exception('profile.warmup', e,
+                                  registry=self.registry)
+        finally:
+            with self._lock:
+                self._in_flight = False
+                self._g_busy.set(0)
+        return self._warmed
 
     def start(self, seconds=None, *, trigger='manual', event_log=None,
               **extra):
@@ -276,6 +319,7 @@ class ProfileCapture:
         import jax
         try:
             jax.profiler.start_trace(path)
+            self._warmed = True     # the native init is paid now
             try:
                 self._sleep(seconds)
             finally:

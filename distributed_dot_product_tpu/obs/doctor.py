@@ -1,0 +1,318 @@
+# -*- coding: utf-8 -*-
+"""
+Post-mortem bundle diagnosis — ``obs doctor BUNDLE``.
+
+Given a flight-recorder bundle (obs/flight.py), classify the incident
+FROM THE BUNDLE ALONE — no live process, no source log — and name who
+it hurt. The classifier scores five incident classes against the
+evidence in the ring's event window, the metric snapshot, the thread
+stacks and the MANIFEST trigger:
+
+- ``stuck_step``     — the decode loop stopped beating: watchdog
+  liveness-stall transitions, a ``stall`` dump trigger, an injected
+  ``stuck_step`` fault, a scheduler thread blocked in a sleep/step.
+- ``nan_storm``      — numerics went bad: quarantine events piling up,
+  ``failed_nan`` terminals, injected NaN faults, a ``nan_storm`` dump.
+- ``cache_exhaustion`` — the paged KV pool ran dry: typed
+  ``cache_exhausted`` sheds, preemption events, ``pages_free`` at 0.
+- ``deadline_storm`` — latency ate the deadlines: ``deadline_exceeded``
+  rejects and ``deadline_expired`` retirements dominating.
+- ``overload``       — more traffic than capacity: ``queue_full``
+  sheds, NOT_READY(queue full) readiness excursions, degradation.
+
+Every class reports its evidence lines; the primary classification is
+the highest score (ties resolve in the order above — a stall is a
+sharper finding than the overload it causes). Affected parties come
+from the same window via :mod:`~distributed_dot_product_tpu.obs.slo`:
+per-tenant goodput over the ring's events, plus the concrete request
+ids the incident touched (quarantined / preempted / shed / failed).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from distributed_dot_product_tpu.obs import slo as obs_slo
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+
+__all__ = ['Incident', 'diagnose', 'render_incident']
+
+# Classification order = tie-break priority (sharper findings first).
+CLASSES = ('stuck_step', 'nan_storm', 'cache_exhaustion',
+           'deadline_storm', 'overload')
+
+_MAX_LISTED = 16    # request ids printed per affected category
+
+
+@dataclasses.dataclass
+class Incident:
+    """One diagnosis. ``classes`` maps every incident class to
+    ``{'score': float, 'evidence': [str, ...]}``; ``primary`` is the
+    winning class (None only for an empty window)."""
+    primary: Optional[str]
+    classes: Dict[str, dict]
+    trigger: Optional[str]
+    reason: str
+    window: dict
+    tenants: Dict[str, dict]
+    affected: Dict[str, List[str]]
+    anomalies: List[dict]
+    notes: List[str]
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _count(events, name, **match):
+    out = 0
+    for rec in events:
+        if rec.get('event') != name:
+            continue
+        if all(rec.get(k) == v for k, v in match.items()):
+            out += 1
+    return out
+
+
+def _stack_evidence(stacks):
+    """Frames that look like a blocked serving loop: a sleep inside
+    the fault injector's stuck-step hook, or a thread wedged in the
+    engine's compiled dispatch."""
+    hits = []
+    for thread, frames in (stacks or {}).items():
+        text = '\n'.join(frames)
+        if 'on_decode_step' in text and 'sleep' in text:
+            hits.append(f'thread {thread} blocked in an injected '
+                        f'stuck step (fault sleep on the loop stack)')
+        elif 'decode_step' in text and 'sleep' in text:
+            hits.append(f'thread {thread} sleeping inside a decode '
+                        f'step')
+    return hits
+
+
+def diagnose(bundle) -> Incident:
+    """Classify ``bundle`` (a dict from :func:`~distributed_dot_product
+    _tpu.obs.flight.load_bundle`, or a path handed straight to it)."""
+    if not isinstance(bundle, dict):
+        from distributed_dot_product_tpu.obs import flight
+        bundle = flight.load_bundle(bundle)
+    manifest = bundle.get('manifest', {})
+    events = bundle.get('events', [])
+    trigger = manifest.get('trigger')
+    reason = manifest.get('reason', '')
+    notes = []
+    ring = manifest.get('ring', {})
+    if ring.get('dropped'):
+        notes.append(f'ring evicted {ring["dropped"]} records — the '
+                     f'window is truncated; early lifecycle events '
+                     f'may be missing')
+
+    scores = {c: {'score': 0.0, 'evidence': []} for c in CLASSES}
+
+    def vote(cls, points, evidence):
+        scores[cls]['score'] += points
+        scores[cls]['evidence'].append(evidence)
+
+    sched_section = (bundle.get('sections') or {}).get('scheduler') or {}
+
+    # -- stall evidence -------------------------------------------------
+    stalls = _count(events, 'health.liveness', state='stalled')
+    if stalls:
+        vote('stuck_step', 6.0 * stalls,
+             f'watchdog liveness went STALLED {stalls}x')
+    inj_stuck = _count(events, 'fault.inject', kind='stuck_step')
+    if inj_stuck:
+        vote('stuck_step', 4.0 * inj_stuck,
+             f'injected fault: stuck_step x{inj_stuck}')
+    if trigger == 'stall':
+        vote('stuck_step', 4.0, 'bundle dumped by the stall trigger')
+    if sched_section.get('liveness') == 'stalled':
+        age = sched_section.get('last_beat_age_s')
+        vote('stuck_step', 3.0,
+             'scheduler introspection shows liveness STALLED at dump '
+             'time' + (f' (last beat {age:.2f}s ago)'
+                       if isinstance(age, (int, float)) else ''))
+    for hit in _stack_evidence(bundle.get('stacks')):
+        vote('stuck_step', 2.0, hit)
+
+    # -- NaN evidence ---------------------------------------------------
+    quarantines = _count(events, 'serve.quarantine')
+    if quarantines:
+        vote('nan_storm', 2.0 * quarantines,
+             f'{quarantines} slot quarantine(s)')
+    failed = _count(events, 'serve.retire', status='failed_nan')
+    if failed:
+        vote('nan_storm', 3.0 * failed,
+             f'{failed} request(s) failed_nan (requeues exhausted)')
+    inj_nan = (_count(events, 'fault.inject', kind='nan_slot')
+               + _count(events, 'fault.inject', kind='nan_batch'))
+    if inj_nan:
+        vote('nan_storm', 2.0 * inj_nan,
+             f'injected fault: nan x{inj_nan}')
+    if trigger == 'nan_storm':
+        vote('nan_storm', 4.0, 'bundle dumped by the NaN-storm trigger')
+
+    # -- cache-exhaustion evidence --------------------------------------
+    preempts = _count(events, 'serve.preempt')
+    if preempts:
+        vote('cache_exhaustion', 2.0 * preempts,
+             f'{preempts} page-pool preemption(s)')
+    cache_rej = sum(1 for r in events
+                    if r.get('event') in ('serve.reject', 'serve.retire')
+                    and r.get('reason') == 'cache_exhausted')
+    if cache_rej:
+        vote('cache_exhaustion', 3.0 * cache_rej,
+             f'{cache_rej} typed cache_exhausted shed(s)')
+    for sample in bundle.get('metric_samples', []):
+        gauges = (sample.get('metrics') or {}).get('gauges', {})
+        free = gauges.get('serve.cache.pages_free')
+        total_used = gauges.get('serve.cache.pages_used', 0)
+        if free == 0 and total_used:
+            vote('cache_exhaustion', 2.0,
+                 'a metric sample shows pages_free == 0')
+            break
+
+    # -- deadline evidence ----------------------------------------------
+    dl = (sum(1 for r in events if r.get('event') == 'serve.reject'
+              and r.get('reason') == 'deadline_exceeded')
+          + _count(events, 'serve.retire', status='deadline_expired'))
+    if dl:
+        vote('deadline_storm', min(1.0 * dl, 10.0),
+             f'{dl} deadline miss(es) (typed rejects + expirations)')
+
+    # -- overload evidence ----------------------------------------------
+    qfull = sum(1 for r in events if r.get('event') == 'serve.reject'
+                and r.get('reason') == 'queue_full')
+    if qfull:
+        vote('overload', min(1.0 * qfull, 8.0),
+             f'{qfull} queue_full shed(s)')
+    not_ready = sum(1 for r in events
+                    if r.get('event') == 'health.readiness'
+                    and r.get('state') == 'not_ready'
+                    and 'queue' in str(r.get('reason', '')))
+    if not_ready:
+        vote('overload', min(1.0 * not_ready, 4.0),
+             f'readiness went NOT_READY (queue full) {not_ready}x')
+    degraded = sum(1 for r in events
+                   if r.get('event') == 'health.readiness'
+                   and r.get('state') == 'degraded')
+    if degraded:
+        vote('overload', min(0.5 * degraded, 2.0),
+             f'readiness DEGRADED under pressure {degraded}x')
+
+    # -- anomaly verdicts ride along as supporting context --------------
+    anomalies = [r for r in events if r.get('event') == 'anomaly.detected']
+    for rec in anomalies:
+        watch = str(rec.get('watch', rec.get('metric', '')))
+        if 'ttft' in watch or 'token' in watch:
+            vote('stuck_step', 0.5,
+                 f'anomaly detector tripped on {watch}')
+        if 'queue' in watch or 'reject' in watch:
+            vote('overload', 0.5,
+                 f'anomaly detector tripped on {watch}')
+        if 'pages' in watch:
+            vote('cache_exhaustion', 0.5,
+                 f'anomaly detector tripped on {watch}')
+
+    ranked = sorted(CLASSES,
+                    key=lambda c: (-scores[c]['score'],
+                                   CLASSES.index(c)))
+    primary = ranked[0] if scores[ranked[0]]['score'] > 0 else None
+
+    # -- who it hurt: per-tenant goodput + concrete request ids --------
+    timelines = reconstruct(events)
+    spec = obs_slo.SloSpec()        # deadline-free: classes met /
+    report = obs_slo.goodput(events, spec)  # rejected / incomplete
+    tenants = {t: {'requests': tb['requests'],
+                   'met': tb['counts']['met'],
+                   'rejected': tb['counts']['rejected'],
+                   'incomplete': tb['counts']['incomplete']}
+               for t, tb in sorted(report.per_tenant.items())}
+    affected = {'quarantined': [], 'preempted': [], 'rejected': [],
+                'failed': [], 'incomplete': [], 'in_flight': []}
+    # The slot table at dump time: who was ON the device when the
+    # incident hit (a mid-run bundle's events alone can't tell which
+    # incompletes actually held slots).
+    for slot in sched_section.get('slots', []):
+        rid = slot.get('request_id')
+        if rid and rid not in affected['in_flight']:
+            affected['in_flight'].append(rid)
+    for rid, tl in sorted(timelines.items()):
+        if tl.quarantines:
+            affected['quarantined'].append(rid)
+        if tl.preempts:
+            affected['preempted'].append(rid)
+        if tl.status == 'rejected':
+            affected['rejected'].append(rid)
+        elif tl.status in ('failed_nan', 'evicted', 'deadline_expired'):
+            affected['failed'].append(rid)
+        elif tl.status is None:
+            affected['incomplete'].append(rid)
+
+    ts = [r['ts'] for r in events
+          if isinstance(r.get('ts'), (int, float))]
+    window = {'events': len(events),
+              'first_ts': min(ts) if ts else None,
+              'last_ts': max(ts) if ts else None,
+              'ring_dropped': ring.get('dropped', 0)}
+    if not events:
+        notes.append('the bundle carries no events — was an event log '
+                     'active when the recorder ran?')
+    return Incident(primary=primary, classes=scores, trigger=trigger,
+                    reason=reason, window=window, tenants=tenants,
+                    affected=affected, anomalies=anomalies, notes=notes)
+
+
+def _fmt_ids(ids):
+    shown = ' '.join(ids[:_MAX_LISTED])
+    more = len(ids) - _MAX_LISTED
+    return shown + (f' (+{more} more)' if more > 0 else '')
+
+
+def render_incident(incident: Incident) -> str:
+    """The human incident report ``obs doctor`` prints."""
+    parts = []
+    primary = incident.primary or 'inconclusive'
+    score = (incident.classes.get(incident.primary, {}).get('score', 0)
+             if incident.primary else 0)
+    parts.append(f'INCIDENT: {primary} (score {score:.1f}'
+                 + (f', dump trigger: {incident.trigger}'
+                    if incident.trigger else '') + ')')
+    if incident.reason:
+        parts.append(f'  reason: {incident.reason}')
+    w = incident.window
+    parts.append(f'  window: {w["events"]} events'
+                 + (f' over {w["last_ts"] - w["first_ts"]:.2f}s'
+                    if w['first_ts'] is not None else '')
+                 + (f', ring dropped {w["ring_dropped"]}'
+                    if w['ring_dropped'] else ''))
+    parts.append('classification:')
+    for cls in sorted(incident.classes,
+                      key=lambda c: -incident.classes[c]['score']):
+        info = incident.classes[cls]
+        if not info['score']:
+            continue
+        parts.append(f'  {cls:18} {info["score"]:6.1f}')
+        for ev in info['evidence']:
+            parts.append(f'      - {ev}')
+    if not any(i['score'] for i in incident.classes.values()):
+        parts.append('  (no incident evidence in the window)')
+    if incident.anomalies:
+        parts.append(f'anomaly verdicts: {len(incident.anomalies)}')
+        for rec in incident.anomalies[:8]:
+            parts.append(f'  - {rec.get("watch", rec.get("metric"))}: '
+                         f'{rec.get("detector")} value='
+                         f'{rec.get("value")}')
+    parts.append('affected tenants:')
+    if incident.tenants:
+        for tenant, tb in incident.tenants.items():
+            parts.append(f'  {tenant:12} {tb["requests"]:4d} requests: '
+                         f'{tb["met"]} completed in-SLO-window, '
+                         f'{tb["rejected"]} rejected, '
+                         f'{tb["incomplete"]} incomplete/failed')
+    else:
+        parts.append('  (no request lifecycle in the window)')
+    for cat, ids in incident.affected.items():
+        if ids:
+            parts.append(f'affected requests ({cat}): {_fmt_ids(ids)}')
+    for note in incident.notes:
+        parts.append(f'note: {note}')
+    return '\n'.join(parts)

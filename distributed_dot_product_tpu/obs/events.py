@@ -104,6 +104,16 @@ EVENT_SCHEMA = {
     # `perf check` found a per-entry tolerance violation against the
     # committed baseline (entry = registry name, metric = which gate).
     'perf.regression': ('entry', 'metric'),
+    # -- incident layer (obs/anomaly.py, obs/flight.py) ----------------
+    # An online detector flagged a metric stream: `metric` is the
+    # registry family watched, `detector` the detector class that
+    # tripped, `value` the observation that breached. Extra fields
+    # (watch name, threshold/mean/sigma) ride along per detector.
+    'anomaly.detected': ('metric', 'detector', 'value'),
+    # The flight recorder wrote a post-mortem bundle: `trigger` names
+    # the cause (stall / exception / nan_storm / anomaly / sigterm /
+    # http / manual), `path` the bundle directory.
+    'postmortem.dump': ('trigger', 'path'),
     # -- SLO observatory (obs/slo.py) ----------------------------------
     # `slo check` found goodput below the committed SLO_BASELINE.json
     # tolerance (`metric` names the gate; `tenant` is present on
@@ -112,6 +122,13 @@ EVENT_SCHEMA = {
     # -- swallowed exceptions (utils.tracing.log_exception) ------------
     'exception': ('context', 'type'),
 }
+
+
+# Flight-recorder tee (obs/flight.py installs it): called with every
+# record an EventLog emits, as ``(record, encoded_line)``. None when no
+# recorder is installed — the disabled path costs exactly one global
+# None-check per emit, no allocation (the spans contract).
+_TEE = None
 
 
 # Fields that became REQUIRED at schema v2: records stamped with an
@@ -244,6 +261,12 @@ class EventLog:
             if self.fsync:
                 os.fsync(self._fh.fileno())
             self._size += len(line) + 1
+            # Tee into the flight recorder's ring (already-encoded line
+            # — no second serialization). Inside the lock so the ring
+            # sees records in the same order the file does.
+            tee = _TEE
+            if tee is not None:
+                tee(rec, line)
             if self._size >= self.rotate_bytes:
                 self._rotate_locked()
         return rec

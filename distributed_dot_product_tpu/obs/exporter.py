@@ -40,6 +40,13 @@ ProfileCapture`), ``/profile?seconds=N`` begins one bounded
 ``jax.profiler`` trace capture — 200 with the trace directory, 409
 while one is already in flight (never two traces), 400 on a bad
 duration, 404 when the server carries no profiler.
+
+``GET /dump[?reason=...]`` writes one flight-recorder post-mortem
+bundle (obs/flight.py) — the server's explicit ``flight=`` recorder or
+the process-installed one; 404 without either. Every render also
+carries the constant ``<ns>_build_info{schema_version,jax_version,
+python_version}`` gauge so merged multi-replica scrapes can detect
+version skew.
 """
 
 import http.server
@@ -52,7 +59,8 @@ from typing import Optional
 
 from distributed_dot_product_tpu.utils import tracing
 
-__all__ = ['render_prometheus', 'escape_label_value', 'MetricsServer']
+__all__ = ['render_prometheus', 'escape_label_value', 'MetricsServer',
+           'build_info_labels']
 
 _NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
 
@@ -86,15 +94,54 @@ def _fmt(value):
     return repr(v) if not v.is_integer() else str(int(v))
 
 
+def build_info_labels():
+    """The constant build-info label set (computed once per process):
+    event-schema version, jax version, python version. A Prometheus
+    merging several replicas' scrapes (ROADMAP item 2) joins on these
+    to detect version skew across the fleet; flight-recorder bundle
+    MANIFESTs embed the same values (ONE probe — the scrape and the
+    bundle can never disagree about the process that wrote them)."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        import platform
+        from distributed_dot_product_tpu.obs import events as _events
+        try:
+            import jax
+            jax_version = jax.__version__
+        except (ImportError, AttributeError):
+            # The exporter must render without jax too.
+            jax_version = 'unavailable'
+        _BUILD_INFO = {
+            'schema_version': str(_events.SCHEMA_VERSION),
+            'jax_version': jax_version,
+            'python_version': platform.python_version(),
+        }
+    return _BUILD_INFO
+
+
+_BUILD_INFO = None
+
+
 def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
                       *, namespace='ddp') -> str:
     """Render ``registry`` (default: the process registry) as Prometheus
     exposition text. Reads are snapshot-consistent per metric (each
     counter/gauge read is atomic, each histogram summary is computed
     under its own lock), so concurrent writers never produce torn
-    values — only values at least as fresh as the render's start."""
+    values — only values at least as fresh as the render's start.
+
+    Always includes the constant ``<ns>_build_info`` gauge (value 1,
+    labels ``schema_version``/``jax_version``/``python_version``) —
+    the standard build-info idiom, so a multi-replica merge can detect
+    version skew from the scrape alone."""
     registry = registry or tracing.get_registry()
-    lines = []
+    info_fam = _metric_name(namespace, 'build_info')
+    lines = [
+        f'# HELP {info_fam} constant build/version info '
+        f'(schema_version, jax_version, python_version)',
+        f'# TYPE {info_fam} gauge',
+        f'{info_fam}{_labels_str(build_info_labels())} 1',
+    ]
     # Cumulative-bucket histogram families are buffered and emitted
     # after the main body: interleaving `<fam>` summary lines and
     # `<fam>_hist` bucket lines per label set would split each family
@@ -102,7 +149,7 @@ def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
     # (OpenMetrics, promtool) reject. iter_metrics() yields label sets
     # of one family adjacently, so each buffer stays grouped.
     hist_lines = []
-    typed = set()
+    typed = {info_fam}
 
     def _head(kind, fam, comment, out=None):
         if fam not in typed:
@@ -158,7 +205,7 @@ def render_prometheus(registry: Optional['tracing.MetricsRegistry'] = None,
                 hist_lines.append(f'{famh}_sum{_labels_str(labels)} '
                                   f'{_fmt(value["total_sum"])}')
     lines += hist_lines
-    return '\n'.join(lines) + '\n' if lines else ''
+    return '\n'.join(lines) + '\n'
 
 
 _HEALTHY = ('ready', 'degraded')
@@ -170,6 +217,7 @@ class _ObsHTTPServer(http.server.ThreadingHTTPServer):
     registry = None
     health = None
     profiler = None
+    flight = None
     namespace = 'ddp'
 
 
@@ -206,8 +254,42 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                        'application/json')
         elif path == '/profile':
             self._do_profile()
+        elif path == '/dump':
+            self._do_dump()
         else:
             self._send(404, 'not found\n', 'text/plain')
+
+    def _do_dump(self):
+        """``GET /dump[?reason=...]``: write one flight-recorder
+        post-mortem bundle (obs/flight.py) on demand — the operator's
+        "grab the black box NOW" button. Uses the server's explicit
+        recorder, else the process-installed one; 404 when neither
+        exists (the recorder is opt-in like the profiler). The dump is
+        direct (not cooldown-limited): an explicit human request
+        always gets a bundle."""
+        from distributed_dot_product_tpu.obs import flight as _flight
+        rec = self.server.flight or _flight.get_recorder()
+        if rec is None:
+            self._send(404, json.dumps(
+                {'error': 'no flight recorder installed in this '
+                          'process'}) + '\n', 'application/json')
+            return
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        reason = query.get('reason', [''])[0]
+        try:
+            path = rec.dump_bundle(trigger='http', reason=reason)
+        except Exception as e:
+            # Answer 500 instead of dropping the connection, but keep
+            # the failure observable (silent-except contract).
+            tracing.log_exception('exporter.dump_endpoint', e,
+                                  registry=self.server.registry)
+            self._send(500, json.dumps(
+                {'error': f'{type(e).__name__}: {e}'}) + '\n',
+                'application/json')
+            return
+        self._send(200, json.dumps({'status': 'dumped', 'path': path})
+                   + '\n', 'application/json')
 
     def _do_profile(self):
         """``GET /profile?seconds=N``: begin one bounded profiler
@@ -254,12 +336,16 @@ class MetricsServer:
     collisions)."""
 
     def __init__(self, registry=None, *, health=None, profiler=None,
-                 host='127.0.0.1', port=0, namespace='ddp'):
+                 flight=None, host='127.0.0.1', port=0,
+                 namespace='ddp'):
         self.registry = registry or tracing.get_registry()
         self.health = health
         # Optional obs.devmon.ProfileCapture: enables the guarded
         # /profile?seconds=N endpoint (404 without one).
         self.profiler = profiler
+        # Optional obs.flight.FlightRecorder for GET /dump (falls back
+        # to the process-installed recorder; 404 without either).
+        self.flight = flight
         self.host = host
         self.port = port
         self.namespace = namespace
@@ -273,6 +359,7 @@ class MetricsServer:
         srv.registry = self.registry
         srv.health = self.health
         srv.profiler = self.profiler
+        srv.flight = self.flight
         srv.namespace = self.namespace
         self.port = srv.server_address[1]
         self._server = srv

@@ -60,6 +60,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs import flight as obs_flight
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.devmon import CaptureInFlight
 from distributed_dot_product_tpu.obs.spans import span
@@ -120,6 +121,27 @@ class ServeConfig:
     spec: Optional[str] = None
     spec_k: int = 4
     spec_max_ngram: int = 3
+    # Incident flight recorder (obs/flight.py — resolved process-wide
+    # at trigger time, like the active event log): auto-dump a
+    # post-mortem bundle on a watchdog stall, on an unhandled
+    # scheduler-loop exception, and on a NaN-quarantine storm
+    # (`flight_nan_storm` quarantines within `flight_nan_window`
+    # decode steps). All no-ops while no recorder is installed.
+    flight_dump_on_stall: bool = True
+    flight_dump_on_exception: bool = True
+    flight_nan_storm: int = 3
+    flight_nan_window: int = 20
+    # Anomaly watchdog (obs/anomaly.py): True arms the stock catalog
+    # (TTFT p99, tokens/s, queue depth, pages_free, reject rate) over
+    # this scheduler's registry, evaluated from the tick (throttled in
+    # REAL time). Pass a built AnomalyWatchdog for custom watches.
+    anomaly: bool = False
+    # Pay the profiler's one-time native init (~14 s first
+    # `start_trace` on this container — PR 6's measurement) at
+    # SCHEDULER CONSTRUCTION instead of inside the first
+    # anomaly/adaptive capture, which would otherwise spend its whole
+    # bounded window on init and record nothing of the regression.
+    profile_warmup: bool = False
 
 
 class _SlotState(enum.Enum):
@@ -167,7 +189,7 @@ class Scheduler:
                  registry: Optional[tracing.MetricsRegistry] = None,
                  health: Optional[HealthMonitor] = None,
                  on_tick: Optional[Callable] = None, event_log=None,
-                 profiler=None, proposer=None):
+                 profiler=None, proposer=None, anomaly=None):
         self.engine = engine
         # Paged engines gate admission by FREE PAGES, not free slots,
         # and join page exhaustion into the degrade→evict→reject
@@ -211,6 +233,14 @@ class Scheduler:
             stall_timeout=self.cfg.stall_timeout,
             poll_interval=self.cfg.watchdog_poll, registry=self.registry,
             event_log=event_log)
+        # Incident wiring: the watchdog's dangling on_stall hook now
+        # drives the flight recorder — a stall's post-mortem bundle is
+        # written WHILE the loop is wedged (the watchdog thread runs
+        # free), capturing the stuck thread's stack. Never stomps a
+        # caller-installed callback (mirror of the injector.event_log
+        # rule).
+        if self.cfg.flight_dump_on_stall and self.health.on_stall is None:
+            self.health.on_stall = self._on_stall
         if self.cfg.watchdog:
             self.health.start()
         self._slots = [_Slot(i) for i in range(engine.slots)]
@@ -266,6 +296,35 @@ class Scheduler:
         # lazily per tenant seen and cached here (registry get-or-
         # create takes a lock — not a per-token cost we want).
         self._tenant_series: Dict[tuple, object] = {}
+        # NaN-quarantine storm window: decode-step indices of recent
+        # quarantines — `flight_nan_storm` of them within
+        # `flight_nan_window` steps triggers one post-mortem dump.
+        self._quarantine_steps = []
+        # Anomaly watchdog: an explicit one wins; cfg.anomaly=True
+        # builds the stock catalog over THIS scheduler's registry.
+        if anomaly is not None:
+            self._anomaly = anomaly
+        elif self.cfg.anomaly:
+            from distributed_dot_product_tpu.obs.anomaly import (
+                AnomalyWatchdog, default_watches,
+            )
+            self._anomaly = AnomalyWatchdog(
+                self.registry,
+                default_watches(queue_limit=self.cfg.queue_limit,
+                                paged=self._paged),
+                profiler=self.profiler, event_log=event_log)
+        else:
+            self._anomaly = None
+        if self.profiler is not None and self.cfg.profile_warmup:
+            self.profiler.warmup()
+        # Every post-mortem bundle (including an HTTP /dump with no
+        # scheduler in hand) embeds this scheduler's introspection.
+        # ONE bound-method object, captured here: attribute access
+        # mints a fresh one each time, which would break the
+        # ownership check in remove_provider at close() (the same
+        # identity rule FaultInjector._hook documents).
+        self._introspection_hook = self.introspection
+        obs_flight.add_provider('scheduler', self._introspection_hook)
 
     def _tenant_hist(self, name, tenant):
         """The ``tenant=``-labeled series of a latency family — same
@@ -313,6 +372,73 @@ class Scheduler:
                else obs_events.get_active())
         if log is not None:
             log.emit(event, **fields)
+
+    # -- incident flight recorder (obs/flight.py) ----------------------
+    def introspection(self):
+        """Point-in-time scheduler state for a post-mortem bundle:
+        the slot table, queue depth, step index, engine cache stats.
+        Read WITHOUT locks — this runs from the watchdog thread while
+        the loop may be wedged mid-step, and a slightly torn view of
+        host bookkeeping beats a dump that deadlocks."""
+        slots = []
+        for slot in self._slots:
+            req = slot.request
+            slots.append({
+                'index': slot.index, 'state': slot.state.value,
+                'request_id': req.id if req is not None else None,
+                'tenant': req.tenant if req is not None else None,
+                'produced': slot.produced,
+                'prefill_pos': slot.prefill_pos,
+                'requeues': req.requeues if req is not None else None,
+                'last_progress': slot.last_progress,
+            })
+        out = {
+            'step_idx': self._step_idx,
+            'queue_depth': self.admission.depth,
+            'queue_limit': self.cfg.queue_limit,
+            'slots': slots,
+            'results': len(self.results),
+            'liveness': self.health.liveness.value,
+            'readiness': self.health.readiness.value,
+            'last_beat_age_s': self.health.last_beat_age(),
+            'proposer': (type(self._proposer).__name__
+                         if self._proposer is not None else None),
+            'cache_mode': getattr(self.engine, 'cache_mode', 'slab'),
+        }
+        try:
+            out['cache_stats'] = self.engine.cache_stats()
+        except (AttributeError, TypeError):
+            # An engine without the introspection surface is fine.
+            out['cache_stats'] = None
+        return out
+
+    def _flight_dump(self, trigger, reason=''):
+        """One rate-limited post-mortem bundle through the process
+        flight recorder (no-op while none is installed — checked
+        BEFORE building the introspection section, so the disabled
+        path never materializes it). Never raises: the black box must
+        not take down the loop it is recording."""
+        rec = obs_flight.get_recorder()
+        if rec is None:
+            return None
+        try:
+            return rec.maybe_dump(
+                trigger=trigger, reason=reason,
+                sections={'scheduler': self.introspection()})
+        except Exception as e:
+            tracing.log_exception('scheduler.flight_dump', e,
+                                  registry=self.registry)
+            return None
+
+    def _on_stall(self):
+        """Watchdog-thread stall callback: dump the black box WHILE
+        the loop is stuck (the bundle's stacks.json shows where)."""
+        age = self.health.last_beat_age()
+        self._flight_dump(
+            'stall',
+            reason=f'no heartbeat for '
+                   f'{age:.2f}s (timeout {self.cfg.stall_timeout:.2f}s)'
+                   if age is not None else 'watchdog stall')
 
     # -- submission surface --------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, deadline=None,
@@ -454,6 +580,18 @@ class Scheduler:
         else:
             self._c['failed'].inc()
             self._finalize_request(req, 'failed_nan')
+        # Quarantine-storm trigger: one transient NaN is routine; a
+        # cluster of them inside a short step window is an incident —
+        # dump the black box while the poisoned state is still live.
+        self._quarantine_steps.append(self._step_idx)
+        window = [s for s in self._quarantine_steps
+                  if s > self._step_idx - self.cfg.flight_nan_window]
+        self._quarantine_steps = window
+        if len(window) >= self.cfg.flight_nan_storm:
+            self._flight_dump(
+                'nan_storm',
+                reason=f'{len(window)} quarantines within the last '
+                       f'{self.cfg.flight_nan_window} decode steps')
 
     def _ensure_pages(self):
         """Page-deficit ladder, run before every decode tick: make each
@@ -877,7 +1015,21 @@ class Scheduler:
     # -- the loop -------------------------------------------------------
     def step(self) -> bool:
         """One scheduler tick (admit → prefill chunk → decode step →
-        retire). Returns True while work remains."""
+        retire). Returns True while work remains. An unhandled
+        exception escaping the tick dumps a post-mortem bundle (the
+        state that crashed the loop, captured before unwinding
+        destroys it) and re-raises — the flight recorder observes
+        failures, it never absorbs them."""
+        try:
+            return self._step_impl()
+        except Exception as e:
+            if self.cfg.flight_dump_on_exception:
+                self._flight_dump(
+                    'exception',
+                    reason=f'{type(e).__name__}: {e}')
+            raise
+
+    def _step_impl(self) -> bool:
         now = self.clock()
         self.health.beat()
         self._admit_into_free_slots()
@@ -980,6 +1132,18 @@ class Scheduler:
             self._g_pages_free.set(stats['pages_free'])
             self._g_shared.set(stats['shared_pages'])
         self._maybe_profile()
+        # Flight-recorder sample (throttled inside to REAL seconds;
+        # the shared null recorder makes the disabled path one method
+        # call, no allocation) and the anomaly watchdog's evaluation
+        # pass (same real-time throttle).
+        obs_flight.recorder().sample()
+        if self._anomaly is not None:
+            try:
+                self._anomaly.tick()
+            except Exception as e:
+                # A broken detector must never down the serving loop.
+                tracing.log_exception('scheduler.anomaly_tick', e,
+                                      registry=self.registry)
         self._update_readiness()
         if self.on_tick is not None:
             self.on_tick(self)
@@ -1046,6 +1210,8 @@ class Scheduler:
         """Stop the watchdog and mark the surface STOPPED."""
         if not self._closed:
             self._closed = True
+            obs_flight.remove_provider('scheduler',
+                                       self._introspection_hook)
             self.health.stop()
 
     def __enter__(self):

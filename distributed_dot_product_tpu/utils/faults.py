@@ -279,6 +279,11 @@ def serve_plan_from_env(environ=None) -> ServeFaultPlan:
     - ``DDP_TPU_FAULT_ABANDON_AFTER=4``       ...after 4 tokens
     - ``DDP_TPU_FAULT_BURST=64``              drivers submit a 64-request
       burst (examples/serve_lm.py, scripts/smoke_serve.sh)
+    - ``DDP_TPU_FAULT_NAN_REPEAT=1``          the NaN fault fires on EVERY
+      step from ``nan_at_step`` on (``fire_once=False``) — the
+      quarantine STORM that exhausts ``max_requeues`` into typed
+      failures and trips the flight recorder's nan_storm auto-dump
+      (obs/flight.py), instead of the default one-shot glitch
     """
     env = os.environ if environ is None else environ
 
@@ -305,6 +310,7 @@ def serve_plan_from_env(environ=None) -> ServeFaultPlan:
         abandon_after_tokens=_int_default('DDP_TPU_FAULT_ABANDON_AFTER',
                                           2),
         burst=_int_default('DDP_TPU_FAULT_BURST', 0),
+        fire_once=not _int_default('DDP_TPU_FAULT_NAN_REPEAT', 0),
     )
 
 

@@ -490,6 +490,18 @@ class MetricsRegistry:
                     else buckets)
             return h
 
+    def peek(self, kind, name, labels=None):
+        """The EXISTING metric of ``kind`` (``'counter'``/``'gauge'``/
+        ``'histogram'``) under ``name``/``labels``, or None — read-only
+        probing that never creates a series. The anomaly watchdog
+        (obs/anomaly.py) polls metric streams other layers may not have
+        created yet; the get-or-create accessors would materialize an
+        empty series and teach its detectors a phantom zero."""
+        table = {'counter': self._counters, 'gauge': self._gauges,
+                 'histogram': self._histograms}[kind]
+        with self._lock:
+            return table.get(_metric_key(name, labels))
+
     def iter_metrics(self):
         """Structured iteration for exporters: yields ``(kind, name,
         labels_dict, value)`` with ``value`` the counter/gauge value or

@@ -65,12 +65,21 @@ def build_requests(args):
     return reqs
 
 
-def run_burst(args, *, fault_injector, deadline_every=0):
+def run_burst(args, *, fault_injector, deadline_every=0,
+              flight_dir=None):
     """``fault_injector=False`` means EXPLICITLY unfaulted (the clean
     reference run) — plain None would let the scheduler re-arm the same
     env knobs and make the bit-identity audit compare a faulted run
-    against itself."""
+    against itself. ``flight_dir`` (faulted run only) installs the
+    incident flight recorder there: a watchdog stall auto-dumps a
+    post-mortem bundle ``obs doctor`` can diagnose."""
     registry = MetricsRegistry()
+    recorder = None
+    if flight_dir:
+        recorder = obs.flight.FlightRecorder(flight_dir,
+                                             registry=registry,
+                                             sample_interval=0.1)
+        obs.flight.install(recorder)
     engine = KernelEngine(slots=args.slots, t_max=args.t_max,
                           vocab=args.vocab,
                           prefill_chunk=args.prefill_chunk,
@@ -92,29 +101,53 @@ def run_burst(args, *, fault_injector, deadline_every=0):
                       # partial 'evicted' streams, so the ladder stops
                       # before eviction here (eviction has its own
                       # tests).
-                      evict_before_reject=False)
+                      evict_before_reject=False,
+                      profile_warmup=args.profile_warmup)
+    profiler = None
+    if args.profile_warmup:
+        # Opt-in: pay the profiler's ~14 s one-time native init HERE,
+        # so a later adaptive/anomaly capture spends its bounded
+        # window on the regression instead of on init.
+        import tempfile
+        profiler = obs.ProfileCapture(
+            tempfile.mkdtemp(prefix='ddp_serve_profiles_'),
+            registry=registry)
     sched = Scheduler(engine, cfg, fault_injector=fault_injector,
-                      registry=registry)
+                      registry=registry, profiler=profiler)
+    # Live device telemetry for the duration of the run: the gauges
+    # (device.memory.*{device=...}, devices_reporting) land in the
+    # same registry the summary below snapshots — real numbers on
+    # TPU/GPU, an honest devices_reporting=0 on this CPU mesh.
+    devmon = obs.DeviceMonitor(registry=registry, interval=0.2).start()
     rejected = {}
     submitted = build_requests(args)
     t0 = time.perf_counter()
-    for i, (rid, prompt) in enumerate(submitted):
-        deadline = None
-        if deadline_every and i % deadline_every == 3:
-            deadline = sched.clock() + args.deadline_s
-        try:
-            sched.submit(prompt, request_id=rid, deadline=deadline)
-        except RejectedError as e:
-            rejected[rid] = e.reason
-        # Drain a tick every few submissions: a real frontend interleaves
-        # arrivals with serving — and it lets the burst actually overflow
-        # a small queue while slots are busy.
-        if i % 4 == 3:
-            sched.step()
-    results = sched.run_until_idle()
-    wall = time.perf_counter() - t0
-    sched.close()
-    return sched, registry, submitted, rejected, results, wall
+    try:
+        for i, (rid, prompt) in enumerate(submitted):
+            deadline = None
+            if deadline_every and i % deadline_every == 3:
+                deadline = sched.clock() + args.deadline_s
+            try:
+                sched.submit(prompt, request_id=rid, deadline=deadline)
+            except RejectedError as e:
+                rejected[rid] = e.reason
+            # Drain a tick every few submissions: a real frontend
+            # interleaves arrivals with serving — and it lets the burst
+            # actually overflow a small queue while slots are busy.
+            if i % 4 == 3:
+                sched.step()
+        results = sched.run_until_idle()
+        wall = time.perf_counter() - t0
+    finally:
+        # close() in the cleanup path: step() now re-raises unhandled
+        # exceptions (after its flight dump), and an error exit must
+        # not leak the watchdog thread or the scheduler's global
+        # flight introspection provider.
+        sched.close()
+        devmon.stop()
+        if recorder is not None:
+            obs.flight.install(None)
+    return sched, registry, submitted, rejected, results, wall, recorder
 
 
 def run_load_demo(args):
@@ -141,14 +174,19 @@ def run_load_demo(args):
                           vocab=args.vocab,
                           prefill_chunk=args.prefill_chunk,
                           seed=args.seed)
-    res = run_load(cfg, engine=engine,
-                   serve_config=ServeConfig(
-                       queue_limit=args.queue_limit,
-                       max_new_tokens=max(t.new_hi
-                                          for t in cfg.tenants),
-                       watchdog=False),
-                   registry=MetricsRegistry(), event_log=event_log,
-                   clock=clock)
+    registry = MetricsRegistry()
+    devmon = obs.DeviceMonitor(registry=registry, interval=0.2).start()
+    try:
+        res = run_load(cfg, engine=engine,
+                       serve_config=ServeConfig(
+                           queue_limit=args.queue_limit,
+                           max_new_tokens=max(t.new_hi
+                                              for t in cfg.tenants),
+                           watchdog=False),
+                       registry=registry, event_log=event_log,
+                       clock=clock)
+    finally:
+        devmon.stop()
     event_log.close()
     spec = obs_slo.SloSpec(ttft=0.25, per_token=0.05)
     report = obs_slo.goodput(log_path, spec)
@@ -191,6 +229,16 @@ def main(argv=None):
                         '(default: $DDP_TPU_EVENT_LOG); the audit then '
                         'additionally requires every request timeline '
                         'to be reconstructable from the log alone')
+    p.add_argument('--flight-dir',
+                   default=os.environ.get('DDP_TPU_FLIGHT_DIR'),
+                   help='arm the incident flight recorder rooted here '
+                        '(default: $DDP_TPU_FLIGHT_DIR); a watchdog '
+                        'stall / NaN storm auto-dumps a post-mortem '
+                        'bundle for `obs doctor` (faulted run only)')
+    p.add_argument('--profile-warmup', action='store_true',
+                   help='pay the jax profiler\'s one-time native init '
+                        '(~14 s) at startup so a later triggered '
+                        'capture records the regression, not the init')
     p.add_argument('--load', type=int, default=None, metavar='SEED',
                    help='instead of the fixed burst, run a small '
                         'seeded open-loop loadgen trace (virtual '
@@ -222,9 +270,15 @@ def main(argv=None):
     log_ctx = (obs.activate(event_log) if event_log is not None
                else contextlib.nullcontext())
     with log_ctx:
-        sched, registry, submitted, rejected, results, wall = run_burst(
+        (sched, registry, submitted, rejected, results, wall,
+         recorder) = run_burst(
             args, fault_injector=injector,
-            deadline_every=args.deadline_every)
+            deadline_every=args.deadline_every,
+            # The flight recorder rides the FAULTED run only, like the
+            # event log: the clean rerun would overwrite the incident
+            # window with healthy traffic.
+            flight_dir=args.flight_dir if injector is not None
+            else None)
     if event_log is not None:
         event_log.close()
 
@@ -304,10 +358,24 @@ def main(argv=None):
         print(f'event-log timeline audit: {"ok" if ok else "FAILED"} '
               f'({len(submitted) - unreconstructed}/{len(submitted)} '
               f'requests reconstructed from {args.event_log})')
+    # 3b. Incident flight recorder: with the recorder armed and a
+    #     stuck step injected, the watchdog stall must have auto-
+    #     dumped a post-mortem bundle (what `obs doctor` diagnoses —
+    #     scripts/smoke_serve.sh runs it over this very bundle).
+    if recorder is not None:
+        for d in recorder.dumps:
+            print(f'flight bundle [{d["trigger"]}]: {d["path"]}')
+        if injector is not None and plan.stuck_at_step is not None \
+                and not any(d['trigger'] == 'stall'
+                            for d in recorder.dumps):
+            failures.append('stuck step armed and flight recorder '
+                            'installed, but no stall bundle was '
+                            'auto-dumped')
     # 4. Fault isolation: completed streams identical to a clean run.
     if args.check_identical:
-        _, _, _, rej0, clean, _ = run_burst(args, fault_injector=False,
-                                            deadline_every=0)
+        _, _, _, rej0, clean, _, _ = run_burst(args,
+                                               fault_injector=False,
+                                               deadline_every=0)
         for rid, r in results.items():
             if r.status != 'completed' or r.degraded:
                 continue

@@ -42,12 +42,19 @@ export DDP_TPU_FAULT_NAN_DECODE_STEP=7
 export DDP_TPU_FAULT_NAN_DECODE_SLOT=1
 
 OUT="$(mktemp /tmp/ddp_tpu_smoke_serve.XXXXXX)"
+DOCTOR_OUT="$(mktemp /tmp/ddp_tpu_smoke_doctor.XXXXXX)"
 # Observability event log: the run writes its full serve/health/fault
 # lifecycle here, and the audit below must be able to reconstruct the
 # whole fault cocktail from this file ALONE.
 EVENT_LOG="$(mktemp /tmp/ddp_tpu_smoke_events.XXXXXX.jsonl)"
 export DDP_TPU_EVENT_LOG="$EVENT_LOG"
-trap 'rm -f "$OUT" "$EVENT_LOG" "$EVENT_LOG".[0-9]*' EXIT
+# Incident flight recorder: armed for the faulted run — the injected
+# stuck step must make the stall watchdog AUTO-dump a post-mortem
+# bundle, and `obs doctor` must classify the incident from that
+# bundle alone.
+FLIGHT_DIR="$(mktemp -d /tmp/ddp_tpu_smoke_flight.XXXXXX)"
+export DDP_TPU_FLIGHT_DIR="$FLIGHT_DIR"
+trap 'rm -rf "$OUT" "$DOCTOR_OUT" "$EVENT_LOG" "$EVENT_LOG".[0-9]* "$FLIGHT_DIR"' EXIT
 
 echo "== serving soak: burst=$REQUESTS queue_limit=$QUEUE_LIMIT" \
      "+ stuck step + NaN slot"
@@ -83,5 +90,33 @@ then
          "fault cocktail" >&2
     exit 1
 fi
+# Incident response: the stall watchdog must have auto-dumped a
+# flight bundle, and `obs doctor` — reading NOTHING but the bundle —
+# must classify the incident as the injected fault kind and name
+# affected requests.
+grep -q 'flight bundle \[stall\]' "$OUT" || {
+    echo "== smoke_serve FAILED: stall did not auto-dump a flight" \
+         "bundle" >&2; exit 1; }
+BUNDLE="$(ls -d "$FLIGHT_DIR"/bundle-*-stall 2>/dev/null | head -n 1)"
+if [ -z "$BUNDLE" ]; then
+    echo "== smoke_serve FAILED: no stall bundle under $FLIGHT_DIR" >&2
+    exit 1
+fi
+if ! python -m distributed_dot_product_tpu.obs doctor "$BUNDLE" \
+        | tee "$DOCTOR_OUT"; then
+    echo "== smoke_serve FAILED: obs doctor could not read the" \
+         "bundle" >&2
+    exit 1
+fi
+grep -q 'INCIDENT: stuck_step' "$DOCTOR_OUT" || {
+    echo "== smoke_serve FAILED: doctor did not classify the injected" \
+         "stuck step (wanted INCIDENT: stuck_step)" >&2; exit 1; }
+grep -q 'injected fault: stuck_step' "$DOCTOR_OUT" || {
+    echo "== smoke_serve FAILED: doctor evidence misses the injected" \
+         "fault kind" >&2; exit 1; }
+grep -q 'affected requests' "$DOCTOR_OUT" || {
+    echo "== smoke_serve FAILED: doctor named no affected requests" >&2
+    exit 1; }
 echo "== smoke_serve OK: faults injected, recovered, streams intact," \
-     "event log reconstructs the cocktail"
+     "event log reconstructs the cocktail, doctor diagnosed the" \
+     "stall bundle"

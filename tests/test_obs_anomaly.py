@@ -1,0 +1,336 @@
+# -*- coding: utf-8 -*-
+"""
+Anomaly watchdog (obs/anomaly.py): detector semantics (EWMA z-score
+warmup/re-baseline, static thresholds, rate-of-change cliffs), watch
+reading (gauge / percentile / counter-rate / fn, absent series
+skipped), breach events + cooldowns, the profile/dump action chains,
+and the scheduler integration that generalizes the old one-off ttft
+trigger.
+"""
+
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import anomaly as anomaly_mod
+from distributed_dot_product_tpu.obs import flight
+from distributed_dot_product_tpu.obs.anomaly import (
+    AnomalyWatchdog, EwmaZScore, RateOfChange, StaticThreshold, Watch,
+    default_watches,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+# -- detectors -----------------------------------------------------------
+
+def test_static_threshold_above_below():
+    above = StaticThreshold(above=10.0)
+    assert above.update(9.9) is None
+    verdict = above.update(10.1)
+    assert verdict['kind'] == 'above' and verdict['threshold'] == 10.0
+    below = StaticThreshold(below=1.0)
+    assert below.update(1.0) is None
+    assert below.update(0.0)['kind'] == 'below'
+    with pytest.raises(ValueError):
+        StaticThreshold()
+
+
+def test_ewma_zscore_warms_up_then_flags_spikes():
+    det = EwmaZScore(z=4.0, alpha=0.2, min_samples=16)
+    # A wild warmup value must NOT breach: the baseline is cold.
+    for v in [0.01, 0.5, 0.01] + [0.01] * 13:
+        assert det.update(v) is None
+    # Steady state: small jitter stays in spec...
+    for _ in range(20):
+        assert det.update(0.0101) is None
+    # ...a spike breaches, with the full forensic fields.
+    verdict = det.update(5.0)
+    assert verdict is not None
+    assert verdict['kind'] == 'zscore'
+    assert abs(verdict['z']) > 4.0
+    assert verdict['mean'] < 0.1
+    assert verdict['threshold'] == 4.0
+
+
+def test_ewma_zscore_rebaselines_on_sustained_shift():
+    """A sustained level shift re-baselines (alerting forever on the
+    new normal would be noise, not detection)."""
+    det = EwmaZScore(z=4.0, alpha=0.3, min_samples=8)
+    for _ in range(20):
+        det.update(1.0)
+    assert det.update(100.0) is not None      # the shift itself flags
+    for _ in range(30):
+        det.update(100.0)
+    assert det.update(100.5) is None          # the new normal is quiet
+    det.reset()
+    assert det._n == 0
+
+
+def test_ewma_constant_stream_does_not_flag_jitter():
+    det = EwmaZScore(z=4.0, min_samples=8, min_sigma=1e-3)
+    for _ in range(20):
+        det.update(1.0)
+    # Variance is ~0; the sigma floor keeps harmless jitter in spec.
+    assert det.update(1.001) is None
+
+
+def test_rate_of_change_delta_and_ratio():
+    det = RateOfChange(max_delta=5.0)
+    assert det.update(10.0) is None           # first sample: no prev
+    assert det.update(12.0) is None
+    verdict = det.update(30.0)
+    assert verdict['kind'] == 'delta' and verdict['previous'] == 12.0
+    rel = RateOfChange(max_ratio=0.5)
+    rel.update(100.0)
+    assert rel.update(120.0) is None
+    assert rel.update(10.0)['kind'] == 'ratio'
+    with pytest.raises(ValueError):
+        RateOfChange()
+
+
+# -- watch reading -------------------------------------------------------
+
+def test_watch_reads_signals_and_skips_absent_series():
+    reg = MetricsRegistry()
+    w_gauge = Watch(name='g', metric='serve.queue_depth',
+                    detector=StaticThreshold(above=5), signal='gauge')
+    # Absent series: skipped, never created (peek, not get-or-create).
+    assert w_gauge.read(reg, now=0.0) is None
+    assert reg.snapshot()['gauges'] == {}
+    reg.gauge('serve.queue_depth').set(7)
+    assert w_gauge.read(reg, now=1.0) == 7.0
+
+    w_p99 = Watch(name='p', metric='serve.ttft_seconds',
+                  detector=StaticThreshold(above=5), signal='p99')
+    assert w_p99.read(reg, now=0.0) is None
+    h = reg.histogram('serve.ttft_seconds')
+    assert w_p99.read(reg, now=0.0) is None    # empty → NaN → skipped
+    h.observe(0.25)
+    assert w_p99.read(reg, now=1.0) == 0.25
+
+    w_rate = Watch(name='r', metric='serve.tokens_generated',
+                   detector=StaticThreshold(above=1e9),
+                   signal='counter', rate=True)
+    reg.counter('serve.tokens_generated').inc(10)
+    assert w_rate.read(reg, now=10.0) is None  # first sample anchors
+    reg.counter('serve.tokens_generated').inc(10)
+    assert w_rate.read(reg, now=12.0) == pytest.approx(5.0)
+
+    w_fn = Watch(name='f', metric='x', signal='fn',
+                 fn=lambda r: 42.0,
+                 detector=StaticThreshold(above=41))
+    assert w_fn.read(reg, now=0.0) == 42.0
+
+
+# -- the watchdog --------------------------------------------------------
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start(self, seconds=None, *, trigger='manual', event_log=None,
+              **extra):
+        self.calls.append(trigger)
+        return {'path': '/nowhere', 'seconds': seconds,
+                'trigger': trigger}
+
+
+def test_breach_emits_event_chains_profiler_and_dump(tmp_path):
+    reg = MetricsRegistry()
+    reg.gauge('serve.queue_depth').set(100)
+    prof = _FakeProfiler()
+    log = obs.EventLog(tmp_path / 'ev.jsonl')
+    dog = AnomalyWatchdog(
+        reg,
+        [Watch(name='depth', metric='serve.queue_depth',
+               detector=StaticThreshold(above=10), signal='gauge',
+               actions=('profile', 'dump'))],
+        profiler=prof, event_log=log, min_interval=0.0)
+    with flight.recording(base_dir=tmp_path / 'flight',
+                          registry=reg) as rec:
+        fired = dog.tick(force=True)
+    log.close()
+    assert len(fired) == 1
+    # The breach event validates against the closed vocabulary.
+    records, errors = obs.validate_file(tmp_path / 'ev.jsonl')
+    assert errors == []
+    breach = [r for r in records if r['event'] == 'anomaly.detected']
+    assert len(breach) == 1
+    assert breach[0]['metric'] == 'serve.queue_depth'
+    assert breach[0]['detector'] == 'StaticThreshold'
+    assert breach[0]['value'] == 100.0
+    assert breach[0]['watch'] == 'depth'
+    # Both actions chained: a capture and a flight bundle.
+    assert prof.calls == ['anomaly.depth']
+    assert len(rec.dumps) == 1
+    assert rec.dumps[0]['trigger'] == 'anomaly'
+    assert 'depth' in rec.dumps[0]['reason']
+    # Counters moved.
+    counters = reg.snapshot()['counters']
+    assert counters['anomaly.breaches'] == 1
+    assert counters['anomaly.breaches.depth'] == 1
+
+
+def test_unchanged_reading_not_refed_no_variance_collapse():
+    """A constant histogram p99 re-read every tick must NOT collapse
+    an EWMA detector's variance: between real observations the tick
+    cadence outruns the stream, and re-feeding the same value would
+    make the next tiny jitter an astronomical z — a false breach on a
+    healthy service (regression: the detector only sees DISTINCT
+    readings)."""
+    reg = MetricsRegistry()
+    h = reg.histogram('serve.ttft_seconds')
+    det = EwmaZScore(z=4.0, min_samples=4)
+    dog = AnomalyWatchdog(
+        reg,
+        [Watch(name='ttft', metric='serve.ttft_seconds',
+               detector=det, signal='p99')],
+        min_interval=0.0)
+    # A handful of real, slightly-varying observations...
+    for v in (0.010, 0.011, 0.0105, 0.0102, 0.0108, 0.0101):
+        h.observe(v)
+        dog.tick(force=True)
+    # ...then 200 idle ticks over the unchanged reservoir: the
+    # detector must be fed nothing (its sample count freezes).
+    n_before = det._n
+    for _ in range(200):
+        assert dog.tick(force=True) == []
+    assert det._n == n_before
+    # A fresh observation with ordinary jitter stays in spec.
+    h.observe(0.0115)
+    assert dog.tick(force=True) == []
+    assert dog.breaches == []
+
+
+def test_breach_cooldown_suppresses_re_alerts():
+    reg = MetricsRegistry()
+    reg.gauge('serve.queue_depth').set(100)
+    dog = AnomalyWatchdog(
+        reg,
+        [Watch(name='depth', metric='serve.queue_depth',
+               detector=StaticThreshold(above=10), signal='gauge',
+               cooldown=3600.0)],
+        min_interval=0.0)
+    assert len(dog.tick(force=True)) == 1
+    assert dog.tick(force=True) == []          # inside the cooldown
+    assert len(dog.breaches) == 1
+
+
+def test_tick_throttles_on_real_time():
+    reg = MetricsRegistry()
+    dog = AnomalyWatchdog(reg, [], min_interval=3600.0)
+    dog.tick()
+    reg.gauge('serve.queue_depth').set(100)
+    dog.watches.append(
+        Watch(name='depth', metric='serve.queue_depth',
+              detector=StaticThreshold(above=10), signal='gauge'))
+    assert dog.tick() == []                    # throttled
+    assert len(dog.tick(force=True)) == 1
+
+
+def test_broken_detector_is_contained():
+    reg = MetricsRegistry()
+    reg.gauge('g').set(1)
+    dog = AnomalyWatchdog(
+        reg,
+        [Watch(name='bad', metric='g', signal='fn',
+               fn=lambda r: (_ for _ in ()).throw(RuntimeError('x')),
+               detector=StaticThreshold(above=0)),
+         Watch(name='good', metric='g', signal='gauge',
+               detector=StaticThreshold(above=0))],
+        min_interval=0.0)
+    fired = dog.tick(force=True)      # the bad watch must not stop
+    assert [w.name for w, _ in fired] == ['good']
+    assert reg.snapshot()['counters'][
+        'exceptions_swallowed.anomaly.read'] == 1
+
+
+def test_default_watches_catalog():
+    watches = default_watches(queue_limit=8, paged=True)
+    names = {w.name for w in watches}
+    assert names == {'ttft_p99', 'tokens_per_s', 'queue_depth',
+                     'reject_rate', 'pages_free'}
+    by_name = {w.name: w for w in watches}
+    assert by_name['ttft_p99'].actions == ('profile', 'dump')
+    assert isinstance(by_name['queue_depth'].detector, StaticThreshold)
+    assert by_name['queue_depth'].detector.above == pytest.approx(7.2)
+    assert isinstance(by_name['pages_free'].detector, StaticThreshold)
+    assert by_name['pages_free'].detector.below == 1
+    # Slab catalog: no pages watch; no queue_limit → EWMA depth.
+    slab = {w.name: w for w in default_watches()}
+    assert 'pages_free' not in slab
+    assert isinstance(slab['queue_depth'].detector, EwmaZScore)
+
+
+def test_reject_total_sums_typed_counters():
+    reg = MetricsRegistry()
+    reg.counter('serve.rejected.queue_full').inc(3)
+    reg.counter('serve.rejected.deadline_exceeded').inc(2)
+    assert anomaly_mod._reject_total(reg) == 5.0
+
+
+# -- scheduler integration ----------------------------------------------
+
+def test_scheduler_anomaly_tick_fires_and_logs(tmp_path):
+    """A scheduler armed with a custom watchdog breaches
+    deterministically (static threshold on queue depth under an
+    overflowing burst), the breach lands in the run's event log, and
+    the chained flight dump is written — the PR-6 one-off ttft
+    trigger, generalized."""
+    import numpy as np
+
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, RejectedError, Scheduler, ServeConfig,
+    )
+    reg = MetricsRegistry()
+    log = obs.EventLog(tmp_path / 'ev.jsonl')
+    dog = AnomalyWatchdog(
+        reg,
+        [Watch(name='queue_depth', metric='serve.queue_depth',
+               detector=StaticThreshold(above=2.5), signal='gauge',
+               actions=('dump',))],
+        event_log=log, min_interval=0.0)
+    eng = KernelEngine(slots=2, t_max=32, vocab=16, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       decode_impl='xla')
+    with flight.recording(base_dir=tmp_path / 'flight',
+                          registry=reg) as rec:
+        sched = Scheduler(
+            eng, ServeConfig(queue_limit=6, max_new_tokens=3,
+                             watchdog=False,
+                             evict_before_reject=False),
+            fault_injector=False, registry=reg,
+            event_log=log, anomaly=dog)
+        rng = np.random.default_rng(3)
+        for i in range(10):
+            try:
+                sched.submit(rng.integers(0, 16, size=3).astype(
+                    np.int32), request_id=f'r{i}')
+            except RejectedError:
+                pass
+        sched.run_until_idle()
+        sched.close()
+    log.close()
+    assert len(dog.breaches) >= 1
+    records, errors = obs.validate_file(tmp_path / 'ev.jsonl')
+    assert errors == []
+    assert any(r['event'] == 'anomaly.detected'
+               and r['watch'] == 'queue_depth' for r in records)
+    assert any(d['trigger'] == 'anomaly' for d in rec.dumps)
+
+
+def test_serveconfig_anomaly_true_builds_stock_catalog():
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, Scheduler, ServeConfig,
+    )
+    eng = KernelEngine(slots=2, t_max=16, vocab=16, heads=2,
+                       head_dim=4, seed=0, decode_impl='xla')
+    sched = Scheduler(eng, ServeConfig(watchdog=False, anomaly=True),
+                      registry=MetricsRegistry())
+    try:
+        assert sched._anomaly is not None
+        assert {w.name for w in sched._anomaly.watches} >= {
+            'ttft_p99', 'tokens_per_s', 'queue_depth', 'reject_rate'}
+    finally:
+        sched.close()

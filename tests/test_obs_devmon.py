@@ -148,6 +148,38 @@ def test_capture_writes_loadable_trace_and_event(tmp_path):
     assert caps[0]['path'] == info['path']
 
 
+def test_warmup_pays_init_once_and_is_guarded(tmp_path):
+    """warmup() pays the profiler's one-time native init (a real
+    throwaway start/stop trace) exactly once: the first call warms,
+    later calls are no-ops, a real capture also marks the instance
+    warmed, and warming is refused while a capture is in flight."""
+    reg = MetricsRegistry()
+    prof = ProfileCapture(tmp_path / 'traces', registry=reg)
+    assert not prof.warmed
+    assert prof.warmup() is True
+    assert prof.warmed
+    assert (tmp_path / 'traces' / 'warmup').exists()
+    assert prof.warmup() is False        # idempotent
+    # No phantom accounting: warmup is not a capture.
+    assert reg.counter('profile.captures').value == 0
+    assert reg.gauge('profile.capture_in_flight').value == 0
+
+    # While a capture is in flight, warmup is refused like a second
+    # capture (flag forced directly: a real capture's worker can lose
+    # the flag fast under profiler contention, making the race
+    # untestable end-to-end).
+    prof2 = ProfileCapture(tmp_path / 't2', registry=MetricsRegistry())
+    prof2._in_flight = True
+    with pytest.raises(CaptureInFlight):
+        prof2.warmup()
+    prof2._in_flight = False
+    # A real capture pays the init too: the instance comes out warmed.
+    prof2.start(0.01)
+    assert prof2.join(60.0)
+    assert prof2.warmed
+    assert prof2.warmup() is False
+
+
 def test_capture_seconds_clamped_and_validated(tmp_path):
     prof = ProfileCapture(tmp_path, registry=MetricsRegistry(),
                           max_seconds=0.05, clock=lambda s: None)

@@ -1,0 +1,144 @@
+# -*- coding: utf-8 -*-
+"""
+Bundle diagnosis (obs/doctor.py): each incident class classified from
+a synthetic bundle carrying its signature evidence, tie-break order,
+affected-party naming, and the human rendering.
+"""
+
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import doctor as obs_doctor
+from distributed_dot_product_tpu.obs import flight
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+def _bundle_from(tmp_path, emit_fn, *, trigger='manual', registry=None):
+    """One bundle whose ring holds exactly the events ``emit_fn``
+    writes."""
+    reg = registry or MetricsRegistry()
+    with flight.recording(base_dir=tmp_path / 'flight',
+                          registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        emit_fn(log)
+        log.close()
+        path = rec.dump_bundle(trigger=trigger)
+    return flight.load_bundle(path)
+
+
+def test_cache_exhaustion_classified(tmp_path):
+    def emit(log):
+        log.emit('serve.admit', request_id='a', slot=0, tenant='t0',
+                 queue_wait=0.0)
+        log.emit('serve.preempt', request_id='a', slot=0,
+                 requeued=True)
+        log.emit('serve.admit', request_id='a', slot=1, tenant='t0',
+                 queue_wait=0.1)
+        log.emit('serve.preempt', request_id='a', slot=1,
+                 requeued=False)
+        log.emit('serve.evict', request_id='a', slot=1)
+        log.emit('serve.retire', request_id='a', status='evicted',
+                 reason='cache_exhausted', tenant='t0')
+        log.emit('serve.reject', request_id='b',
+                 reason='cache_exhausted', tenant='t0')
+
+    incident = obs_doctor.diagnose(_bundle_from(tmp_path, emit))
+    assert incident.primary == 'cache_exhaustion'
+    assert incident.affected['preempted'] == ['a']
+    assert incident.affected['rejected'] == ['b']
+    out = obs_doctor.render_incident(incident)
+    assert 'cache_exhausted' in out and 'preemption' in out
+
+
+def test_cache_exhaustion_pages_free_sample_counts(tmp_path):
+    """The metric-sample channel is evidence too: a sample showing
+    pages_free == 0 with pages in use votes even without events."""
+    reg = MetricsRegistry()
+    reg.gauge('serve.cache.pages_free').set(0)
+    reg.gauge('serve.cache.pages_used').set(16)
+    incident = obs_doctor.diagnose(
+        _bundle_from(tmp_path, lambda log: None, registry=reg))
+    assert incident.classes['cache_exhaustion']['score'] > 0
+    assert incident.primary == 'cache_exhaustion'
+
+
+def test_deadline_storm_classified(tmp_path):
+    def emit(log):
+        for i in range(4):
+            log.emit('serve.reject', request_id=f'd{i}',
+                     reason='deadline_exceeded', tenant='t0')
+        log.emit('serve.admit', request_id='e', slot=0, tenant='t0',
+                 queue_wait=0.0)
+        log.emit('serve.retire', request_id='e',
+                 status='deadline_expired', tenant='t0')
+
+    incident = obs_doctor.diagnose(_bundle_from(tmp_path, emit))
+    assert incident.primary == 'deadline_storm'
+    assert incident.affected['rejected'] == [f'd{i}' for i in range(4)]
+    assert incident.affected['failed'] == ['e']
+
+
+def test_overload_classified_and_tenants_named(tmp_path):
+    def emit(log):
+        for i in range(6):
+            log.emit('serve.reject', request_id=f'q{i}',
+                     reason='queue_full',
+                     tenant='free' if i % 2 else 'paid')
+        log.emit('health.readiness', state='not_ready',
+                 reason='queue full')
+        log.emit('serve.admit', request_id='ok', slot=0, tenant='paid',
+                 queue_wait=0.0)
+        log.emit('serve.decode', request_id='ok', slot=0,
+                 token_index=0, ttft=0.01)
+        log.emit('serve.retire', request_id='ok', status='completed',
+                 total_seconds=0.05, tenant='paid')
+
+    incident = obs_doctor.diagnose(_bundle_from(tmp_path, emit))
+    assert incident.primary == 'overload'
+    assert set(incident.tenants) == {'free', 'paid'}
+    assert incident.tenants['paid']['met'] == 1
+    assert incident.tenants['free']['rejected'] == 3
+    out = obs_doctor.render_incident(incident)
+    assert 'queue_full' in out
+    assert 'free' in out and 'paid' in out
+
+
+def test_empty_bundle_is_inconclusive_with_note(tmp_path):
+    incident = obs_doctor.diagnose(
+        _bundle_from(tmp_path, lambda log: None))
+    assert incident.primary is None
+    assert any('no events' in n for n in incident.notes)
+    out = obs_doctor.render_incident(incident)
+    assert 'inconclusive' in out
+
+
+def test_ring_truncation_is_noted(tmp_path):
+    reg = MetricsRegistry()
+    with flight.recording(base_dir=tmp_path / 'flight', registry=reg,
+                          max_records=4) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        for i in range(10):
+            log.emit('serve.reject', request_id=f'r{i}',
+                     reason='queue_full', tenant='t0')
+        log.close()
+        path = rec.dump_bundle(trigger='manual')
+    incident = obs_doctor.diagnose(path)
+    # 6 events evicted by the record cap, plus the dump-time forced
+    # metric/device sample pair that shares the same bound.
+    assert incident.window['ring_dropped'] >= 6
+    assert any('truncated' in n for n in incident.notes)
+
+
+def test_anomaly_verdicts_ride_along(tmp_path):
+    def emit(log):
+        log.emit('anomaly.detected', metric='serve.cache.pages_free',
+                 detector='StaticThreshold', value=0.0,
+                 watch='pages_free')
+
+    incident = obs_doctor.diagnose(_bundle_from(tmp_path, emit))
+    assert len(incident.anomalies) == 1
+    assert incident.classes['cache_exhaustion']['score'] > 0
+    out = obs_doctor.render_incident(incident)
+    assert 'anomaly' in out and 'pages_free' in out

@@ -57,6 +57,32 @@ def test_render_counters_gauges_histograms():
     assert '# TYPE ddp_serve_step_seconds summary' in text
 
 
+def test_build_info_gauge_always_rendered():
+    """Every render carries the constant ddp_build_info gauge —
+    schema/jax/python versions as labels, value 1 — even over an empty
+    registry (a merged multi-replica scrape detects version skew from
+    the scrape alone)."""
+    import platform
+
+    import jax
+
+    from distributed_dot_product_tpu.obs import events as obs_events
+    text = render_prometheus(MetricsRegistry())
+    _assert_valid_exposition(text)
+    assert '# TYPE ddp_build_info gauge' in text
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith('ddp_build_info{'))
+    assert line.endswith(' 1')
+    assert f'schema_version="{obs_events.SCHEMA_VERSION}"' in line
+    assert f'jax_version="{jax.__version__}"' in line
+    assert f'python_version="{platform.python_version()}"' in line
+    # Present next to real metrics too, exactly once.
+    reg = MetricsRegistry()
+    reg.counter('serve.admitted').inc()
+    text = render_prometheus(reg)
+    assert text.count('ddp_build_info{') == 1
+
+
 def test_label_escaping_round_trip():
     assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
     reg = MetricsRegistry()

@@ -1,0 +1,462 @@
+# -*- coding: utf-8 -*-
+"""
+Incident flight recorder (obs/flight.py): zero-alloc disabled path,
+hard ring bounds, bundle validity (obs validate / reconstruct / slo run
+on the ring JSONL unchanged — including a rotation-boundary source log
+and a torn tail), the /dump endpoint, SIGTERM chaining, and the tier-1
+acceptance: under the burst+stuck+NaN fault cocktail the watchdog
+stall AUTO-dumps a bundle and `obs doctor` classifies the incident —
+naming the injected fault kind and the affected request ids/tenants —
+from the bundle alone.
+"""
+
+import json
+import os
+import signal
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import doctor as obs_doctor
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs import flight
+from distributed_dot_product_tpu.obs.__main__ import main as obs_main
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_state():
+    """Every test starts with no recorder installed, no stray
+    providers, and leaves the module state as it found it."""
+    prev_recorder = flight.get_recorder()
+    prev_providers = dict(flight._PROVIDERS)
+    flight.install(None)
+    yield
+    flight.install(prev_recorder)
+    flight._PROVIDERS.clear()
+    flight._PROVIDERS.update(prev_providers)
+
+
+def _emit_lifecycle(log, rid, tenant='default', tokens=2,
+                    status='completed'):
+    log.emit('serve.admit', request_id=rid, slot=0, tenant=tenant,
+             queue_wait=0.0, prompt_len=2, requeues=0)
+    for i in range(tokens):
+        fields = dict(request_id=rid, slot=0, token_index=i)
+        if i == 0:
+            fields['ttft'] = 0.01
+        else:
+            fields['gap'] = 0.002
+        log.emit('serve.decode', **fields)
+    log.emit('serve.retire', request_id=rid, status=status,
+             tokens=tokens, total_seconds=0.02, tenant=tenant)
+
+
+# -- disabled path -------------------------------------------------------
+
+def test_disabled_recorder_is_shared_null_object():
+    """The spans contract: with nothing installed, recorder() returns
+    ONE shared null object (no allocation per call), the events tee is
+    a plain None, and emitting events records nothing anywhere."""
+    a, b = flight.recorder(), flight.recorder()
+    assert a is b is flight._NULL
+    assert flight.get_recorder() is None
+    assert obs_events._TEE is None
+    # The null surface is inert end to end.
+    assert a.sample() is False
+    assert a.maybe_dump(trigger='stall') is None
+    assert a.dump_bundle() is None
+    assert a.stats()['records'] == 0
+
+
+def test_install_wires_and_unwires_the_tee(tmp_path):
+    rec = flight.FlightRecorder(tmp_path, registry=MetricsRegistry())
+    prev = flight.install(rec)
+    assert prev is None
+    assert flight.recorder() is rec
+    assert obs_events._TEE is not None
+    flight.install(None)
+    assert obs_events._TEE is None
+    assert flight.recorder() is flight._NULL
+
+
+# -- the ring ------------------------------------------------------------
+
+def test_ring_is_hard_bounded_in_records_and_bytes(tmp_path):
+    """Both bounds enforced: the record cap caps the deque, the byte
+    cap evicts oldest-first even below the record cap; evictions are
+    counted, never silent."""
+    reg = MetricsRegistry()
+    rec = flight.FlightRecorder(tmp_path, max_records=8,
+                                max_bytes=100_000, registry=reg)
+    for i in range(50):
+        rec._add('event', json.dumps({'i': i, 'pad': 'x' * 20}))
+    stats = rec.stats()
+    assert stats['records'] <= 8
+    assert stats['dropped'] == 50 - stats['records']
+    # Byte bound alone (record bound loose): oldest evicted until fit.
+    rec2 = flight.FlightRecorder(tmp_path, max_records=10_000,
+                                 max_bytes=500, registry=reg)
+    line = 'y' * 100
+    for _ in range(50):
+        rec2._add('event', line)
+    stats2 = rec2.stats()
+    assert stats2['bytes'] <= 500
+    assert stats2['records'] == 5
+    assert stats2['dropped'] == 45
+
+
+def test_tee_captures_event_log_emits(tmp_path):
+    reg = MetricsRegistry()
+    with flight.recording(base_dir=tmp_path, registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        _emit_lifecycle(log, 'r0')
+        log.close()
+        assert rec.stats()['teed'] == 4
+        path = rec.dump_bundle(trigger='manual')
+    bundle = flight.load_bundle(path)
+    assert [r['event'] for r in bundle['events']] == [
+        'serve.admit', 'serve.decode', 'serve.decode', 'serve.retire']
+    # The teed lines are byte-identical to what the log wrote.
+    with open(tmp_path / 'ev.jsonl', encoding='utf-8') as f:
+        assert len(f.read().splitlines()) == 4
+
+
+# -- bundle validity -----------------------------------------------------
+
+def test_bundle_ring_jsonl_validates_and_reconstructs(tmp_path,
+                                                      capsys):
+    """The acceptance contract for the ring window: `obs validate
+    --require` exits 0 over the bundle's events.jsonl and
+    reconstruct() rebuilds complete timelines — INCLUDING when the
+    source log rotated mid-window (events spanning path.1 + the live
+    file) and when the bundle's own tail is torn."""
+    reg = MetricsRegistry()
+    with flight.recording(base_dir=tmp_path, registry=reg) as rec:
+        # Tiny rotate_bytes: the lifecycle stream spans rotations.
+        log = obs.EventLog(tmp_path / 'rot.jsonl', rotate_bytes=400,
+                           keep_rotations=5)
+        for i in range(6):
+            _emit_lifecycle(log, f'r{i}', tenant=f't{i % 2}')
+        log.close()
+        assert log.rotations >= 1, 'source log never rotated — the ' \
+                                   'boundary case is not exercised'
+        path = rec.dump_bundle(trigger='manual')
+
+    bundle = flight.load_bundle(path)
+    # 1. CLI validation, with required events, over the ring JSONL.
+    rc = obs_main(['validate', bundle['events_path'],
+                   '--timelines',
+                   '--require', 'serve.admit,serve.decode,serve.retire'])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    # 2. Library reconstruction: every request complete, tenants kept.
+    timelines = obs.reconstruct(bundle['events_path'])
+    assert set(timelines) == {f'r{i}' for i in range(6)}
+    assert all(tl.complete for tl in timelines.values())
+    # 3. Goodput accounting runs on the same records unchanged.
+    report = obs.goodput(bundle['events'], obs.SloSpec())
+    assert report.requests == 6
+    assert set(report.per_tenant) == {'t0', 't1'}
+
+    # 4. Torn tail: truncate the bundle's last line mid-record — the
+    # readers must tolerate it (crash-mid-dump semantics).
+    with open(bundle['events_path'], 'r+', encoding='utf-8') as f:
+        data = f.read()
+        f.seek(0)
+        f.write(data[:-25])
+        f.truncate()
+    _, errors = obs.validate_file(bundle['events_path'])
+    assert errors == []
+    timelines = obs.reconstruct(bundle['events_path'])
+    assert len(timelines) == 6      # the torn record was r5's retire
+    reloaded = flight.load_bundle(path)
+    assert len(reloaded['events']) == len(bundle['events']) - 1
+
+
+def test_bundle_layout_and_manifest(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter('serve.completed').inc(3)
+    flight.add_provider('custom', lambda: {'hello': 'world'})
+    with flight.recording(base_dir=tmp_path, registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        _emit_lifecycle(log, 'r0')
+        log.close()
+        path = rec.dump_bundle(trigger='manual', reason='layout test',
+                               sections={'extra': {'k': 1}})
+    for fname in ('MANIFEST.json', 'events.jsonl', 'metrics.json',
+                  'metric_samples.jsonl', 'device_samples.jsonl',
+                  'stacks.json', 'custom.json', 'extra.json'):
+        assert os.path.exists(os.path.join(path, fname)), fname
+    bundle = flight.load_bundle(path)
+    man = bundle['manifest']
+    assert man['schema'] == flight.BUNDLE_SCHEMA
+    assert man['trigger'] == 'manual'
+    assert man['reason'] == 'layout test'
+    assert man['event_schema_version'] == obs_events.SCHEMA_VERSION
+    assert man['python_version']
+    assert bundle['metrics']['counters']['serve.completed'] == 3
+    # The forced dump-time sample landed.
+    assert len(bundle['metric_samples']) >= 1
+    assert len(bundle['device_samples']) >= 1
+    assert bundle['sections']['custom'] == {'hello': 'world'}
+    assert bundle['sections']['extra'] == {'k': 1}
+    # Every live thread (at least this one) has a stack.
+    assert any('MainThread' in name for name in bundle['stacks'])
+
+
+def test_postmortem_dump_event_emitted_and_valid(tmp_path):
+    reg = MetricsRegistry()
+    log = obs.EventLog(tmp_path / 'ev.jsonl')
+    with flight.recording(base_dir=tmp_path, registry=reg) as rec, \
+            obs.activate(log):
+        path = rec.dump_bundle(trigger='manual')
+    log.close()
+    records, errors = obs.validate_file(tmp_path / 'ev.jsonl')
+    assert errors == []
+    dumps = [r for r in records if r['event'] == 'postmortem.dump']
+    assert len(dumps) == 1
+    assert dumps[0]['trigger'] == 'manual'
+    assert dumps[0]['path'] == path
+
+
+def test_maybe_dump_cooldown_rate_limits_per_trigger(tmp_path):
+    reg = MetricsRegistry()
+    rec = flight.FlightRecorder(tmp_path, registry=reg,
+                                dump_cooldown=3600.0)
+    first = rec.maybe_dump(trigger='stall')
+    assert first is not None
+    assert rec.maybe_dump(trigger='stall') is None     # suppressed
+    # A DIFFERENT trigger has its own budget.
+    assert rec.maybe_dump(trigger='nan_storm') is not None
+    # dump_bundle stays direct (the operator's explicit request).
+    assert rec.dump_bundle(trigger='manual') is not None
+
+
+def test_failed_dump_does_not_consume_the_cooldown(tmp_path):
+    """The cooldown anchors on SUCCESS: a dump that failed (disk
+    full, unwritable base_dir) must not suppress the retry the
+    still-firing trigger requests (regression)."""
+    rec = flight.FlightRecorder(tmp_path, registry=MetricsRegistry(),
+                                dump_cooldown=3600.0)
+    orig = rec.dump_bundle
+
+    def _boom(*args, **kwargs):
+        raise OSError('disk full')
+
+    rec.dump_bundle = _boom
+    with pytest.raises(OSError):
+        rec.maybe_dump(trigger='stall')
+    # The failure propagated (the scheduler's _flight_dump logs it)
+    # AND left the trigger's budget intact: the retry dumps.
+    rec.dump_bundle = orig
+    assert rec.maybe_dump(trigger='stall') is not None
+    # A SUCCESSFUL dump does consume the budget.
+    assert rec.maybe_dump(trigger='stall') is None
+
+
+def test_load_bundle_rejects_non_bundles(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        flight.load_bundle(tmp_path)
+    (tmp_path / 'MANIFEST.json').write_text('{"schema": 99}')
+    with pytest.raises(ValueError, match='schema'):
+        flight.load_bundle(tmp_path)
+    assert obs_main(['doctor', str(tmp_path)]) == 1
+
+
+def test_open_from_env(tmp_path):
+    assert flight.open_from_env(environ={}) is None
+    rec = flight.open_from_env(
+        environ={'DDP_TPU_FLIGHT_DIR': str(tmp_path)},
+        registry=MetricsRegistry())
+    assert rec is not None
+    assert rec.base_dir == str(tmp_path)
+
+
+def test_sample_throttles_on_real_time(tmp_path):
+    reg = MetricsRegistry()
+    rec = flight.FlightRecorder(tmp_path, registry=reg,
+                                sample_interval=3600.0)
+    assert rec.sample() is True
+    assert rec.sample() is False        # inside the interval
+    assert rec.sample(force=True) is True
+
+
+# -- HTTP /dump ----------------------------------------------------------
+
+def test_dump_endpoint(tmp_path):
+    reg = MetricsRegistry()
+    srv = obs.MetricsServer(reg).start()
+    try:
+        # No recorder installed: 404, like the profiler-less /profile.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f'{srv.url}/dump', timeout=60)
+        assert exc.value.code == 404
+        with flight.recording(base_dir=tmp_path, registry=reg):
+            with urllib.request.urlopen(
+                    f'{srv.url}/dump?reason=operator+poke',
+                    timeout=60) as resp:
+                body = json.loads(resp.read())
+            assert resp.status == 200
+        assert os.path.exists(os.path.join(body['path'],
+                                           'MANIFEST.json'))
+        man = json.load(open(os.path.join(body['path'],
+                                          'MANIFEST.json')))
+        assert man['trigger'] == 'http'
+        assert man['reason'] == 'operator poke'
+    finally:
+        srv.stop()
+
+
+# -- SIGTERM trigger -----------------------------------------------------
+
+def test_sigterm_trigger_dumps_and_chains(tmp_path):
+    """install_sigterm dumps a bundle and then calls the PREVIOUS
+    handler — the training driver's final-save handler keeps
+    working."""
+    chained = threading.Event()
+    prev = signal.signal(signal.SIGTERM,
+                         lambda signum, frame: chained.set())
+    rec = flight.FlightRecorder(tmp_path, registry=MetricsRegistry())
+    try:
+        rec.install_sigterm()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert chained.wait(5.0), 'previous SIGTERM handler not chained'
+        assert len(rec.dumps) == 1
+        assert rec.dumps[0]['trigger'] == 'sigterm'
+    finally:
+        rec.uninstall_sigterm()
+        signal.signal(signal.SIGTERM, prev)
+
+
+# -- the tier-1 acceptance: cocktail → stall auto-dump → doctor ----------
+
+def _run_cocktail(tmp_path):
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, RejectedError, Scheduler, ServeConfig,
+    )
+    from distributed_dot_product_tpu.utils.faults import (
+        ServeFaultInjector, ServeFaultPlan,
+    )
+    reg = MetricsRegistry()
+    log = obs.EventLog(tmp_path / 'ev.jsonl')
+    rec = flight.FlightRecorder(tmp_path / 'flight', registry=reg,
+                                sample_interval=0.05)
+    flight.install(rec)
+    try:
+        eng = KernelEngine(slots=3, t_max=32, vocab=16, heads=2,
+                           head_dim=4, prefill_chunk=4, seed=5,
+                           decode_impl='xla')
+        # Warm the compiled programs: the watchdog's first stall must
+        # be the INJECTED one, not the first-compile pause
+        # (examples/serve_lm.py documents the same dance).
+        eng.step(np.zeros(3, np.int32), np.ones(3, bool))
+        eng.prefill(0, np.asarray([0], np.int32))
+        for i in range(3):
+            eng.reset(i)
+        plan = ServeFaultPlan(stuck_at_step=3, stuck_seconds=0.5,
+                              nan_at_step=5, nan_slot=1)
+        sched = Scheduler(
+            eng,
+            ServeConfig(queue_limit=4, max_new_tokens=4,
+                        stall_timeout=0.15, watchdog_poll=0.02,
+                        evict_before_reject=False),
+            fault_injector=ServeFaultInjector(plan), registry=reg,
+            event_log=log)
+        rng = np.random.default_rng(11)
+        rejected = []
+        for i in range(14):
+            prompt = rng.integers(
+                0, 16, size=int(rng.integers(1, 7))).astype(np.int32)
+            try:
+                sched.submit(prompt, request_id=f'r{i:03d}',
+                             tenant='paid' if i % 2 else 'free')
+            except RejectedError:
+                rejected.append(f'r{i:03d}')
+            if i % 3 == 2:      # interleave serving with the burst
+                sched.step()
+        results = sched.run_until_idle()
+        sched.close()
+        assert sched.health.stall_events >= 1
+        assert reg.snapshot()['counters']['serve.nan_quarantined'] >= 1
+        assert rejected, 'burst never overflowed the queue'
+        return rec, log, results
+    finally:
+        flight.install(None)
+        log.close()
+
+
+def test_cocktail_stall_autodumps_bundle_and_doctor_classifies(
+        tmp_path, capsys):
+    """ISSUE 10 acceptance: burst + stuck step + NaN slot with faults
+    ENABLED — the stall auto-dumps a bundle, and `obs doctor`,
+    reading NOTHING but that bundle, classifies the incident naming
+    the injected fault kind and the affected request ids and
+    tenants."""
+    rec, log, results = _run_cocktail(tmp_path)
+
+    # The watchdog stall AUTO-dumped (no manual dump call anywhere).
+    stall_dumps = [d for d in rec.dumps if d['trigger'] == 'stall']
+    assert len(stall_dumps) == 1, rec.dumps
+    bundle_path = stall_dumps[0]['path']
+
+    # Doctor runs from the bundle directory alone (CLI surface).
+    rc = obs_main(['doctor', bundle_path])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    # Classification names the injected fault kind...
+    assert 'INCIDENT: stuck_step' in out
+    assert 'injected fault: stuck_step' in out
+    # ...and the affected request ids and tenants.
+    assert 'affected requests' in out
+    assert 'r00' in out
+    assert 'free' in out and 'paid' in out
+
+    # Library surface agrees, with structured evidence.
+    incident = obs_doctor.diagnose(bundle_path)
+    assert incident.primary == 'stuck_step'
+    assert incident.classes['stuck_step']['score'] \
+        > incident.classes['overload']['score']
+    assert incident.affected['in_flight'], \
+        'the slot table at stall time names nobody'
+    assert set(incident.tenants) == {'free', 'paid'}
+
+    # An end-of-run bundle (same ring, later window) carries the NaN
+    # evidence too: the quarantined request is named.
+    final = rec.dump_bundle(trigger='manual', reason='post-run')
+    incident2 = obs_doctor.diagnose(final)
+    assert incident2.classes['nan_storm']['score'] > 0
+    assert incident2.affected['quarantined'], \
+        'quarantined request not named'
+    quarantined = incident2.affected['quarantined'][0]
+    rc = obs_main(['doctor', final])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert quarantined in out
+    # Ring accounting is honest in the MANIFEST.
+    man = flight.load_bundle(final)['manifest']
+    assert man['ring']['records'] > 0
+    assert man['ring']['max_records'] == 2048
+
+
+def test_doctor_json_output(tmp_path, capsys):
+    reg = MetricsRegistry()
+    with flight.recording(base_dir=tmp_path, registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        _emit_lifecycle(log, 'r0', status='failed_nan')
+        log.emit('serve.quarantine', request_id='r0', slot=0,
+                 requeued=False)
+        log.close()
+        path = rec.dump_bundle(trigger='nan_storm')
+    rc = obs_main(['doctor', path, '--json'])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload['primary'] == 'nan_storm'
+    assert payload['trigger'] == 'nan_storm'
