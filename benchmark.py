@@ -184,7 +184,23 @@ def parse_args():
                         help='serve-load mode: write the run\'s JSONL '
                              'event log here (the goodput report is '
                              'computed from it ALONE; default: a '
-                             'temp file)')
+                             'temp file). With --topology it is the '
+                             'log DIRECTORY: one log per member '
+                             '(router/prefill/r0/r1/... + twin)')
+    parser.add_argument('--topology', default=None,
+                        help="serve-load mode: run the trace against a "
+                             "disaggregated 'PxD' topology (P prefill "
+                             "pools x D decode replicas, e.g. 1x2) "
+                             "through the router, AND against its "
+                             "single-process twin (one replica's "
+                             "engine) on the identical trace — the "
+                             "row records both goodputs and the "
+                             "routing telemetry")
+    parser.add_argument('--prefill-threshold', type=int, default=8,
+                        help='--topology: prefix rows at/above which a '
+                             'fresh prompt offloads to the prefill '
+                             'pool (below it the replica prefills '
+                             'locally)')
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -1135,6 +1151,172 @@ def run_decode_serve(args):
     return record
 
 
+def run_serve_load_topology(args):
+    """``--mode serve-load --topology 1x2``: the disaggregated-serving
+    row. The SAME seeded trace (serialized to ``trace.json`` and read
+    back — both runs consume the byte-identical file) drives (a) the
+    router over a P-prefill-pool / D-decode-replica topology (each
+    replica its own paged engine + scheduler + event log; long prompts
+    prefill sequence-sharded across the mesh and hand off as pool
+    pages) and (b) the single-process twin (ONE replica's engine
+    behind one scheduler). Goodput for the topology is computed over
+    the MERGED per-member logs — the run asserts every submitted
+    request reconstructs exactly once across them — and the twin's
+    over its own log; the row records both plus the routing telemetry
+    (per-replica placements, prefix hits, handoffs)."""
+    import tempfile
+
+    from distributed_dot_product_tpu import obs
+    from distributed_dot_product_tpu.obs import slo as obs_slo
+    from distributed_dot_product_tpu.serve import (
+        KernelEngine, LoadGenConfig, RouterConfig, Scheduler,
+        ServeConfig, TopologyConfig, VirtualClock, build_serving,
+        default_tenants, generate_trace, load_trace, parse_topology,
+        run_trace, save_trace,
+    )
+    from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+    prefill_pools, decode_replicas = parse_topology(args.topology)
+    slots = args.batch if args.batch > 1 else 4
+    t_max = args.seq_len or 96
+    if t_max % args.page_size:
+        raise SystemExit(f'--page-size {args.page_size} must divide '
+                         f'the cache length {t_max}')
+    decode_impl = (None if args.decode_impl == 'auto'
+                   else args.decode_impl)
+    log_dir = args.event_log or tempfile.mkdtemp(
+        prefix='ddp_serve_topo_')
+    os.makedirs(log_dir, exist_ok=True)
+    member_names = (['router']
+                    + (['prefill'] if prefill_pools else [])
+                    + [f'r{i}' for i in range(decode_replicas)])
+    for name in member_names + ['twin']:
+        # Fresh logs per run: EventLog APPENDS (resuming seq), and a
+        # stale previous run would double every merged timeline.
+        obs.remove_log(os.path.join(log_dir, f'{name}.jsonl'))
+    cfg = LoadGenConfig(
+        seed=args.load_seed, rate=args.load_rate,
+        requests=args.load_requests, arrival=args.arrival,
+        tenants=default_tenants(args.load_tenants), vocab=64,
+        tick_seconds=args.load_tick)
+    trace_path = os.path.join(log_dir, 'trace.json')
+    save_trace(trace_path, generate_trace(cfg))
+    serve_cfg = ServeConfig(
+        queue_limit=args.queue_limit,
+        max_new_tokens=max(t.new_hi for t in cfg.tenants),
+        watchdog=False, spec=args.spec, spec_k=args.spec_k)
+    topo = TopologyConfig(
+        prefill_pools=prefill_pools, decode_replicas=decode_replicas,
+        slots=slots, t_max=t_max, page_size=args.page_size, vocab=64,
+        heads=args.heads, head_dim=args.head_dim, seed=0,
+        decode_impl=decode_impl)
+    clock = VirtualClock()
+    router = build_serving(
+        topo, serve_config=serve_cfg,
+        router_config=RouterConfig(
+            prefill_threshold=args.prefill_threshold),
+        clock=clock, log_dir=log_dir)
+    try:
+        with span('benchmark.serve_load_topology', seed=args.load_seed,
+                  topology=args.topology):
+            res = run_trace(router, load_trace(trace_path), clock,
+                            tick_seconds=cfg.tick_seconds)
+    finally:
+        # Member logs must close (flushing their tails) even when the
+        # run under them crashes — those logs ARE the debugging record.
+        router.close()
+    sources = router.pool.logs()
+    spec = obs_slo.SloSpec(ttft=args.slo_ttft,
+                           per_token=args.slo_token)
+    report = obs_slo.goodput(sources, spec)
+    if not res.accounted:
+        raise SystemExit('serve-load: a submitted request has no '
+                         'terminal record across the topology — '
+                         'router accounting bug, not a measurable row')
+    if report.requests != len(res.submitted):
+        raise SystemExit(
+            f'serve-load: {report.requests} requests classified from '
+            f'the merged logs vs {len(res.submitted)} submitted — a '
+            f'request reconstructed zero or several times')
+    bad = [rid for rid, tl in obs.reconstruct(sources).items()
+           if not tl.complete]
+    if bad:
+        raise SystemExit(
+            f'serve-load: {len(bad)} request lifecycle(s) do not '
+            f'reconstruct across the merged replica logs: {bad[:5]}')
+
+    # The single-process twin on the identical serialized trace: ONE
+    # replica's engine behind one scheduler, its own virtual clock.
+    clock_twin = VirtualClock()
+    twin_path = os.path.join(log_dir, 'twin.jsonl')
+    twin_log = obs.EventLog(twin_path, clock=clock_twin)
+    twin_engine = KernelEngine(
+        slots=slots, t_max=t_max, vocab=64, heads=args.heads,
+        head_dim=args.head_dim, prefill_chunk=8, seed=0,
+        decode_impl=decode_impl, cache_mode='paged',
+        page_size=args.page_size)
+    twin = Scheduler(twin_engine, serve_cfg, clock=clock_twin,
+                     event_log=twin_log, fault_injector=False,
+                     registry=MetricsRegistry())
+    try:
+        res_twin = run_trace(twin, load_trace(trace_path), clock_twin,
+                             tick_seconds=cfg.tick_seconds)
+    finally:
+        twin.close()
+        twin_log.close()
+    report_twin = obs_slo.goodput(twin_path, spec)
+
+    counters = router.registry.snapshot()['counters']
+    routed = {}
+    for key, n in counters.items():
+        # Per-(replica, tenant) labeled series sum to per-replica
+        # placement counts: 'router.routed{replica=r0,tenant=t1}'.
+        if key.startswith('router.routed{'):
+            name = key.split('replica=', 1)[1].split(',')[0].rstrip('}')
+            routed[name] = routed.get(name, 0) + n
+    record = {
+        'mode': 'serve-load', 'topology': args.topology,
+        'seed': args.load_seed, 'arrival': cfg.arrival,
+        'rate_requested': cfg.rate, 'rate_offered': res.offered_rate,
+        'requests': report.requests, 'slots': slots, 't_max': t_max,
+        'page_size': args.page_size, 'spec': args.spec,
+        'decode_impl': args.decode_impl,
+        'queue_limit': serve_cfg.queue_limit,
+        'tick_seconds': cfg.tick_seconds,
+        'prefill_threshold': args.prefill_threshold,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+        'slo': spec.to_dict(),
+        'goodput_pct': report.goodput_pct,
+        'counts': report.counts,
+        'per_tenant': {t: tb['goodput_pct']
+                       for t, tb in sorted(report.per_tenant.items())},
+        'twin_goodput_pct': report_twin.goodput_pct,
+        'twin_counts': report_twin.counts,
+        'twin_ticks': res_twin.ticks,
+        'routed': routed,
+        'prefix_hits': counters.get('router.prefix_hits', 0),
+        'prefix_misses': counters.get('router.prefix_misses', 0),
+        'handoffs': counters.get('router.handoffs', 0),
+        'handoff_pages': counters.get('router.handoff_pages', 0),
+        'virtual_seconds': res.virtual_seconds,
+        'wall_seconds': res.wall_seconds,
+        'ticks': res.ticks,
+        'trace': trace_path,
+        'event_logs': dict(sources),
+    }
+    print(f"serve-load[topology {args.topology}] seed={args.load_seed} "
+          f"{cfg.arrival}@{cfg.rate:.0f}/s x{report.requests}: "
+          f"goodput {report.goodput_pct:.1f}% vs single-process twin "
+          f"{report_twin.goodput_pct:.1f}% "
+          f"(routed {routed}, {record['handoffs']} handoffs, "
+          f"{record['prefix_hits']} prefix hits)")
+    print(obs_slo.render_report(report))
+    print(f'event logs: {log_dir}')
+    _append_record(args.file, record)
+    return record
+
+
 def run_serve_load(args):
     """``--mode serve-load``: goodput under SLO for a seeded open-loop
     trace (ROADMAP item 5's measurement half). The loadgen drives the
@@ -1143,7 +1325,9 @@ def run_serve_load(args):
     the goodput report is computed FROM THE LOG ALONE (obs/slo.py) —
     the row a scheduling-policy change will be graded on, per tenant.
     The flag defaults are the CI smoke config: scripts/ci.sh runs this
-    bare and gates the log against SLO_BASELINE.json."""
+    bare and gates the log against SLO_BASELINE.json. With
+    ``--topology PxD`` the run goes through the disaggregated router
+    instead (:func:`run_serve_load_topology`)."""
     import tempfile
 
     from distributed_dot_product_tpu import obs
@@ -1153,6 +1337,9 @@ def run_serve_load(args):
         default_tenants, run_load,
     )
     from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+    if args.topology:
+        return run_serve_load_topology(args)
 
     slots = args.batch if args.batch > 1 else 4
     t_max = args.seq_len or 96

@@ -62,7 +62,8 @@ __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'PagedDecodeCache', 'PagePool',
            'init_paged_cache', 'paged_gather', 'paged_append_kv_slots',
            'paged_append_rows', 'paged_reset_slot',
-           'paged_rollback_slots', 'paged_copy_attach']
+           'paged_rollback_slots', 'paged_copy_attach',
+           'paged_transfer_pages']
 
 
 class DecodeCache(NamedTuple):
@@ -729,6 +730,35 @@ def paged_copy_attach(cache: PagedDecodeCache, src_page, dst_page, slot,
         k_pool=copy(cache.k_pool), v_pool=copy(cache.v_pool),
         length=jnp.where(sel, jnp.asarray(length_val, jnp.int32),
                          cache.length))
+
+
+def paged_transfer_pages(cache: PagedDecodeCache, src_k_pool, src_v_pool,
+                         src_pages, dst_pages):
+    """Cross-CACHE page transfer — the prefill→decode KV handoff unit
+    of disaggregated serving (serve/replica.py): copy the pool pages
+    named by ``src_pages`` out of ANOTHER paged cache's
+    ``src_k_pool``/``src_v_pool`` into THIS cache's ``dst_pages``.
+    Both vectors are ``−1``-padded to a fixed width (one compiled
+    program per pool-shape pair, not per prefix length); a padded
+    entry copies nothing — the write drops past the sink row like
+    every other masked paged write. The page geometry (page size, KV
+    heads, head dim) must match; the page COUNT of the two pools may
+    differ (a prefill pool is sized for one prompt in flight, a decode
+    pool for its whole batch). Page tables and host refcounts are
+    untouched: the caller (``KernelEngine.adopt_prefix``) owns the
+    allocator bookkeeping on both sides."""
+    src = jnp.asarray(src_pages, jnp.int32)
+    dst = jnp.asarray(dst_pages, jnp.int32)
+    ok = jnp.logical_and(src >= 0, dst >= 0)
+    dsti = jnp.where(ok, dst, cache.pages + 1)   # pads: dropped
+    srci = jnp.maximum(src, 0)
+
+    def put(pool, src_pool):
+        rows = jnp.take(src_pool, srci, axis=0).astype(pool.dtype)
+        return pool.at[dsti].set(rows, mode='drop')
+
+    return cache._replace(k_pool=put(cache.k_pool, src_k_pool),
+                          v_pool=put(cache.v_pool, src_v_pool))
 
 
 class PagePool:

@@ -13,7 +13,8 @@ Offline event-log tooling::
         [--baseline-out SLO_BASELINE.json] [--json]
     python -m distributed_dot_product_tpu.obs slo check LOG [LOG...]
         --against SLO_BASELINE.json [--json]
-    python -m distributed_dot_product_tpu.obs doctor BUNDLE [--json]
+    python -m distributed_dot_product_tpu.obs doctor BUNDLE
+        [BUNDLE...] [--json]
 
 ``validate`` schema-checks every record of each log's rotated set
 against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
@@ -43,13 +44,16 @@ pass several paths, optionally labeled ``replica=path``.
 switches to compact machine-readable output with the FULL event
 records (the default renders ``(seq, event)`` pairs for humans).
 
-``doctor`` diagnoses a flight-recorder post-mortem bundle
-(obs/flight.py) FROM THE BUNDLE ALONE: classifies the incident
+``doctor`` diagnoses flight-recorder post-mortem bundle(s)
+(obs/flight.py) FROM THE BUNDLES ALONE: classifies the incident
 (stuck_step / nan_storm / cache_exhaustion / deadline_storm /
 overload) from the ring's events, the metric samples and the thread
 stacks, and names the affected tenants and request ids — exit 1 only
 on an unreadable/invalid bundle (scripts/smoke_serve.sh greps its
-classification against the injected fault cocktail).
+classification against the injected fault cocktail). Several bundles
+(one per serving replica, optionally labeled ``replica=path``) merge
+into one diagnosis whose verdict names the replica the incident
+happened on and prefixes affected request ids with their replica.
 
 Runs on plain files — no devices touched, safe in any CI stage.
 """
@@ -67,19 +71,27 @@ from distributed_dot_product_tpu.obs.events import (
 from distributed_dot_product_tpu.obs.timeline import reconstruct, timeline
 
 
-def _parse_log_args(logs):
-    """CLI log args → a reconstruct() source: one bare path stays a
-    path; several (or any ``replica=path`` labeled one) become a
-    multi-source list with per-replica labels."""
+def _parse_labeled(items):
+    """``replica=path`` CLI args → ``([(label, path), ...], labeled)``
+    — bare paths get positional ``r0, r1, ...`` labels. The ONE place
+    the label grammar lives (log sets and bundle sets share it)."""
     parsed = []
     labeled = False
-    for i, arg in enumerate(logs):
+    for i, arg in enumerate(items):
         if '=' in arg and not os.path.exists(arg):
             label, path = arg.split('=', 1)
             labeled = True
         else:
             label, path = f'r{i}', arg
         parsed.append((label, path))
+    return parsed, labeled
+
+
+def _parse_log_args(logs):
+    """CLI log args → a reconstruct() source: one bare path stays a
+    path; several (or any ``replica=path`` labeled one) become a
+    multi-source list with per-replica labels."""
+    parsed, labeled = _parse_labeled(logs)
     if len(parsed) == 1 and not labeled:
         return parsed[0][1]
     return parsed
@@ -246,12 +258,14 @@ def _cmd_slo_check(args):
 def _cmd_doctor(args):
     from distributed_dot_product_tpu.obs import doctor as obs_doctor
     from distributed_dot_product_tpu.obs import flight as obs_flight
-    try:
-        bundle = obs_flight.load_bundle(args.bundle)
-    except (OSError, ValueError, json.JSONDecodeError) as e:
-        print(f'{args.bundle}: unreadable bundle: {e}', file=sys.stderr)
-        return 1
-    incident = obs_doctor.diagnose(bundle)
+    labeled = []
+    for label, path in _parse_labeled(args.bundle)[0]:
+        try:
+            labeled.append((label, obs_flight.load_bundle(path)))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f'{path}: unreadable bundle: {e}', file=sys.stderr)
+            return 1
+    incident = obs_doctor.diagnose_bundles(labeled)
     if args.json:
         print(json.dumps(incident.to_dict(), indent=2, default=str))
     else:
@@ -339,11 +353,16 @@ def main(argv=None):
     c.set_defaults(fn=_cmd_slo_check)
 
     d = sub.add_parser(
-        'doctor', help='diagnose a flight-recorder post-mortem bundle '
-                       '(classify the incident, name affected '
-                       'tenants/requests) from the bundle alone')
-    d.add_argument('bundle', help='bundle directory (MANIFEST.json + '
-                                  'ring JSONL + snapshots)')
+        'doctor', help='diagnose flight-recorder post-mortem bundle(s) '
+                       '(classify the incident, name the replica and '
+                       'affected tenants/requests) from the bundles '
+                       'alone')
+    d.add_argument('bundle', nargs='+',
+                   help='bundle director(ies) (MANIFEST.json + ring '
+                        'JSONL + snapshots); several merge as '
+                        'per-replica bundles, optionally labeled '
+                        'replica=path — the verdict then names the '
+                        'replica')
     d.add_argument('--json', action='store_true',
                    help='machine-readable incident object')
     d.set_defaults(fn=_cmd_doctor)

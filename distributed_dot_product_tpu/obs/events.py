@@ -77,6 +77,21 @@ EVENT_SCHEMA = {
     # Paged pool ran dry under this slot mid-stream: slot freed, request
     # requeued (True) or terminally evicted CACHE_EXHAUSTED (False).
     'serve.preempt': ('request_id', 'slot', 'requeued'),
+    # -- disaggregated serving (serve/router.py, serve/replica.py) -----
+    # The router placed a request on a decode replica: `target` names
+    # it, `policy` how it was chosen (prefix / session / load). Lives
+    # in the ROUTER's log; the request's admit→retire lifecycle lives
+    # in the named replica's — reconstruct over the merged labeled set
+    # follows the request across both. (`target`, not `replica`: the
+    # multi-log merge annotates every record with its SOURCE under
+    # `replica`.) A router shed (every replica queue full) is a
+    # `serve.reject` with reason `no_replica`.
+    'router.route': ('request_id', 'target'),
+    # The prefill pool computed a prompt's KV sequence-sharded and
+    # handed it to `target` as whole pool pages
+    # (KernelEngine.adopt_prefix): `pages` moved, `rows` of KV they
+    # cover. Lives in the PREFILL pool's log.
+    'prefill.handoff': ('request_id', 'target', 'pages'),
     # -- speculative decoding (serve/scheduler.py spec ticks) ----------
     # A proposer guessed `proposed` continuation tokens for the slot
     # this tick (`proposer` names which: ngram/draft/custom).
@@ -444,11 +459,22 @@ def merge_events(sources):
     index)`` — a stable k-way merge, so equal timestamps resolve in
     source order and the merge is deterministic."""
     streams = []
+    seen_labels = set()
     for i, src in enumerate(sources):
         if isinstance(src, (tuple, list)) and len(src) == 2:
             label, path = src
         else:
             label, path = f'r{i}', src
+        if str(label) in seen_labels:
+            # Two sources under one label would collapse into one
+            # indistinguishable replica (and silently interleave their
+            # seq series) — a mislabeled merge is a typed error, not a
+            # corrupted timeline.
+            raise ValueError(
+                f'duplicate replica label {str(label)!r} in '
+                f'merge_events sources — label each source uniquely '
+                f'(replica=path)')
+        seen_labels.add(str(label))
         recs = read_events(path)
         for rec in recs:
             rec.setdefault('replica', str(label))

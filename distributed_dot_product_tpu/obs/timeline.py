@@ -72,6 +72,12 @@ class Timeline:
     spec_steps: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # Disaggregated-serving arcs: router placements (router.route, in
+    # the router's log) and prefill→decode KV handoffs
+    # (prefill.handoff, in the prefill pool's) — a routed request's
+    # lifecycle legitimately spans up to three logs.
+    routes: int = 0
+    handoffs: int = 0
 
     def phases(self):
         """Compact ``{phase: seconds}`` view for printing."""
@@ -110,6 +116,17 @@ def _validate(tl: Timeline):
         replica = rec.get('replica')
         if replica is not None and replica not in tl.replicas:
             tl.replicas.append(replica)
+        if ev == 'router.route':
+            # Placement rides its OWN log: at equal timestamps the
+            # merge may order it before or after the replica-side
+            # lifecycle (a one-tick request can even retire at the
+            # route's ts), so it is state-exempt — counted, never a
+            # transition and never an after-terminal violation.
+            tl.routes += 1
+            continue
+        if ev == 'prefill.handoff':
+            tl.handoffs += 1
+            continue
         if state == 'done':
             tl.errors.append(f'event {ev} after terminal state')
             continue
@@ -202,8 +219,8 @@ def reconstruct(source) -> Dict[str, Timeline]:
     for rec in records:
         rid = rec.get('request_id')
         ev = rec.get('event', '')
-        if rid is not None and (ev.startswith('serve.')
-                                or ev.startswith('spec.')):
+        if rid is not None and ev.startswith(('serve.', 'spec.',
+                                              'router.', 'prefill.')):
             per_request.setdefault(rid, []).append(rec)
     return {rid: _validate(Timeline(request_id=rid, events=evs))
             for rid, evs in per_request.items()}

@@ -20,6 +20,13 @@ Composition (each piece standalone-testable):
   traffic generator (Poisson/bursty arrivals, heavy-tailed length
   mixes, tenant shares) driving the scheduler on a virtual clock; the
   measurement substrate for SLO/goodput accounting (obs/slo.py).
+- :mod:`~distributed_dot_product_tpu.serve.replica` — disaggregated
+  substrate: the sequence-sharded prefill pool (KV computed across the
+  mesh, handed off as pool pages) and the data-parallel decode replica
+  pool, each replica a Scheduler+KernelEngine with its own log/metrics.
+- :mod:`~distributed_dot_product_tpu.serve.router` — the front end:
+  admission (typed NO_REPLICA), prefix-cache-aware and session-affine
+  placement, prefill→decode handoff orchestration.
 """
 
 from distributed_dot_product_tpu.serve.admission import (  # noqa: F401
@@ -34,7 +41,15 @@ from distributed_dot_product_tpu.serve.health import (  # noqa: F401
 )
 from distributed_dot_product_tpu.serve.loadgen import (  # noqa: F401
     Arrival, LoadGenConfig, LoadResult, TenantSpec, VirtualClock,
-    default_tenants, generate_trace, run_load, run_trace,
+    default_tenants, generate_trace, load_trace, run_load, run_trace,
+    save_trace,
+)
+from distributed_dot_product_tpu.serve.replica import (  # noqa: F401
+    DecodeReplica, PrefillPool, ReplicaPool, TopologyConfig,
+    maybe_init_distributed, parse_topology,
+)
+from distributed_dot_product_tpu.serve.router import (  # noqa: F401
+    Router, RouterConfig, build_serving,
 )
 from distributed_dot_product_tpu.serve.scheduler import (  # noqa: F401
     Scheduler, ServeConfig,
@@ -45,4 +60,8 @@ __all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
            'Liveness', 'Readiness', 'Scheduler', 'ServeConfig',
            'Arrival', 'LoadGenConfig', 'LoadResult', 'TenantSpec',
            'VirtualClock', 'default_tenants', 'generate_trace',
-           'run_load', 'run_trace']
+           'run_load', 'run_trace', 'save_trace', 'load_trace',
+           'DecodeReplica', 'PrefillPool', 'ReplicaPool',
+           'TopologyConfig', 'maybe_init_distributed',
+           'parse_topology', 'Router', 'RouterConfig',
+           'build_serving']

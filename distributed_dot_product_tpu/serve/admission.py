@@ -53,6 +53,11 @@ class RejectReason(enum.Enum):
     # The request names a shared prefix that is not (or no longer)
     # registered — at submit, or unregistered while it sat queued.
     PREFIX_UNREGISTERED = 'prefix_unregistered'
+    # Disaggregated serving (serve/router.py): no decode replica in the
+    # pool can accept the request — every replica's admission queue is
+    # at its bound (or the pool is empty). The router-level analog of
+    # QUEUE_FULL, shed BEFORE any replica's ladder runs.
+    NO_REPLICA = 'no_replica'
 
 
 class RejectedError(Exception):

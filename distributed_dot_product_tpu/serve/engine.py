@@ -51,8 +51,8 @@ import jax.numpy as jnp
 from distributed_dot_product_tpu.models.decode import (
     PagePool, append_kv_slots, decode_step, init_paged_cache,
     init_slot_cache, paged_append_rows, paged_copy_attach,
-    paged_reset_slot, paged_rollback_slots, reset_slot, rollback_slots,
-    slots_all_finite,
+    paged_reset_slot, paged_rollback_slots, paged_transfer_pages,
+    reset_slot, rollback_slots, slots_all_finite,
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
@@ -209,6 +209,10 @@ class KernelEngine:
         # fixed compiled shape under its own retrace budget.
         self._verifies = {}
         self._rollbacks = {}
+        # Cross-cache KV handoff programs (disaggregated serving):
+        # one per SOURCE pool shape — a topology has exactly one
+        # prefill pool shape, so one program for the engine's life.
+        self._transfers = {}
 
     # -- compiled bodies ------------------------------------------------
     def _project(self, tokens):
@@ -620,9 +624,79 @@ class KernelEngine:
             self.cache = self._prefix_fill(
                 self.cache, jnp.asarray(buf), jnp.int32(len(chunk)),
                 row_j, jnp.int32(start))
+        return self._register_pages(pages, n)
+
+    def _register_pages(self, pages, n):
+        """Enter ``pages`` (already allocated and filled, covering
+        ``n`` rows) into the prefix registry — the one place prefix
+        ids are minted, shared by :meth:`register_prefix` (local
+        prefill) and :meth:`adopt_prefix` (cross-cache handoff)."""
         pid = next(self._prefix_counter)
         self._prefix_registry[pid] = (pages, n)
         return pid
+
+    def _transfer_program(self, src_shape):
+        prog = self._transfers.get(src_shape)
+        if prog is None:
+            from distributed_dot_product_tpu.analysis.retrace import (
+                watch_traces,
+            )
+            prog = self._transfers[src_shape] = jax.jit(
+                watch_traces(paged_transfer_pages, 'engine.adopt',
+                             budget=2),
+                donate_argnums=(0,))
+        return prog
+
+    def adopt_prefix(self, src_cache, src_pages, length):
+        """The prefill→decode KV handoff (disaggregated serving): copy
+        ``length`` rows living in ``src_pages`` of ANOTHER paged cache
+        (a prefill pool's — same page size and head geometry, its own
+        pool size) into freshly allocated pages of THIS engine's pool
+        and register them as a shared prefix. One compiled program
+        moves whole pages — the transfer unit is the page, exactly as
+        :meth:`register_prefix`'s product is, so sequences started
+        with :meth:`start_with_prefix` cannot tell a handed-off prefix
+        from a locally prefilled one. Raises on pool exhaustion (the
+        router checks headroom first) and on geometry mismatch."""
+        if self.cache_mode != 'paged':
+            raise ValueError("prefix adoption needs cache_mode='paged'")
+        if src_cache.page_size != self.page_size:
+            raise ValueError(
+                f'page-size mismatch: source {src_cache.page_size} vs '
+                f'{self.page_size} — the page is the transfer unit, '
+                f'both pools must agree')
+        if src_cache.k_pool.shape[1:] != self.cache.k_pool.shape[1:] \
+                or src_cache.v_pool.shape[1:] != self.cache.v_pool.shape[1:]:
+            raise ValueError(
+                f'KV geometry mismatch: source pages '
+                f'{src_cache.k_pool.shape[1:]} vs '
+                f'{self.cache.k_pool.shape[1:]}')
+        if length < 1 or length + 1 > self.t_max:
+            raise ValueError(f'prefix of {length} rows leaves no room '
+                             f'to generate in a t_max={self.t_max} '
+                             f'cache')
+        src_pages = [int(p) for p in src_pages]
+        needed = self.pool.pages_for_rows(length)
+        if len(src_pages) != needed:
+            raise ValueError(f'{len(src_pages)} source pages for '
+                             f'{length} rows (need {needed})')
+        pages = self.pool.alloc_block(needed)
+        if pages is None:
+            raise RuntimeError(
+                f'page pool exhausted adopting a {length}-row prefix '
+                f'({needed} pages needed, {self.pool.free_pages} free)')
+        # Fixed-width −1-padded vectors: one compiled transfer program
+        # per source pool shape, whatever the prefix length.
+        width = max(self.pool.pages_per_slot, needed)
+        vec_src = np.full(width, -1, np.int32)
+        vec_dst = np.full(width, -1, np.int32)
+        vec_src[:needed] = src_pages
+        vec_dst[:needed] = pages
+        key = (src_cache.k_pool.shape, src_cache.v_pool.shape, width)
+        self.cache = self._transfer_program(key)(
+            self.cache, src_cache.k_pool, src_cache.v_pool,
+            jnp.asarray(vec_src), jnp.asarray(vec_dst))
+        return self._register_pages(pages, length)
 
     def prefix_length(self, prefix_id):
         return self._prefix_registry[prefix_id][1]

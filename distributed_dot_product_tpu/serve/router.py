@@ -1,0 +1,329 @@
+# -*- coding: utf-8 -*-
+"""
+The serving front end of the disaggregated topology: admission, replica
+placement, prefill→decode KV handoff, session affinity and
+prefix-cache-aware routing over a
+:class:`~distributed_dot_product_tpu.serve.replica.ReplicaPool`.
+
+Placement ladder, per request (first hit wins):
+
+1. **Prefix affinity** — the prompt continues a prefix some replica
+   already holds registered pages for: route THERE and ride the pages
+   (``submit(prefix_id=...)`` → refcounted sharing, ``shared_pages >
+   0`` on exactly that replica). PR 7's refcounted prefix sharing
+   becomes a cluster-level cache: the router's prefix map is the
+   cluster index, the replicas' registries the storage.
+2. **Session affinity** — ``submit(session=...)`` sticks a session to
+   the replica that served it last (its KV/prefix locality is there).
+3. **Least loaded** — fewest in-flight requests (queued + busy slots)
+   among replicas whose admission queue has room.
+
+A fresh long prompt (``prefix rows >= prefill_threshold``) is built by
+the sequence-sharded prefill pool and handed to the chosen replica as
+whole pages (``KernelEngine.adopt_prefix``), registered, and entered
+into the prefix map — the NEXT identical prompt takes ladder rung 1.
+Short prompts route directly; the replica's own chunked prefill serves
+them (the handoff's page granularity would cost more than it saves).
+
+Every routed request leaves exactly ONE lifecycle in exactly ONE
+replica's event log plus a ``router.route`` record in the router's own
+log (and a ``prefill.handoff`` in the prefill pool's when pages moved)
+— ``obs.reconstruct`` over the merged labeled set follows the request
+across the logs. When NO replica can accept, the router sheds with the
+typed ``NO_REPLICA`` reason BEFORE any replica's ladder runs: capacity
+probing (``Scheduler.load()``), never a reject in one log and an admit
+in another.
+"""
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.serve.admission import (
+    RejectedError, RejectReason,
+)
+from distributed_dot_product_tpu.serve.replica import (
+    ReplicaPool, TopologyConfig,
+)
+from distributed_dot_product_tpu.utils import tracing
+
+__all__ = ['RouterConfig', 'Router', 'build_serving']
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Routing policy knobs. ``prefill_threshold``: prefix rows
+    (``len(prompt) - 1``) at or above which a fresh prompt offloads to
+    the prefill pool; below it the replica prefills locally.
+    ``prefix_cache_cap``: registered prefixes kept per replica — past
+    it the replica's least-recently-hit prefix is unregistered (its
+    pages free once the last rider retires)."""
+    prefill_threshold: int = 8
+    prefix_cache: bool = True
+    prefix_cache_cap: int = 32
+    # Most of a replica's pool its registered prefixes may PIN
+    # (registry references never free while registered): past it the
+    # replica's least-recently-hit prefixes unregister even under the
+    # entry cap — decode slots must keep the rest of the pool.
+    prefix_pin_fraction: float = 0.5
+    session_affinity: bool = True
+
+
+class Router:
+    """Front-end router over ``pool`` (see module docstring). Exposes
+    the :class:`~distributed_dot_product_tpu.serve.scheduler.Scheduler`
+    driving surface — ``submit`` / ``step`` / ``results`` /
+    ``run_until_idle`` — so the loadgen's ``run_trace`` drives a whole
+    topology exactly as it drives one scheduler (the single-process
+    twin comparison is the same trace through both)."""
+
+    def __init__(self, pool: ReplicaPool,
+                 config: Optional[RouterConfig] = None, *,
+                 clock=time.monotonic, event_log=None, registry=None):
+        self.pool = pool
+        self.cfg = config or RouterConfig()
+        self.clock = clock
+        self.event_log = event_log
+        self.registry = registry or tracing.MetricsRegistry()
+        self._by_name = {r.name: r for r in pool.replicas}
+        self._sessions = {}
+        # prefix key (tuple of prefix tokens) -> (replica, pid, rows);
+        # ordered by last hit for the per-replica LRU cap.
+        self._prefix_map = collections.OrderedDict()
+        self._rids = itertools.count()
+        reg = self.registry
+        self._c_hits = reg.counter('router.prefix_hits')
+        self._c_miss = reg.counter('router.prefix_misses')
+        self._c_handoffs = reg.counter('router.handoffs')
+        self._c_handoff_pages = reg.counter('router.handoff_pages')
+        self._c_unregistered = reg.counter('router.prefix_unregistered')
+        reg.gauge('router.replicas').set(len(pool.replicas))
+        self._routed_series = {}
+        self._noreplica_series = {}
+
+    # -- observability ---------------------------------------------------
+    def _emit(self, event, _log=None, **fields):
+        """Into ``_log`` when given (the prefill pool's), else the
+        router's own, else the process-active one, else nowhere."""
+        log = _log if _log is not None else (
+            self.event_log if self.event_log is not None
+            else obs_events.get_active())
+        if log is not None:
+            log.emit(event, **fields)
+
+    def _count_routed(self, replica, tenant):
+        key = (replica, tenant)
+        c = self._routed_series.get(key)
+        if c is None:
+            c = self._routed_series[key] = self.registry.counter(
+                'router.routed',
+                labels={'replica': replica, 'tenant': tenant})
+        c.inc()
+
+    # -- the cluster prefix cache ---------------------------------------
+    def _cache_prefix(self, key, replica, pid, rows):
+        self._prefix_map[key] = (replica.name, pid, rows)
+        self._prefix_map.move_to_end(key)
+        held = [k for k, (name, _, _) in self._prefix_map.items()
+                if name == replica.name]
+        # Evict the replica's least-recently-HIT prefixes (OrderedDict
+        # order = hit recency) past EITHER bound: the entry cap, or the
+        # page-pin budget — registry references never free while
+        # registered, so without the page bound a varied long-prompt
+        # stream would pin the whole pool and starve decode slots
+        # (every fresh request then preempts CACHE_EXHAUSTED while the
+        # twin serves the same trace fine). Unregistering only drops
+        # the registry's references: pages still shared by live riders
+        # survive until those retire, and a request queued against an
+        # evicted pid resolves as the typed PREFIX_UNREGISTERED
+        # terminal, never a crash. The just-added entry (last in hit
+        # order) is never the victim.
+        pin_budget = max(1, int(replica.engine.pool.pages
+                                * self.cfg.prefix_pin_fraction))
+        while held[:-1] and (len(held) > self.cfg.prefix_cache_cap
+                             or replica.engine.pinned_pages
+                             > pin_budget):
+            victim = held.pop(0)
+            _, old_pid, _ = self._prefix_map.pop(victim)
+            replica.engine.unregister_prefix(old_pid)
+            self._c_unregistered.inc()
+
+    def _prefix_hit(self, key, loads):
+        """The replica already holding ``key``'s pages, if it can
+        accept — consumes a ladder-rung-1 placement."""
+        if not self.cfg.prefix_cache or key is None:
+            return None
+        hit = self._prefix_map.get(key)
+        if hit is None:
+            return None
+        name, pid, rows = hit
+        if not loads[name]['accepting']:
+            return None
+        self._prefix_map.move_to_end(key)
+        return self._by_name[name], pid, rows
+
+    def _handoff(self, rid, replica, key, tenant):
+        """Build ``key``'s KV in the prefill pool and adopt its pages
+        into ``replica``'s — returns the registered prefix id, or None
+        when the handoff cannot happen (no headroom on either side:
+        the prompt then serves the plain way, correctness never
+        depends on the offload)."""
+        prefill = self.pool.prefill
+        rows = len(key)
+        needed = replica.engine.pool.pages_for_rows(rows)
+        free = replica.engine.free_pages
+        if free is not None and free < needed:
+            return None
+        try:
+            # ValueError covers data-dependent impossibility (a prompt
+            # too long for t_max): falling through hands the FLAT
+            # prompt to the replica, whose admission produces the same
+            # typed PROMPT_TOO_LONG reject the non-routed path records
+            # — the offload must never turn a shed into a crash.
+            handle = prefill.build(np.asarray(key, np.int32))
+        except (RuntimeError, ValueError):
+            return None
+        try:
+            pid = replica.engine.adopt_prefix(
+                prefill.engine.cache, handle.pages, handle.length)
+        finally:
+            prefill.release(handle)
+        self._cache_prefix(key, replica, pid, rows)
+        self._c_handoffs.inc()
+        self._c_handoff_pages.inc(needed)
+        self._emit('prefill.handoff', _log=prefill.event_log,
+                   request_id=rid, target=replica.name, pages=needed,
+                   rows=rows, tenant=tenant)
+        return pid
+
+    # -- submission surface ----------------------------------------------
+    def submit(self, prompt, *, max_new_tokens=None, deadline=None,
+               request_id=None, tenant=None, session=None):
+        """Place one request on a decode replica (see the module
+        docstring's ladder) and submit it there. Raises the replica's
+        own typed :class:`RejectedError` for per-request validation
+        sheds, or a router-level NO_REPLICA when every replica's queue
+        is at its bound."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tenant = str(tenant or 'default')
+        rid = request_id or f'rt-{next(self._rids)}'
+        # One load() scan per replica per submit: the snapshot feeds
+        # the accepting filter, the affinity probes AND the
+        # least-loaded key below (this is the per-request hot path).
+        loads = {r.name: r.load() for r in self.pool.replicas}
+        accepting = [r for r in self.pool.replicas
+                     if loads[r.name]['accepting']]
+        if not accepting:
+            key = (tenant,)
+            c = self._noreplica_series.get(key)
+            if c is None:
+                c = self._noreplica_series[key] = self.registry.counter(
+                    'router.rejected.no_replica',
+                    labels={'tenant': tenant})
+            c.inc()
+            self._emit('serve.reject', request_id=rid,
+                       reason=RejectReason.NO_REPLICA.value,
+                       queued=False, tenant=tenant)
+            raise RejectedError(
+                RejectReason.NO_REPLICA,
+                f'request {rid}: no decode replica accepting '
+                f'({len(self.pool.replicas)} replicas, every queue at '
+                f'its bound)')
+        key = (tuple(int(t) for t in prompt[:-1])
+               if len(prompt) > 1 else None)
+        replica = prefix_id = None
+        sub_prompt = prompt
+        policy = 'load'
+        hit = self._prefix_hit(key, loads)
+        if hit is not None:
+            replica, prefix_id, rows = hit
+            sub_prompt = prompt[rows:]
+            policy = 'prefix'
+            self._c_hits.inc()
+        else:
+            if key is not None and self.cfg.prefix_cache:
+                self._c_miss.inc()
+            if session is not None and self.cfg.session_affinity:
+                name = self._sessions.get(session)
+                if name is not None and loads[name]['accepting']:
+                    replica, policy = self._by_name[name], 'session'
+            if replica is None:
+                replica = min(accepting,
+                              key=lambda r: (loads[r.name]['queued']
+                                             + loads[r.name]['busy'],
+                                             r.name))
+            if self.pool.prefill is not None and key is not None \
+                    and len(key) >= self.cfg.prefill_threshold:
+                pid = self._handoff(rid, replica, key, tenant)
+                if pid is not None:
+                    prefix_id, sub_prompt = pid, prompt[-1:]
+        req = replica.scheduler.submit(
+            sub_prompt, max_new_tokens=max_new_tokens,
+            deadline=deadline, request_id=rid, prefix_id=prefix_id,
+            tenant=tenant)
+        if session is not None:
+            self._sessions[session] = replica.name
+        self._count_routed(replica.name, tenant)
+        self._emit('router.route', request_id=req.id,
+                   target=replica.name, policy=policy, tenant=tenant)
+        return req
+
+    # -- driving surface -------------------------------------------------
+    def step(self) -> bool:
+        return self.pool.step_all()
+
+    @property
+    def results(self):
+        out = {}
+        for r in self.pool.replicas:
+            out.update(r.results)
+        return out
+
+    def run_until_idle(self, max_ticks=100_000):
+        ticks = 0
+        while self.step():
+            ticks += 1
+            if ticks >= max_ticks:
+                raise RuntimeError(
+                    f'topology still busy after {max_ticks} ticks: '
+                    + ' '.join(f'{r.name}={r.load()}'
+                               for r in self.pool.replicas))
+        return self.results
+
+    def loads(self):
+        """Per-replica placement signals, by name — the router's own
+        introspection surface (and the test hook)."""
+        return {r.name: r.load() for r in self.pool.replicas}
+
+    def close(self):
+        self.pool.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def build_serving(topology: Optional[TopologyConfig] = None, *,
+                  serve_config=None, router_config=None,
+                  clock=time.monotonic, log_dir=None, mesh=None,
+                  fault_injector=False, registry=None) -> Router:
+    """Wire a whole single-process topology: the
+    :class:`~distributed_dot_product_tpu.serve.replica.ReplicaPool`
+    (one paged engine + scheduler + event log per decode replica, plus
+    the sequence-sharded prefill pool), a router event log under
+    ``log_dir``, and the :class:`Router` over it. The returned
+    router's ``pool.logs()`` is the labeled multi-source set the obs
+    layer merges."""
+    pool = ReplicaPool(topology, serve_config=serve_config,
+                       clock=clock, log_dir=log_dir, mesh=mesh,
+                       fault_injector=fault_injector)
+    return Router(pool, router_config, clock=clock,
+                  event_log=pool.open_log('router'), registry=registry)

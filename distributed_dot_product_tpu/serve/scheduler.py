@@ -1012,6 +1012,23 @@ class Scheduler:
         eng.rollback(targets)
         self._proposer.end_step()
 
+    def load(self):
+        """Placement signal for a router (serve/router.py): in-flight
+        work and headroom, read without device sync. ``accepting`` is
+        the router's admission probe — a False here means a submit
+        would shed QUEUE_FULL, so the router tries another replica (or
+        sheds typed NO_REPLICA) INSTEAD of letting this scheduler
+        reject: a routed request must leave exactly one lifecycle in
+        exactly one replica's log, never a reject in one and an admit
+        in another."""
+        busy = sum(s.state is not _SlotState.FREE for s in self._slots)
+        out = {'queued': self.admission.depth, 'busy': busy,
+               'free_slots': self.engine.slots - busy,
+               'accepting': not self.admission.full and not self._closed}
+        if self._paged:
+            out['free_pages'] = self.engine.free_pages
+        return out
+
     # -- the loop -------------------------------------------------------
     def step(self) -> bool:
         """One scheduler tick (admit → prefill chunk → decode step →

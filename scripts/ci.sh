@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/7] ruff (generic hygiene) ==='
+echo '=== [1/8] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,10 +27,10 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/7] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/8] graphlint (jaxpr/domain contracts) ==='
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/7] tier-1 tests ==='
+echo '=== [3/8] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -38,7 +38,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/7] smoke serve + event-log schema validation ==='
+echo '=== [4/8] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -48,7 +48,7 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/7] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+echo '=== [5/8] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
 # Speculative decoding's exactness guarantee, proven on a real burst
 # through the ENV knob a deployment would flip: the same traffic served
 # with the n-gram proposer (verify-k steps) and without (plain n=1
@@ -106,7 +106,7 @@ print(f'spec smoke OK: {len(base)} streams bit-identical, '
 PY
 fi
 
-echo '=== [6/7] serve-load smoke + SLO goodput gate ==='
+echo '=== [6/8] serve-load smoke + SLO goodput gate ==='
 # A seeded open-loop trace (virtual clock — minutes of simulated
 # traffic in seconds of wall time, CPU-deterministic) drives the
 # scheduler, then the goodput report computed FROM THE EVENT LOG ALONE
@@ -131,7 +131,19 @@ else
     rm -f "$slo_log" "$slo_row"
 fi
 
-echo '=== [7/7] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [7/8] disaggregated-serving smoke (router + 2 decode pools) ==='
+# The 1-router/2-pool cocktail on the CPU mesh: the seeded trace through
+# the disaggregated topology AND its single-process twin, member logs
+# schema-validated (--require router.route / prefill.handoff), goodput
+# over the MERGED replica logs gated against SLO_BASELINE.json, and the
+# exactly-once / topology-beats-twin invariants asserted from the row.
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping router-smoke stage'
+else
+    scripts/smoke_router.sh || rc=1
+fi
+
+echo '=== [8/8] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for

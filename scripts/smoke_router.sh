@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Disaggregated-serving smoke: the 1-router / 2-decode-pool cocktail on
+# the CPU mesh, end to end through the real CLIs.
+#
+#   scripts/smoke_router.sh
+#
+# What it proves (exit 0 = all of it):
+#   1. `benchmark.py --mode serve-load --topology 1x2` runs the seeded
+#      CI trace through the router (sequence-sharded prefill pool +
+#      2 paged decode replicas, KV handoff as pool pages) AND through
+#      its single-process twin on the byte-identical serialized trace.
+#   2. The router/prefill logs schema-validate and actually carry the
+#      disaggregation events (router.route placements, prefill.handoff
+#      page transfers).
+#   3. Goodput computed over the MERGED per-member logs passes the
+#      committed SLO_BASELINE.json gate (`obs slo check` with labeled
+#      replica=path sources) — the same gate the single-process smoke
+#      answers to.
+#   4. Every submitted request is accounted exactly once across the
+#      merged logs, and the routed topology's goodput is at least the
+#      twin's on the same trace (2x the capacity never does worse).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+dir="$(mktemp -d /tmp/ddp_router_smoke.XXXXXX)"
+row="$dir/row.json"
+trap 'rm -rf "$dir"' EXIT
+
+echo "== smoke_router: serve-load --topology 1x2 (logs in $dir) =="
+python benchmark.py --mode serve-load --topology 1x2 \
+    --event-log "$dir" --file "$row" || exit 1
+
+echo '== smoke_router: member logs schema-validate + carry the routing events =='
+python -m distributed_dot_product_tpu.obs validate "$dir/router.jsonl" \
+    --require router.route || exit 1
+python -m distributed_dot_product_tpu.obs validate "$dir/prefill.jsonl" \
+    --require prefill.handoff || exit 1
+
+echo '== smoke_router: goodput gate over the MERGED replica logs =='
+python -m distributed_dot_product_tpu.obs slo check \
+    router="$dir/router.jsonl" prefill="$dir/prefill.jsonl" \
+    r0="$dir/r0.jsonl" r1="$dir/r1.jsonl" \
+    --against SLO_BASELINE.json || exit 1
+
+echo '== smoke_router: exactly-once accounting + twin comparison =='
+python - "$row" <<'PY' || exit 1
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))[-1]
+assert rec['topology'] == '1x2', rec
+assert sum(rec['counts'].values()) == rec['requests'], (
+    f"classification classes {rec['counts']} do not partition the "
+    f"{rec['requests']} submitted requests")
+assert rec['goodput_pct'] >= rec['twin_goodput_pct'], (
+    f"routed topology goodput {rec['goodput_pct']:.1f}% fell below its "
+    f"single-process twin's {rec['twin_goodput_pct']:.1f}% on the same "
+    f"trace")
+assert set(rec['routed']) == {'r0', 'r1'}, rec['routed']
+assert rec['handoffs'] >= 1, 'no prefill->decode KV handoff happened'
+print(f"router smoke OK: goodput {rec['goodput_pct']:.1f}% "
+      f"(twin {rec['twin_goodput_pct']:.1f}%), routed {rec['routed']}, "
+      f"{rec['handoffs']} handoffs / {rec['handoff_pages']} pages, "
+      f"{rec['prefix_hits']} prefix hits")
+PY
+
+echo 'smoke_router OK'

@@ -15,11 +15,25 @@ import os
 import subprocess
 import sys
 
+import jax
 import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 pytestmark = pytest.mark.slow
+
+# The attn online/ulysses CLI modes fail on jax 0.4.x: their pjit
+# lowering emits a PartitionId op the CPU SPMD pipeline of that line
+# cannot compile. PINNED to the jax version rather than blanket-xfailed
+# so a jax upgrade AUTO-UN-XFAILS them (condition False → the tests run
+# and must pass) instead of the marker rotting over a fixed bug.
+_JAX_VERSION = tuple(int(p) for p in jax.__version__.split('.')[:3]
+                     if p.isdigit())
+_PARTITION_ID_XFAIL = pytest.mark.xfail(
+    condition=_JAX_VERSION < (0, 5, 0),
+    reason=f'PartitionId SPMD lowering, jax {jax.__version__} '
+           f'(auto-un-xfails at jax >= 0.5)',
+    strict=False)
 
 
 def _run(tmp_path, name, *bench_args):
@@ -73,8 +87,7 @@ def test_offset_none_and_ring(tmp_path):
     assert rec['impl'] == 'ring'
 
 
-@pytest.mark.xfail(
-    reason='PartitionId SPMD lowering, jax 0.4.37', strict=False)
+@_PARTITION_ID_XFAIL
 def test_attn_mode(tmp_path):
     rec = _run(tmp_path, 'attn', '--mode', 'attn', '--attn-impl', 'online',
                '--scale', '2344', '--skip-local')
@@ -83,8 +96,7 @@ def test_attn_mode(tmp_path):
     assert rec['dist_gflops_per_chip'] > 0
 
 
-@pytest.mark.xfail(
-    reason='PartitionId SPMD lowering, jax 0.4.37', strict=False)
+@_PARTITION_ID_XFAIL
 def test_attn_mode_seq_len_override(tmp_path):
     # --seq-len overrides the reference's T = 75000/scale convention
     # (used by the head-dim sweep to pin T exactly).
@@ -221,3 +233,26 @@ def test_metrics_out_snapshot(tmp_path):
     hists = payload['metrics']['histograms']
     assert hists['serve.ttft_seconds']['total_count'] > 0
     assert hists['serve.queue_wait_seconds']['total_count'] > 0
+
+
+def test_serve_load_topology_mode(tmp_path):
+    """--mode serve-load --topology 1x2: the disaggregated row runs the
+    trace through the router AND the single-process twin, merges the
+    per-member logs, and records both goodputs plus the routing
+    telemetry. The per-member JSONL logs must exist and the placements
+    must cover every decode replica."""
+    logs = tmp_path / 'topo'
+    rec = _run(tmp_path, 'topo', '--mode', 'serve-load',
+               '--topology', '1x2', '--load-requests', '24',
+               '--event-log', str(logs))
+    assert rec['topology'] == '1x2'
+    assert rec['requests'] == 24
+    assert set(rec['routed']) == {'r0', 'r1'}
+    assert sum(rec['routed'].values()) + rec['counts']['rejected'] >= 24
+    assert rec['handoffs'] >= 1          # the long-prompt tail offloads
+    # 2x the capacity on the same trace: the topology never does worse.
+    assert rec['goodput_pct'] >= rec['twin_goodput_pct']
+    for name in ('router', 'prefill', 'r0', 'r1'):
+        assert (logs / f'{name}.jsonl').exists(), name
+    assert (logs / 'twin.jsonl').exists()
+    assert (logs / 'trace.json').exists()

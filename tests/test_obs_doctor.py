@@ -142,3 +142,87 @@ def test_anomaly_verdicts_ride_along(tmp_path):
     assert incident.classes['cache_exhaustion']['score'] > 0
     out = obs_doctor.render_incident(incident)
     assert 'anomaly' in out and 'pages_free' in out
+
+
+def test_multi_bundle_diagnosis_names_the_replica(tmp_path):
+    """Per-replica bundles (a disaggregated topology dumps one black
+    box per decode pool) merge into ONE diagnosis: scores sum, the
+    verdict names the replica whose bundle carries the primary
+    evidence, and affected request ids are prefixed with their
+    replica."""
+    def emit_quiet(log):
+        log.emit('serve.admit', request_id='ok-1', slot=0, tenant='t0',
+                 queue_wait=0.0)
+        log.emit('serve.retire', request_id='ok-1', status='completed',
+                 tenant='t0')
+
+    def emit_nan_storm(log):
+        for i in range(3):
+            log.emit('serve.admit', request_id=f'n{i}', slot=i,
+                     tenant='t1', queue_wait=0.0)
+            log.emit('serve.quarantine', request_id=f'n{i}', slot=i,
+                     requeued=False)
+            log.emit('serve.retire', request_id=f'n{i}',
+                     status='failed_nan', tenant='t1')
+
+    quiet = _bundle_from(tmp_path / 'q', emit_quiet)
+    stormy = _bundle_from(tmp_path / 's', emit_nan_storm,
+                          trigger='nan_storm')
+    incident = obs_doctor.diagnose_bundles(
+        [('r0', quiet), ('r1', stormy)])
+    assert incident.primary == 'nan_storm'
+    assert incident.replica == 'r1'
+    # Affected ids say where their lifecycle ran.
+    assert incident.affected['quarantined'] == ['r1:n0', 'r1:n1',
+                                                'r1:n2']
+    # Evidence lines carry the bundle label.
+    assert any(ev.startswith('[r1]') for ev in
+               incident.classes['nan_storm']['evidence'])
+    # Tenants sum across replicas.
+    assert incident.tenants['t0']['requests'] == 1
+    assert incident.tenants['t1']['requests'] == 3
+    out = obs_doctor.render_incident(incident)
+    assert 'replica r1' in out and 'r1:n0' in out
+    # One bundle degenerates to the single-bundle contract (no labels).
+    solo = obs_doctor.diagnose_bundles([('r1', stormy)])
+    assert solo.replica is None
+    assert solo.affected['quarantined'] == ['n0', 'n1', 'n2']
+
+
+def test_multi_bundle_doctor_cli(tmp_path):
+    """`obs doctor r0=B0 r1=B1` merges labeled bundles and prints the
+    replica in the verdict; exit 0."""
+    import json as _json
+    import subprocess
+    import sys
+
+    def emit(log):
+        log.emit('serve.admit', request_id='a', slot=0, tenant='t0',
+                 queue_wait=0.0)
+        log.emit('serve.quarantine', request_id='a', slot=0,
+                 requeued=False)
+        log.emit('serve.retire', request_id='a', status='failed_nan',
+                 tenant='t0')
+
+    reg = MetricsRegistry()
+    with flight.recording(base_dir=tmp_path / 'f0',
+                          registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'e0.jsonl')
+        log.emit('health.liveness', state='alive')
+        log.close()
+        b0 = rec.dump_bundle(trigger='manual')
+    with flight.recording(base_dir=tmp_path / 'f1',
+                          registry=MetricsRegistry()) as rec:
+        log = obs.EventLog(tmp_path / 'e1.jsonl')
+        emit(log)
+        log.close()
+        b1 = rec.dump_bundle(trigger='nan_storm')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'distributed_dot_product_tpu.obs',
+         'doctor', f'r0={b0}', f'r1={b1}', '--json'],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    payload = _json.loads(proc.stdout)
+    assert payload['primary'] == 'nan_storm'
+    assert payload['replica'] == 'r1'
+    assert 'r1:a' in payload['affected']['failed']

@@ -383,3 +383,96 @@ def test_preempt_requeue_spec_completion_arc(tmp_path, devices):
     assert tl.ttft == ttft_decode['ttft']
     assert ttft_decode['ts'] >= preempt_ts
     assert tl.ttft >= ttft_decode['ts'] - first_admit_ts > 0
+
+
+# -- merge_events edge cases (disaggregated log sets) -------------------
+
+def test_merge_events_three_replicas_and_empty_source(tmp_path):
+    """>= 3 sources merge with per-source seq order preserved and
+    every record labeled; a completely EMPTY source log (a replica
+    that saw no traffic) contributes nothing and breaks nothing."""
+    from distributed_dot_product_tpu.obs.events import merge_events
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    logs = []
+    for name in ('router', 'r0', 'r1'):
+        logs.append((name, EventLog(tmp_path / f'{name}.jsonl',
+                                    clock=clock)))
+    by = dict(logs)
+    by['router'].emit('router.route', request_id='x', target='r0')
+    by['r0'].emit('serve.admit', request_id='x', slot=0, tenant='t0',
+                  queue_wait=0.0)
+    by['r1'].emit('serve.admit', request_id='y', slot=0, tenant='t1',
+                  queue_wait=0.0)
+    by['r0'].emit('serve.retire', request_id='x', status='completed',
+                  tenant='t0')
+    by['r1'].emit('serve.retire', request_id='y', status='completed',
+                  tenant='t1')
+    for _, log in logs:
+        log.close()
+    empty = tmp_path / 'r2.jsonl'
+    empty.write_text('')
+    sources = [(n, log.path) for n, log in logs] + [('r2', empty)]
+    recs = merge_events(sources)
+    assert len(recs) == 5
+    assert [r['replica'] for r in recs] == [
+        'router', 'r0', 'r1', 'r0', 'r1']
+    # Per-source seq never reorders.
+    for name in ('router', 'r0', 'r1'):
+        seqs = [r['seq'] for r in recs if r['replica'] == name]
+        assert seqs == sorted(seqs)
+    assert not any(r['replica'] == 'r2' for r in recs)
+    # The merged set reconstructs: the route event rides x's timeline.
+    tls = reconstruct(sources)
+    assert tls['x'].complete and tls['x'].routes == 1
+    assert tls['x'].replicas == ['router', 'r0']
+    assert tls['y'].complete and tls['y'].replicas == ['r1']
+
+
+def test_merge_events_duplicate_labels_typed_error(tmp_path):
+    """Two sources under one replica label would collapse into one
+    indistinguishable stream — a typed ValueError naming the label,
+    never a silently corrupted merge."""
+    from distributed_dot_product_tpu.obs.events import merge_events
+
+    a = EventLog(tmp_path / 'a.jsonl')
+    a.emit('health.liveness', state='alive')
+    a.close()
+    b = EventLog(tmp_path / 'b.jsonl')
+    b.emit('health.liveness', state='alive')
+    b.close()
+    with pytest.raises(ValueError, match="duplicate replica label 'r0'"):
+        merge_events([('r0', a.path), ('r0', b.path)])
+    # Auto-labels are positional and unique — the same pair merges.
+    assert len(merge_events([a.path, b.path])) == 2
+
+
+def test_merge_events_ts_tie_stability_three_sources(tmp_path):
+    """Equal timestamps across THREE sources resolve in source order,
+    deterministically: merging twice yields the identical sequence,
+    and reordering the sources reorders ONLY the tied records."""
+    from distributed_dot_product_tpu.obs.events import merge_events
+
+    frozen = lambda: 7.0  # noqa: E731
+    paths = []
+    for i in range(3):
+        log = EventLog(tmp_path / f's{i}.jsonl', clock=frozen)
+        log.emit('health.liveness', state='alive')
+        log.emit('health.readiness', state='ready')
+        log.close()
+        paths.append((f's{i}', log.path))
+    recs = merge_events(paths)
+    assert [(r['replica'], r['seq']) for r in recs] == [
+        ('s0', 0), ('s0', 1), ('s1', 0), ('s1', 1), ('s2', 0),
+        ('s2', 1)]
+    assert [(r['replica'], r['seq']) for r in merge_events(paths)] \
+        == [(r['replica'], r['seq']) for r in recs]
+    flipped = merge_events(list(reversed(paths)))
+    assert [(r['replica'], r['seq']) for r in flipped] == [
+        ('s2', 0), ('s2', 1), ('s1', 0), ('s1', 1), ('s0', 0),
+        ('s0', 1)]

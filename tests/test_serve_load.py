@@ -252,3 +252,87 @@ def test_virtual_time_latencies_are_exact(tmp_path, devices):
     assert tl.ttft == 0.0
     assert all(g == pytest.approx(0.01) for g in tl.token_gaps)
     assert len(tl.token_gaps) == 3          # 4 tokens, 3 gaps
+
+
+# -- trace serialization (save_trace / load_trace) ----------------------
+
+def test_trace_save_load_round_trip_exact(tmp_path):
+    """A serialized trace reloads to the last bit — every float, id,
+    prompt token and budget — so the identical request stream can
+    drive a router topology and its single-process twin byte for
+    byte."""
+    from distributed_dot_product_tpu.serve import load_trace, save_trace
+
+    cfg = LoadGenConfig(
+        seed=11, rate=700.0, requests=32, arrival='bursty',
+        tenants=[TenantSpec('t0', share=1.0, deadline_s=0.4),
+                 TenantSpec('t1', share=2.0)])
+    trace = generate_trace(cfg)
+    path = tmp_path / 'trace.json'
+    save_trace(path, trace, note='round-trip test')
+    loaded = load_trace(path)
+    assert len(loaded) == len(trace)
+    for a, b in zip(trace, loaded):
+        assert b.at == a.at                      # exact, not approx
+        assert b.request_id == a.request_id
+        assert b.tenant == a.tenant
+        assert b.prompt.dtype == np.int32
+        assert (b.prompt == a.prompt).all()
+        assert b.max_new_tokens == a.max_new_tokens
+        assert b.deadline_s == a.deadline_s
+    # Serialization is deterministic: same trace, same bytes.
+    path2 = tmp_path / 'trace2.json'
+    save_trace(path2, loaded, note='round-trip test')
+    assert path.read_bytes() == path2.read_bytes()
+
+
+def test_trace_load_rejects_bad_schema_and_malformed(tmp_path):
+    import json
+
+    from distributed_dot_product_tpu.serve import load_trace, save_trace
+
+    p = tmp_path / 'bad_schema.json'
+    p.write_text('{"schema": 999, "arrivals": []}')
+    with pytest.raises(ValueError, match='schema'):
+        load_trace(p)
+    trace = generate_trace(LoadGenConfig(seed=1, requests=2))
+    good = tmp_path / 'good.json'
+    save_trace(good, trace)
+    payload = json.loads(good.read_text())
+    del payload['arrivals'][1]['prompt']
+    mangled = tmp_path / 'mangled.json'
+    mangled.write_text(json.dumps(payload))
+    with pytest.raises(ValueError, match='arrival 1'):
+        load_trace(mangled)
+
+
+def test_saved_trace_drives_identical_run(tmp_path, devices):
+    """Generated and reloaded traces produce the SAME results dict
+    through a scheduler — the twin-comparison precondition."""
+    from distributed_dot_product_tpu.serve import (
+        Scheduler, load_trace, run_trace, save_trace,
+    )
+
+    cfg = LoadGenConfig(seed=5, rate=400.0, requests=16,
+                        tick_seconds=0.002)
+    trace = generate_trace(cfg)
+    path = tmp_path / 'trace.json'
+    save_trace(path, trace)
+
+    def run(tr):
+        clock = VirtualClock()
+        sched = Scheduler(
+            KernelEngine(slots=2, t_max=64, decode_impl='xla'),
+            ServeConfig(watchdog=False, queue_limit=8,
+                        max_new_tokens=24),
+            clock=clock, registry=MetricsRegistry(),
+            fault_injector=False)
+        try:
+            res = run_trace(sched, tr, clock,
+                            tick_seconds=cfg.tick_seconds)
+        finally:
+            sched.close()
+        return {rid: (r.status, tuple(r.tokens))
+                for rid, r in res.results.items()}
+
+    assert run(trace) == run(load_trace(path))

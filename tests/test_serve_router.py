@@ -1,0 +1,397 @@
+# -*- coding: utf-8 -*-
+"""
+Disaggregated multi-chip serving (serve/replica.py + serve/router.py):
+the sequence-sharded prefill pool, the prefill→decode KV handoff
+through the page pool, router placement (prefix affinity / session
+affinity / least-loaded / typed NO_REPLICA), and the ISSUE-12
+acceptance — a seeded trace against a 1-router/2-decode-pool topology
+on the CPU mesh where every submitted request reconstructs exactly
+once across the merged replica logs, goodput is at least the
+single-process twin's at 2x offered rate, and a re-submitted
+registered prefix lands on the replica already holding its pages.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import slo as obs_slo
+from distributed_dot_product_tpu.obs.events import EventLog
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, LoadGenConfig, PrefillPool, RejectReason,
+    RejectedError, RouterConfig, Scheduler, ServeConfig,
+    TopologyConfig, VirtualClock, build_serving, default_tenants,
+    generate_trace, load_trace, maybe_init_distributed, parse_topology,
+    run_trace, save_trace,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+
+def _topo(replicas=2, slots=2, t_max=64, page_size=16, vocab=32,
+          **kw):
+    return TopologyConfig(decode_replicas=replicas, slots=slots,
+                          t_max=t_max, page_size=page_size,
+                          vocab=vocab, seed=3, **kw)
+
+
+def _serving(tmp_path, clock, *, replicas=2, threshold=4,
+             queue_limit=4, max_new=8, cap=32, **topo_kw):
+    return build_serving(
+        _topo(replicas=replicas, **topo_kw),
+        serve_config=ServeConfig(watchdog=False, queue_limit=queue_limit,
+                                 max_new_tokens=max_new),
+        router_config=RouterConfig(prefill_threshold=threshold,
+                                   prefix_cache_cap=cap),
+        clock=clock, log_dir=tmp_path / 'logs')
+
+
+# -- topology plumbing --------------------------------------------------
+
+def test_parse_topology():
+    assert parse_topology('1x2') == (1, 2)
+    assert parse_topology('0x1') == (0, 1)
+    with pytest.raises(ValueError, match='look like'):
+        parse_topology('2-3')
+    with pytest.raises(ValueError, match='prefill pools'):
+        parse_topology('2x2')
+    with pytest.raises(ValueError, match='decode replica'):
+        parse_topology('1x0')
+
+
+def test_maybe_init_distributed_is_a_noop_unconfigured():
+    """Without a coordinator the single-process multi-replica mode
+    needs no process group — the call must be a no-op, not a hang."""
+    assert maybe_init_distributed(environ={}) is False
+
+
+# -- prefill pool: sequence-sharded KV, bit-identical to local ----------
+
+def test_prefill_pool_kv_bitwise_matches_local_prefill(devices):
+    """The sharded projection (rows split over the 'seq' mesh axis)
+    writes page contents BITWISE equal to register_prefix's local
+    chunked prefill — the row-parallel matmul preserves each row's
+    accumulation order, so a handed-off prefix is indistinguishable
+    from a locally prefilled one."""
+    tokens = (np.arange(1, 25, dtype=np.int32) * 5) % 32
+    pf = PrefillPool(t_max=64, page_size=16, vocab=32, seed=3)
+    assert pf.n_shards == 8
+    handle = pf.build(tokens)
+    ref = KernelEngine(slots=2, t_max=64, vocab=32, seed=3,
+                       cache_mode='paged', page_size=16,
+                       decode_impl='xla')
+    ref_pages, ref_n = ref._prefix_registry[
+        ref.register_prefix(tokens)]
+    assert handle.length == ref_n == len(tokens)
+    assert len(handle.pages) == len(ref_pages) == 2
+    for sp, rp in zip(handle.pages, ref_pages):
+        for pool_name in ('k_pool', 'v_pool'):
+            a = np.asarray(getattr(pf.engine.cache, pool_name)[sp])
+            b = np.asarray(getattr(ref.cache, pool_name)[rp])
+            assert (a == b).all(), (pool_name, sp, rp)
+    # Release returns the pages; a second build reuses the pool.
+    pf.release(handle)
+    assert pf.engine.pool.free_pages == pf.engine.pool.pages
+    pf.build(tokens)
+
+
+def test_adopt_prefix_stream_identity_and_validation(devices):
+    """A stream started on a handed-off prefix is BIT-IDENTICAL to the
+    same prompt served flat on an identical engine; geometry
+    mismatches are typed errors, never silent corruption."""
+    tokens = np.arange(1, 20, dtype=np.int32) % 32
+    prompt = list(tokens) + [5]
+    pf = PrefillPool(t_max=64, page_size=16, vocab=32, seed=3)
+    handle = pf.build(tokens)
+    dec = KernelEngine(slots=2, t_max=64, vocab=32, seed=3,
+                       cache_mode='paged', page_size=16,
+                       decode_impl='xla')
+    pid = dec.adopt_prefix(pf.engine.cache, handle.pages,
+                           handle.length)
+    pf.release(handle)
+    clock = VirtualClock()
+    s1 = Scheduler(dec, ServeConfig(watchdog=False, max_new_tokens=8),
+                   clock=clock, registry=MetricsRegistry(),
+                   fault_injector=False)
+    r1 = s1.submit([prompt[-1]], prefix_id=pid, max_new_tokens=8)
+    s1.run_until_idle()
+    s1.close()
+    flat = KernelEngine(slots=2, t_max=64, vocab=32, seed=3,
+                        cache_mode='paged', page_size=16,
+                        decode_impl='xla')
+    s2 = Scheduler(flat, ServeConfig(watchdog=False, max_new_tokens=8),
+                   clock=clock, registry=MetricsRegistry(),
+                   fault_injector=False)
+    r2 = s2.submit(prompt, max_new_tokens=8)
+    s2.run_until_idle()
+    s2.close()
+    assert s1.results[r1.id].tokens == s2.results[r2.id].tokens
+    # Page-size mismatch is typed.
+    other = KernelEngine(slots=2, t_max=64, vocab=32, seed=3,
+                         cache_mode='paged', page_size=8,
+                         decode_impl='xla')
+    h2 = pf.build(tokens)
+    with pytest.raises(ValueError, match='page-size mismatch'):
+        other.adopt_prefix(pf.engine.cache, h2.pages, h2.length)
+    with pytest.raises(ValueError, match='source pages'):
+        dec.adopt_prefix(pf.engine.cache, h2.pages[:1], h2.length)
+    pf.release(h2)
+
+
+# -- router placement ---------------------------------------------------
+
+def test_router_spreads_load_and_sticks_sessions(tmp_path, devices):
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, threshold=100, queue_limit=8,
+                      slots=1)
+    try:
+        for i in range(4):
+            router.submit([1 + i, 2, 3], request_id=f'a{i}')
+        loads = router.loads()
+        # Least-loaded placement alternates across the two replicas.
+        assert all(lo['queued'] + lo['busy'] == 2
+                   for lo in loads.values()), loads
+        router.run_until_idle()
+        # Session affinity: every submit under one session lands on
+        # the SAME replica even when the other is emptier.
+        for i in range(3):
+            router.submit([7, 8, 9 + i], request_id=f's{i}',
+                          session='sess-1')
+            router.run_until_idle()
+        tls = reconstruct(router.pool.logs())
+        homes = {tls[f's{i}'].replicas[-1] for i in range(3)}
+        assert len(homes) == 1, homes
+    finally:
+        router.close()
+
+
+def test_router_no_replica_typed_reject(tmp_path, devices):
+    """Every replica queue at its bound => the router sheds with the
+    typed NO_REPLICA reason BEFORE any replica's ladder runs — no
+    replica log carries a reject, the router's own log does."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, queue_limit=1, slots=1,
+                      threshold=100)
+    try:
+        # Fill: queue_limit=1 per replica and no ticks run, so two
+        # submits saturate the topology's admission capacity.
+        for i in range(2):
+            router.submit([1, 2], request_id=f'f{i}',
+                          max_new_tokens=4)
+        with pytest.raises(RejectedError) as exc:
+            router.submit([1, 2], request_id='shed',
+                          max_new_tokens=4)
+        assert exc.value.reason is RejectReason.NO_REPLICA
+        counters = router.registry.snapshot()['counters']
+        assert counters[
+            'router.rejected.no_replica{tenant=default}'] == 1
+        router.run_until_idle()
+    finally:
+        router.close()
+    tls = reconstruct(router.pool.logs())
+    shed = tls['shed']
+    assert shed.status == 'rejected'
+    assert shed.reason == 'no_replica'
+    assert shed.complete, shed.errors
+    assert shed.replicas == ['router']   # only the router's log saw it
+    # No lifecycle leaked into any replica log.
+    for name, path in router.pool.logs():
+        if name not in ('router', 'prefill'):
+            assert not any(r.get('request_id') == 'shed'
+                           for r in obs.read_events(path))
+
+
+def test_prefix_affinity_routes_to_the_page_holder(tmp_path, devices):
+    """ISSUE-12 acceptance (prefix affinity): a re-submitted
+    registered prefix lands on the replica already holding its pages
+    — shared_pages > 0 there while it decodes, 0 on every other
+    replica — and the stream equals the first run's."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, threshold=4, max_new=6)
+    prompt = list((np.arange(18) * 3 + 1) % 32) + [9]
+    try:
+        router.submit(prompt, request_id='first')
+        router.run_until_idle()
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.handoffs'] == 1
+        tls = reconstruct(router.pool.logs())
+        home = tls['first'].replicas[-1]
+        assert tls['first'].handoffs == 1
+
+        router.submit(prompt, request_id='again')
+        router.step()          # admission attaches the shared prefix
+        stats = {r.name: r.engine.cache_stats()
+                 for r in router.pool.replicas}
+        assert stats[home]['shared_pages'] > 0, stats
+        for name, st in stats.items():
+            if name != home:
+                assert st['shared_pages'] == 0, stats
+        router.run_until_idle()
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.prefix_hits'] == 1
+        assert counters['router.handoffs'] == 1   # no second transfer
+        tls = reconstruct(router.pool.logs())
+        assert tls['again'].replicas[-1] == home
+        assert tls['again'].handoffs == 0
+        results = router.results
+        assert results['again'].tokens == results['first'].tokens
+    finally:
+        router.close()
+
+
+def test_prefix_cache_lru_cap_unregisters(tmp_path, devices):
+    """Past prefix_cache_cap per replica the least-recently-hit prefix
+    is unregistered: its pages free (no rider left) and a later
+    identical prompt misses the cluster cache."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, replicas=1, threshold=4,
+                      cap=2, max_new=4, t_max=96, slots=2)
+    try:
+        prompts = [list((np.arange(8) + 7 * j) % 32) + [j + 1]
+                   for j in range(3)]
+        for j, p in enumerate(prompts):
+            router.submit(p, request_id=f'p{j}')
+            router.run_until_idle()
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.handoffs'] == 3
+        assert counters['router.prefix_unregistered'] == 1
+        assert len(router._prefix_map) == 2
+        # The evicted (oldest) prefix misses; a cached one hits.
+        router.submit(prompts[0], request_id='again0')
+        router.run_until_idle()
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.handoffs'] == 4      # re-built
+        router.submit(prompts[2], request_id='again2')
+        router.run_until_idle()
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.prefix_hits'] == 1
+        assert counters['router.handoffs'] == 4      # served by pages
+    finally:
+        router.close()
+
+
+def test_router_too_long_prompt_sheds_typed_not_crash(tmp_path,
+                                                      devices):
+    """A prompt past t_max that also crosses the prefill threshold
+    must come out as the replica's typed PROMPT_TOO_LONG reject — the
+    prefill pool's own impossibility (ValueError in build) falls
+    through to the flat submit path, exactly what the non-routed
+    scheduler records for the same prompt."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, threshold=4)
+    prompt = [(i % 31) + 1 for i in range(70)]     # 70 > t_max=64
+    try:
+        with pytest.raises(RejectedError) as exc:
+            router.submit(prompt, request_id='long')
+        assert exc.value.reason is RejectReason.PROMPT_TOO_LONG
+        router.run_until_idle()
+    finally:
+        router.close()
+    tl = reconstruct(router.pool.logs())['long']
+    assert tl.complete, tl.errors
+    assert tl.status == 'rejected' and tl.reason == 'prompt_too_long'
+
+
+def test_prefix_pin_budget_bounds_the_registry(tmp_path, devices):
+    """Distinct long prompts must never pin a replica's whole pool:
+    past prefix_pin_fraction of the pages the LRU prefixes unregister
+    even under the entry cap, leaving decode headroom."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, replicas=1, threshold=4,
+                      cap=100, max_new=4, slots=2)
+    try:
+        for j in range(4):      # 2 pinned pages per distinct prefix
+            p = [int(t) for t in (np.arange(18) + 11 * j) % 32] \
+                + [j + 1]
+            router.submit(p, request_id=f'p{j}')
+            router.run_until_idle()
+        eng = router.pool.replicas[0].engine
+        budget = eng.pool.pages // 2          # default fraction 0.5
+        assert eng.pinned_pages <= budget, (
+            f'{eng.pinned_pages} pinned of {eng.pool.pages} pages')
+        counters = router.registry.snapshot()['counters']
+        assert counters['router.prefix_unregistered'] >= 1
+        # Idle, so everything not pinned is free again.
+        assert eng.free_pages >= eng.pool.pages - budget
+    finally:
+        router.close()
+
+
+# -- ISSUE-12 acceptance: trace through the topology vs the twin --------
+
+def test_trace_topology_acceptance(tmp_path, devices):
+    """Tier-1 acceptance: a seeded serve-load trace at 2x the CI
+    offered rate through a 1-router/2-decode-pool topology on the CPU
+    mesh. Every submitted request reconstructs EXACTLY ONCE across the
+    merged replica logs (complete lifecycle or typed reject), routed
+    goodput >= the single-process twin's on the byte-identical
+    serialized trace, and offloaded requests' timelines span the
+    prefill and decode logs."""
+    cfg = LoadGenConfig(seed=7, rate=1200.0, requests=48,
+                        tenants=default_tenants(2), vocab=64,
+                        tick_seconds=0.002)
+    trace_path = tmp_path / 'trace.json'
+    save_trace(trace_path, generate_trace(cfg))
+    serve_cfg = ServeConfig(watchdog=False, queue_limit=12,
+                            max_new_tokens=24)
+
+    clock = VirtualClock()
+    router = build_serving(
+        TopologyConfig(decode_replicas=2, slots=4, t_max=96,
+                       page_size=16, vocab=64, seed=0),
+        serve_config=serve_cfg,
+        router_config=RouterConfig(prefill_threshold=8),
+        clock=clock, log_dir=tmp_path / 'topo')
+    try:
+        res = run_trace(router, load_trace(trace_path), clock,
+                        tick_seconds=cfg.tick_seconds)
+    finally:
+        router.close()
+    assert res.accounted
+    sources = router.pool.logs()
+    assert [n for n, _ in sources][:2] == ['router', 'prefill']
+
+    # Exactly once across the merged logs: one complete timeline per
+    # submitted request, classes partition the set.
+    tls = reconstruct(sources)
+    assert len(tls) == len(res.submitted) == 48
+    for rid, tl in tls.items():
+        assert tl.complete, (rid, tl.errors)
+        assert tl.routes <= 1
+    spec = obs_slo.SloSpec(ttft=0.25, per_token=0.05)
+    report = obs_slo.goodput(sources, spec)
+    assert report.requests == 48
+    assert sum(report.counts.values()) == 48
+
+    # A handed-off request's lifecycle spans router + prefill + its
+    # decode replica's logs.
+    offloaded = [tl for tl in tls.values() if tl.handoffs]
+    assert offloaded, 'no prompt crossed the prefill threshold'
+    for tl in offloaded:
+        assert 'prefill' in tl.replicas and 'router' in tl.replicas
+        assert any(r.startswith('r') and r not in ('router',)
+                   for r in tl.replicas), tl.replicas
+
+    # The single-process twin (ONE replica's engine) on the identical
+    # serialized trace, at the same 2x offered rate.
+    clock2 = VirtualClock()
+    twin_log = EventLog(tmp_path / 'twin.jsonl', clock=clock2)
+    twin = Scheduler(
+        KernelEngine(slots=4, t_max=96, vocab=64, seed=0,
+                     cache_mode='paged', page_size=16,
+                     decode_impl='xla'),
+        serve_cfg, clock=clock2, event_log=twin_log,
+        registry=MetricsRegistry(), fault_injector=False)
+    try:
+        res_twin = run_trace(twin, load_trace(trace_path), clock2,
+                             tick_seconds=cfg.tick_seconds)
+    finally:
+        twin.close()
+        twin_log.close()
+    assert res_twin.accounted
+    twin_report = obs_slo.goodput(twin_log.path, spec)
+    assert report.goodput_pct >= twin_report.goodput_pct, (
+        f'routed {report.goodput_pct:.1f}% < twin '
+        f'{twin_report.goodput_pct:.1f}% at 2x offered rate')
+    # And the replication actually helps under this overload.
+    assert report.counts['met'] > twin_report.counts['met']
