@@ -3,7 +3,7 @@
 ``graphlint`` — static analysis that mechanically enforces the repo's
 performance and correctness contracts.
 
-Three engines (see ISSUE/README "Static analysis"):
+Four engines (see README "Static analysis"):
 
 - **Jaxpr linter** (:mod:`.jaxpr_rules` over :mod:`.registry`): traces
   every registered entrypoint at example abstract shapes and walks the
@@ -15,6 +15,13 @@ Three engines (see ISSUE/README "Static analysis"):
 - **AST ruleset** (:mod:`.astlint`): pure-``ast`` hazard patterns —
   host pulls of traced values and traced-bool branching in hot paths,
   clock reads inside jit, silent broad excepts.
+- **servelint** (:mod:`.protolint` / :mod:`.conclint` /
+  :mod:`.determlint`): the serving/obs layer's contracts — emit call
+  sites vs the closed EVENT_SCHEMA vocabulary and the RejectReason
+  taxonomy, ``# guarded-by:`` lock discipline plus daemon/named thread
+  discipline, and real-time/random/environ reads inside declared
+  virtual-clock tick paths (``GRAPHLINT_TICK_ROOTS`` closures, with the
+  intentional real-time modules in determlint's REAL_TIME_CONTRACT).
 
 CLI: ``python -m distributed_dot_product_tpu.analysis`` (exit 0 = no
 violations). The tier-1 gate test (tests/test_graphlint.py) asserts a
@@ -26,13 +33,14 @@ imports every layer) along with it would be an import cycle.
 """
 
 from distributed_dot_product_tpu.analysis.base import (     # noqa: F401
-    RULES, Violation, format_violations,
+    RULES, Violation, active_violations, format_violations,
 )
 from distributed_dot_product_tpu.analysis.retrace import (  # noqa: F401
     RetraceBudgetExceeded, watch_traces,
 )
 
-__all__ = ['RULES', 'Violation', 'format_violations', 'watch_traces',
+__all__ = ['RULES', 'Violation', 'active_violations',
+           'format_violations', 'watch_traces',
            'RetraceBudgetExceeded', 'run_analysis']
 
 
@@ -50,7 +58,9 @@ def run_analysis(paths=None, rules=None, repo_root=None,
     import os
     violations = []
     if ast_rules:
-        from distributed_dot_product_tpu.analysis import astlint
+        from distributed_dot_product_tpu.analysis import (
+            astlint, conclint, determlint, protolint,
+        )
         if paths is None:
             pkg = os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))
@@ -69,6 +79,15 @@ def run_analysis(paths=None, rules=None, repo_root=None,
         if ast_rule_set is None or ast_rule_set:
             violations.extend(astlint.lint_paths(
                 paths, repo_root=repo_root, rules=ast_rule_set))
+        # servelint families ride the same AST pass and path set.
+        for mod, fam in ((protolint, protolint.PROTO_RULES),
+                         (conclint, conclint.CONC_RULES),
+                         (determlint, determlint.DETERM_RULES)):
+            fam_rules = None if rules is None else \
+                [r for r in rules if r in fam]
+            if fam_rules is None or fam_rules:
+                violations.extend(mod.lint_paths(
+                    paths, repo_root=repo_root, rules=fam_rules))
     if jaxpr:
         from distributed_dot_product_tpu.analysis import jaxpr_rules
         jaxpr_rule_set = None if rules is None else \

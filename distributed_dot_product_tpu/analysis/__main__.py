@@ -2,8 +2,11 @@
 """
 ``python -m distributed_dot_product_tpu.analysis`` — the graphlint CLI.
 
-Exit status: 0 when clean, 1 when any violation (each rendered as
-``file:line: rule [entrypoint]: message``), 2 on usage errors.
+Exit status: 0 when clean, 1 when any ACTIVE violation (each rendered
+as ``file:line: rule [entrypoint]: message``), 2 on usage errors.
+Registration-waived records (``TraceSpec.allow`` — the flax Dense
+bf16-accum debt) render with an ``(allowed)`` mark and never fail the
+run; ``--format json`` carries them with ``"allowed": true``.
 
 The jaxpr pass traces on a forced 8-virtual-device CPU platform
 (tracing needs devices for meshes but never executes), so the CLI is
@@ -26,6 +29,16 @@ def main(argv=None):
     parser.add_argument('paths', nargs='*',
                         help='files/dirs for the AST pass (default: '
                              'the package + scripts/ + tests/)')
+    parser.add_argument('--changed-only', nargs='?', const='HEAD',
+                        metavar='REF', default=None,
+                        help='lint only .py files changed vs the git '
+                             'ref (default HEAD) plus untracked ones — '
+                             'the fast pre-commit mode. The jaxpr/'
+                             'registry pass still runs when a changed '
+                             'file can affect a registered entrypoint '
+                             '(ops/, models/, parallel/, obs/, '
+                             'serve/engine.py, train.py, analysis/), '
+                             'else it is skipped')
     parser.add_argument('--rule', action='append', dest='rules',
                         metavar='ID', choices=sorted(RULES),
                         help='run only this rule (repeatable)')
@@ -51,10 +64,21 @@ def main(argv=None):
 
     if args.rules:
         from distributed_dot_product_tpu.analysis.astlint import AST_RULES
+        from distributed_dot_product_tpu.analysis.conclint import (
+            CONC_RULES,
+        )
+        from distributed_dot_product_tpu.analysis.determlint import (
+            DETERM_RULES,
+        )
         from distributed_dot_product_tpu.analysis.jaxpr_rules import (
             JAXPR_RULES,
         )
-        static = set(AST_RULES) | set(JAXPR_RULES) | {'parse-error'}
+        from distributed_dot_product_tpu.analysis.protolint import (
+            PROTO_RULES,
+        )
+        static = (set(AST_RULES) | set(JAXPR_RULES) | set(PROTO_RULES)
+                  | set(CONC_RULES) | set(DETERM_RULES)
+                  | {'parse-error'})
         runtime_only = [r for r in args.rules if r not in static]
         if runtime_only:
             parser.error(
@@ -62,6 +86,31 @@ def main(argv=None):
                 f'retrace sentinel (analysis/retrace.py; on under '
                 f'pytest), not statically — there is nothing for this '
                 f'command to check')
+
+    if args.changed_only is not None:
+        if args.paths:
+            parser.error('--changed-only computes its own file set — '
+                         'drop the explicit paths')
+        try:
+            changed = changed_files(args.changed_only)
+        except RuntimeError as e:
+            parser.error(str(e))
+        if not changed:
+            # Notices go to stderr: --format json owns stdout.
+            print(f'graphlint: no .py files changed vs '
+                  f'{args.changed_only} — nothing to lint',
+                  file=sys.stderr)
+            if args.format == 'json':
+                print('[]')
+            return 0
+        args.paths = changed
+        if not args.no_jaxpr and not any(
+                _affects_registry(p) for p in changed):
+            print(f'graphlint: changed files cannot affect registered '
+                  f'entrypoints — skipping the jaxpr pass '
+                  f'({len(changed)} files, AST rules only)',
+                  file=sys.stderr)
+            args.no_jaxpr = True
 
     if not args.no_jaxpr:
         # Force the hermetic 8-device CPU platform BEFORE jax commits
@@ -82,13 +131,70 @@ def main(argv=None):
         except ValueError as e:
             parser.error(str(e))
 
-    from distributed_dot_product_tpu.analysis import run_analysis
+    from distributed_dot_product_tpu.analysis import (
+        active_violations, run_analysis,
+    )
     violations = run_analysis(
         paths=args.paths or None, rules=args.rules,
+        # Explicit (absolute) changed-file paths still render
+        # repo-relative in violations.
+        repo_root=_repo_root() if args.changed_only is not None
+        else None,
         jaxpr=not args.no_jaxpr, ast_rules=not args.no_ast,
         entrypoints=entrypoints)
     print(format_violations(violations, fmt=args.format))
-    return 1 if violations else 0
+    # `allowed` records (registration-level debt, e.g. the flax Dense
+    # bf16-accum entries) are rendered but never fail the run.
+    return 1 if active_violations(violations) else 0
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _affects_registry(path):
+    """Can a change to ``path`` alter a registered entrypoint's jaxpr?
+    Conservative path heuristic over the LAYER_HOOKS modules plus the
+    analysis subsystem itself."""
+    norm = os.path.abspath(path).replace(os.sep, '/')
+    return any(frag in norm for frag in (
+        '/ops/', '/models/', '/parallel/', '/analysis/',
+        '/serve/engine.py', '/train.py', '/obs/'))
+
+
+def changed_files(ref='HEAD'):
+    """The .py files changed vs ``ref`` (tracked diff + untracked),
+    as absolute paths of files that still exist. RuntimeError when git
+    cannot resolve the ref — the CLI maps it to a usage error."""
+    import subprocess
+    root = _repo_root()
+
+    def _git(*argv):
+        res = subprocess.run(['git', *argv], cwd=root,
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f'--changed-only: git {" ".join(argv)} failed: '
+                f'{res.stderr.strip() or res.stdout.strip()}')
+        return res.stdout.splitlines()
+
+    names = _git('diff', '--name-only', '--diff-filter=d', ref,
+                 '--', '*.py')
+    names += _git('ls-files', '--others', '--exclude-standard',
+                  '--', '*.py')
+    out = []
+    for name in dict.fromkeys(n.strip() for n in names if n.strip()):
+        # The deliberate-violation fixture tree is excluded from the
+        # full walk (iter_python_files); explicitly-named files bypass
+        # that exclusion, so a changed-files sweep must apply it here
+        # or any PR touching a fixture fails its own pre-commit lint.
+        if 'graphlint_fixtures' in name or '__pycache__' in name:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(path):
+            out.append(path)
+    return out
 
 
 if __name__ == '__main__':

@@ -9,8 +9,8 @@ generic style linter (ruff owns hygiene — see pyproject.toml); every
 rule here encodes a contract this repo has already been burned by or
 explicitly designed around. Scope is per rule: the traced-value rules
 (``host-pull``, ``traced-bool-branch``) only police the jit hot paths
-(``ops/``, ``models/``); ``clock-in-jit`` and ``silent-except`` apply
-package-wide plus ``scripts/``.
+(``ops/``, ``models/``, ``serve/``, ``obs/``); ``clock-in-jit`` and
+``silent-except`` apply package-wide plus ``scripts/``.
 
 "Traced value" is approximated statically and conservatively: a local
 name is *jax-derived* when it was assigned from a ``jnp.* / jax.* /
@@ -38,9 +38,14 @@ AST_RULES = ('host-pull', 'traced-bool-branch', 'clock-in-jit',
              'silent-except')
 
 # Rules whose scope is the jit hot paths only (path fragments matched
-# against the repo-relative file path).
+# against the repo-relative file path). serve/ and obs/ joined the
+# sweep in PR 13: the serving tick and the obs sampling paths dispatch
+# compiled programs per token, so a host pull of a jnp-derived value
+# there stalls the same hot loop the kernel rules protect.
 _HOT_PATH_FRAGMENTS = (os.sep + 'ops' + os.sep,
-                       os.sep + 'models' + os.sep)
+                       os.sep + 'models' + os.sep,
+                       os.sep + 'serve' + os.sep,
+                       os.sep + 'obs' + os.sep)
 
 _JAX_ROOTS = {'jnp', 'jax', 'lax'}
 _PREDICATE_FNS = {'any', 'all', 'isfinite', 'isnan', 'allclose',

@@ -20,7 +20,8 @@ import dataclasses
 import re
 from typing import Optional
 
-__all__ = ['Violation', 'RULES', 'allowed_by_pragma', 'format_violations']
+__all__ = ['Violation', 'RULES', 'allowed_by_pragma',
+           'active_violations', 'format_violations']
 
 # Rule catalog. Jaxpr rules (J*) trace registered entrypoints and walk
 # the ClosedJaxpr; AST rules (A*) parse source; R* is enforced at
@@ -85,6 +86,39 @@ RULES = {
         'entrypoint may not trace more often than its declared budget '
         '— automates the round-5 decode_seq_parallel retrace-storm '
         'finding (ADVICE.md)'),
+    # -- servelint: protocol / concurrency / determinism (PR 13) --------
+    'event-vocab': (
+        'protolint (analysis/protolint.py): a literal event kind at an '
+        'emit() call site must exist in the closed obs/events.py '
+        'EVENT_SCHEMA vocabulary — an unknown kind raises mid-incident '
+        'at runtime; here it fails at PR time'),
+    'event-fields': (
+        'protolint: a literal emit() payload must carry every field '
+        'EVENT_SCHEMA requires for its kind (calls forwarding **kwargs '
+        'are skipped — only statically-complete payloads are judged)'),
+    'reject-reason': (
+        'protolint: a serve.reject `reason` must be a RejectReason '
+        'member — a literal string must be one of the enum values, and '
+        'a RejectReason attribute must name a member and emit its '
+        '.value (the enum object would serialize as its repr)'),
+    'guarded-by': (
+        'conclint (analysis/conclint.py): a field annotated '
+        '`# guarded-by: self._lock` may only be read or written inside '
+        'a `with self._lock:` block (exempt: __init__, methods named '
+        '*_locked — the caller holds the lock by convention)'),
+    'thread-discipline': (
+        'conclint: every threading.Thread(...) must be daemon=True and '
+        'carry a name= — a non-daemon thread blocks interpreter '
+        'shutdown on a wedged step, and an unnamed one is anonymous in '
+        'the flight recorder\'s stack dumps'),
+    'tick-determinism': (
+        'determlint (analysis/determlint.py): no real-time reads '
+        '(time.time/monotonic/sleep/perf_counter), `random` module '
+        'calls, np.random, or os.environ reads inside a declared '
+        'virtual-clock tick path (GRAPHLINT_TICK_ROOTS and their '
+        'intra-module call closure) — the seeded bit-reproducible '
+        'replay contract; intentional real-time sites live in '
+        'determlint\'s REAL_TIME_CONTRACT table'),
 }
 
 _PRAGMA = re.compile(r'#\s*graphlint:\s*allow\[([a-z0-9_,\s-]+)\]')
@@ -97,11 +131,18 @@ class Violation:
     file: Optional[str] = None      # repo-relative where possible
     line: Optional[int] = None
     entrypoint: Optional[str] = None  # registry name (jaxpr rules)
+    # Waived-but-visible: a registration-level allowance (TraceSpec
+    # .allow — the flax Dense bf16-accum debt) keeps the record in
+    # `--format json` output without failing the CLI or the gate, so
+    # known debt stays enumerable instead of disappearing into a
+    # pragma.
+    allowed: bool = False
 
     def render(self):
         where = f'{self.file}:{self.line}' if self.file else '<registry>'
         entry = f' [{self.entrypoint}]' if self.entrypoint else ''
-        return f'{where}: {self.rule}{entry}: {self.message}'
+        mark = ' (allowed)' if self.allowed else ''
+        return f'{where}: {self.rule}{entry}{mark}: {self.message}'
 
 
 def allowed_by_pragma(source_lines, lineno, rule):
@@ -115,16 +156,29 @@ def allowed_by_pragma(source_lines, lineno, rule):
     return False
 
 
+def active_violations(violations):
+    """The violations that FAIL a run (``allowed=False``) — the CLI
+    exit code and the tier-1 gate both judge this subset; allowed
+    records stay visible in the rendered output."""
+    return [v for v in violations if not v.allowed]
+
+
 def format_violations(violations, fmt='text'):
     """Render a violation list for the CLI: ``text`` (one line each) or
-    ``json`` (a list of plain dicts)."""
+    ``json`` (a list of plain dicts, ``allowed`` records included)."""
     if fmt == 'json':
         import json
         return json.dumps([dataclasses.asdict(v) for v in violations],
                           indent=2)
-    if not violations:
-        return 'graphlint: no violations'
+    act = active_violations(violations)
+    n_allowed = len(violations) - len(act)
     lines = [v.render() for v in violations]
-    lines.append(f'graphlint: {len(violations)} violation'
-                 f'{"s" if len(violations) != 1 else ""}')
+    if not act:
+        lines.append('graphlint: no violations'
+                     + (f' ({n_allowed} allowed by registration)'
+                        if n_allowed else ''))
+    else:
+        lines.append(f'graphlint: {len(act)} violation'
+                     f'{"s" if len(act) != 1 else ""}'
+                     + (f' (+{n_allowed} allowed)' if n_allowed else ''))
     return '\n'.join(lines)

@@ -50,6 +50,14 @@ class TraceSpec:
     fn already carries its jit (lower it directly); otherwise the rule
     jits with ``donate_argnums``. ``min_donated``: least number of
     aliased/donor arguments the lowered module must show.
+    ``allow``: rule ids whose violations on THIS entry are known,
+    documented debt — reported with ``allowed=True`` (visible in
+    ``--format json``) but never failing the CLI or the gate. The
+    registration line carries a matching ``# graphlint: allow[...]``
+    comment so the waiver stays greppable; used for the flax
+    ``linen.Dense`` bf16-accumulation debt (ROADMAP item 3a), whose
+    offending dots trace into flax's own source where a line pragma
+    cannot live.
     """
     name: str
     fn: Callable
@@ -62,6 +70,7 @@ class TraceSpec:
     donate_argnums: Tuple[int, ...] = ()
     static_argnums: Tuple[int, ...] = ()
     min_donated: int = 1
+    allow: Tuple[str, ...] = ()
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)

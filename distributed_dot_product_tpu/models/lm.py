@@ -375,13 +375,14 @@ def graphlint_entrypoints():
 
         return TraceSpec(name='lm.head_bf16', fn=fn, args=(params, x))
 
-    def loss_f32():
+    def loss_f32(name='lm.loss_f32', dtype=None, allow=()):
         from distributed_dot_product_tpu.analysis.registry import (
             TraceSpec,
         )
+        kw = {} if dtype is None else {'dtype': dtype}
         model = TransformerLM(
             vocab_size=32, dim=16, num_heads=2, n_layers=1,
-            attn_kwargs={'distributed': False})
+            attn_kwargs={'distributed': False}, **kw)
         tokens = jnp.zeros((1, 16), jnp.int32)
         params = model.init(jax.random.key(0), tokens)
         targets = jax.ShapeDtypeStruct((1, 16), jnp.int32)
@@ -389,8 +390,18 @@ def graphlint_entrypoints():
         def fn(p, tok, tgt):
             return model.apply(p, tok, tgt, chunk=4, method='nll_sum')
 
-        return TraceSpec(name='lm.loss_f32', fn=fn,
+        return TraceSpec(name=name, fn=fn,
                          args=(params, jax.ShapeDtypeStruct(
-                             (1, 16), jnp.int32), targets))
+                             (1, 16), jnp.int32), targets),
+                         allow=tuple(allow))
 
-    return {'lm.head_bf16': head_bf16, 'lm.loss_f32': loss_f32}
+    def loss_bf16():
+        # The full LM loss at SERVING dtype: the chunked-logsumexp f32
+        # math and head contract are enforced on the bf16 program; the
+        # flax Dense projection dots are the known ROADMAP item 3a
+        # bf16-accum debt, waived per-entry and visible in json output.
+        return loss_f32(name='lm.loss_bf16', dtype=jnp.bfloat16,
+                        allow=('f32-accum',))  # graphlint: allow[f32-accum] flax Dense bf16-accum debt
+
+    return {'lm.head_bf16': head_bf16, 'lm.loss_f32': loss_f32,
+            'lm.loss_bf16': loss_bf16}

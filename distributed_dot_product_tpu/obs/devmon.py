@@ -205,15 +205,16 @@ class ProfileCapture:
         # Explicit in-flight flag, flipped under the lock: a freshly
         # CREATED thread is not yet alive, so Thread.is_alive() alone
         # would let two concurrent start() calls both pass the guard.
-        self._in_flight = False
-        self._n = 0
+        self._in_flight = False     # guarded-by: self._lock
+        self._n = 0                 # guarded-by: self._lock
         self._warmed = False
         self._captures = self.registry.counter('profile.captures')
         self._g_busy = self.registry.gauge('profile.capture_in_flight')
 
     @property
     def busy(self) -> bool:
-        return self._in_flight
+        with self._lock:
+            return self._in_flight
 
     @property
     def warmed(self) -> bool:

@@ -218,16 +218,16 @@ class EventLog:
         self.fsync = fsync
         self.clock = clock
         self._lock = threading.Lock()
-        self._rotations = 0
+        self._rotations = 0             # guarded-by: self._lock
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
         # Reopening an existing log continues its seq series: seq is
         # the authoritative order, so a second run appending to the
         # same file must not restart at 0 (read_events sorts by seq —
         # duplicated values would interleave the two runs' records).
-        self._seq = self._resume_seq()
-        self._fh = open(self.path, 'a', encoding='utf-8')
-        self._size = self._fh.tell()
+        self._seq = self._resume_seq()  # guarded-by: self._lock
+        self._fh = open(self.path, 'a', encoding='utf-8')  # guarded-by: self._lock
+        self._size = self._fh.tell()    # guarded-by: self._lock
 
     def _resume_seq(self):
         if not os.path.exists(self.path):
@@ -302,7 +302,8 @@ class EventLog:
 
     @property
     def rotations(self):
-        return self._rotations
+        with self._lock:
+            return self._rotations
 
     def files(self):
         """Existing log files, oldest first (rotated set then the live

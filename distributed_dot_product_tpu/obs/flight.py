@@ -115,13 +115,13 @@ class FlightRecorder:
         self.clock = clock
         self._devices = devices
         self._lock = threading.Lock()
-        self._ring = collections.deque()     # (kind, encoded line)
-        self._bytes = 0
-        self._dropped = 0
-        self._teed = 0
+        self._ring = collections.deque()     # guarded-by: self._lock
+        self._bytes = 0                      # guarded-by: self._lock
+        self._dropped = 0                    # guarded-by: self._lock
+        self._teed = 0                       # guarded-by: self._lock
         self._last_sample = None             # real-time throttle anchor
         self._last_dump: Dict[str, float] = {}
-        self._n_dumps = 0
+        self._n_dumps = 0                    # guarded-by: self._lock
         self.dumps = []                      # [{'path','trigger',...}]
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -131,8 +131,10 @@ class FlightRecorder:
         self._g_bytes = self.registry.gauge('flight.ring_bytes')
 
     # -- the ring -------------------------------------------------------
-    def _add(self, kind, line):
+    def _add(self, kind, line, teed=False):
         with self._lock:
+            if teed:
+                self._teed += 1
             self._ring.append((kind, line))
             self._bytes += len(line)
             while self._ring and (len(self._ring) > self.max_records
@@ -144,9 +146,10 @@ class FlightRecorder:
     def _tee_event(self, rec, line):
         """The events-module hook: every record any EventLog emits
         lands here as its already-encoded line (installed via
-        :func:`install`; one global None-check when not)."""
-        self._teed += 1
-        self._add('event', line)
+        :func:`install`; one global None-check when not). The tee
+        count rides ``_add``'s lock — this runs under the SOURCE
+        log's lock while the sampling thread holds ours."""
+        self._add('event', line, teed=True)
 
     def sample(self, force=False):
         """One metric-registry sample + device-stats poll into the
@@ -175,8 +178,13 @@ class FlightRecorder:
         self._add('devices', json.dumps(
             {'ts': ts, 'devices': devs},
             separators=(',', ':'), default=str))
-        self._g_records.set(len(self._ring))
-        self._g_bytes.set(self._bytes)
+        # Gauge values read under the ring lock: the scheduler tick and
+        # the background sampling thread both land here, and a torn
+        # read would export a records/bytes pair from two moments.
+        with self._lock:
+            records, ring_bytes = len(self._ring), self._bytes
+        self._g_records.set(records)
+        self._g_bytes.set(ring_bytes)
         return True
 
     def stats(self):

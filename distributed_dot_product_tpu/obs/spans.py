@@ -85,7 +85,7 @@ class SpanCollector:
         self.enabled = False
         self.registry = registry
         self._lock = threading.Lock()
-        self._records = collections.deque(maxlen=maxlen)
+        self._records = collections.deque(maxlen=maxlen)  # guarded-by: self._lock
         self._tls = threading.local()
 
     def _stack(self):

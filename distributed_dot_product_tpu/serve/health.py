@@ -87,10 +87,10 @@ class HealthMonitor:
         self.on_stall = on_stall
         self.event_log = event_log
         self._lock = threading.Lock()
-        self._last_beat: Optional[float] = None
-        self._liveness = Liveness.ALIVE
-        self._readiness = Readiness.STARTING
-        self._transitions: List[Tuple[float, str, str, str]] = []
+        self._last_beat: Optional[float] = None  # guarded-by: self._lock
+        self._liveness = Liveness.ALIVE          # guarded-by: self._lock
+        self._readiness = Readiness.STARTING     # guarded-by: self._lock
+        self._transitions: List[Tuple[float, str, str, str]] = []  # guarded-by: self._lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._c_stalls = self.registry.counter('serve.watchdog_stalls')
@@ -166,11 +166,13 @@ class HealthMonitor:
 
     @property
     def liveness(self) -> Liveness:
-        return self._liveness
+        with self._lock:
+            return self._liveness
 
     @property
     def readiness(self) -> Readiness:
-        return self._readiness
+        with self._lock:
+            return self._readiness
 
     @property
     def transitions(self):

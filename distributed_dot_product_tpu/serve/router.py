@@ -54,6 +54,11 @@ from distributed_dot_product_tpu.utils import tracing
 
 __all__ = ['RouterConfig', 'Router', 'build_serving']
 
+# determlint: placement and the topology tick are pure functions of
+# the injected clock, the load snapshot and the request stream — a
+# wall-clock read here would unseed the router-vs-twin comparison.
+GRAPHLINT_TICK_ROOTS = ('Router.step', 'Router.submit')
+
 
 @dataclasses.dataclass
 class RouterConfig:

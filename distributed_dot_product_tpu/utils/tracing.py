@@ -279,7 +279,7 @@ class Counter:
     """Monotonic event counter."""
 
     def __init__(self):
-        self._value = 0
+        self._value = 0         # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def inc(self, n=1):
@@ -288,14 +288,15 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge:
     """Last-value gauge (queue depth, active slots, readiness code)."""
 
     def __init__(self):
-        self._value = 0.0
+        self._value = 0.0       # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def set(self, value):
@@ -304,7 +305,8 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 # Default cumulative-bucket bounds (seconds): spans the sub-ms decode
@@ -330,12 +332,13 @@ class Histogram:
     reservoir quantiles are not)."""
 
     def __init__(self, maxlen=4096, buckets=DEFAULT_BUCKETS):
-        self._values = collections.deque(maxlen=maxlen)
-        self._count = 0
-        self._sum = 0.0
+        self._values = collections.deque(maxlen=maxlen)  # guarded-by: self._lock
+        self._count = 0         # guarded-by: self._lock
+        self._sum = 0.0         # guarded-by: self._lock
+        # _bounds is immutable after construction — reads need no lock.
         self._bounds = (tuple(sorted({float(b) for b in buckets}))
                         if buckets else ())
-        self._bucket_counts = [0] * len(self._bounds)
+        self._bucket_counts = [0] * len(self._bounds)  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def observe(self, value):
@@ -373,7 +376,8 @@ class Histogram:
 
     @property
     def count(self):
-        return self._count
+        with self._lock:
+            return self._count
 
     def percentile(self, p):
         """Nearest-rank percentile over the reservoir (NaN when empty)."""
@@ -388,12 +392,14 @@ class Histogram:
     @property
     def total_count(self):
         """Lifetime observation count (never ages out)."""
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def total_sum(self):
         """Lifetime observation sum (never ages out)."""
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def summary(self):
         """Reservoir-local ``count``/``mean``/``p50``/``p99``/``max``
@@ -461,9 +467,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters = {}
-        self._gauges = {}
-        self._histograms = {}
+        self._counters = {}     # guarded-by: self._lock
+        self._gauges = {}       # guarded-by: self._lock
+        self._histograms = {}   # guarded-by: self._lock
 
     def counter(self, name, labels=None) -> Counter:
         with self._lock:
@@ -497,9 +503,9 @@ class MetricsRegistry:
         (obs/anomaly.py) polls metric streams other layers may not have
         created yet; the get-or-create accessors would materialize an
         empty series and teach its detectors a phantom zero."""
-        table = {'counter': self._counters, 'gauge': self._gauges,
-                 'histogram': self._histograms}[kind]
         with self._lock:
+            table = {'counter': self._counters, 'gauge': self._gauges,
+                     'histogram': self._histograms}[kind]
             return table.get(_metric_key(name, labels))
 
     def iter_metrics(self):

@@ -27,7 +27,14 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/8] graphlint (jaxpr/domain contracts) ==='
+echo '=== [2/8] graphlint + servelint (jaxpr/domain/serving contracts) ==='
+# Full pass: jaxpr rules over every registered entrypoint (incl. the
+# bf16 serving-dtype twins, whose flax-Dense f32-accum debt renders as
+# allowed records) + the AST families (host-pull/traced-bool/clock/
+# silent-except) + servelint (protolint event-schema call sites,
+# conclint guarded-by/thread discipline, determlint tick-path
+# determinism). Fast pre-commit twin:
+#   python -m distributed_dot_product_tpu.analysis --changed-only origin/main
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
 echo '=== [3/8] tier-1 tests ==='

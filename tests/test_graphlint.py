@@ -57,11 +57,36 @@ def _negatives_module():
 # -- clean-tree gate ----------------------------------------------------
 
 def test_clean_tree_gate(devices):
-    """THE gate: zero violations across the package AST scan and every
-    registered entrypoint's jaxpr. A contract break anywhere in ops/,
-    models/, serve/ or train.py fails here before it ships."""
+    """THE gate: zero ACTIVE violations across the package AST scan
+    (astlint + the servelint families) and every registered
+    entrypoint's jaxpr. A contract break anywhere in ops/, models/,
+    serve/, obs/ or train.py fails here before it ships. Waived debt
+    (TraceSpec.allow — the flax Dense bf16-accum entries) is reported
+    ``allowed`` and must stay that way: it never fails the gate, but
+    it must also remain VISIBLE (asserted non-empty below, so the
+    waiver cannot silently swallow everything)."""
+    from distributed_dot_product_tpu.analysis import active_violations
     violations = run_analysis()
-    assert violations == [], '\n'.join(v.render() for v in violations)
+    active = active_violations(violations)
+    assert active == [], '\n'.join(v.render() for v in active)
+    waived = [v for v in violations if v.allowed]
+    assert waived, ('expected the registered bf16 flax-Dense debt to '
+                    'render as allowed records — if the debt is paid, '
+                    'drop the TraceSpec.allow entries and this assert')
+    assert {v.rule for v in waived} == {'f32-accum'}
+    # The waiver is entry-wide, so pin the per-entry site COUNTS: a
+    # new bf16-accumulating dot in OUR kernels/decode path would ride
+    # the same entries as a fresh "allowed" record and exit 0 — this
+    # census turns silent debt growth into a reviewed gate failure
+    # (shrinkage too: a paid-down site updates the numbers here).
+    census = {}
+    for v in waived:
+        census[v.entrypoint] = census.get(v.entrypoint, 0) + 1
+    assert census == {
+        'attention.fwd_flash_bf16': 4,      # 3 in-proj + 1 out-proj
+        'decode.seq_parallel_step_bf16': 4,  # same Dense quartet
+        'lm.loss_bf16': 6,                   # attn quartet + 2 MLP
+    }, census
 
 
 def test_registry_covers_every_layer(devices):
@@ -79,6 +104,10 @@ def test_registry_covers_every_layer(devices):
         'decode.step_verify_paged', 'lm.head_bf16', 'lm.loss_f32',
         'serve.engine_decode', 'serve.engine_decode_paged',
         'train.lm_step', 'obs.spanned_decode',
+        # serving-dtype twins (PR 13): module-level surfaces traced at
+        # bf16 so the cache/donation contracts gate the deployed dtype.
+        'attention.fwd_flash_bf16', 'decode.seq_parallel_step_bf16',
+        'lm.loss_bf16',
     }
     assert expected <= names, f'missing: {expected - names}'
 

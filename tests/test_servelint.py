@@ -1,0 +1,219 @@
+# -*- coding: utf-8 -*-
+"""
+servelint (analysis/protolint.py, conclint.py, determlint.py) — the
+serving-layer static-analysis families' own gate and rule tests.
+
+Mirrors tests/test_graphlint.py's structure:
+
+- **Clean-tree gate**: the three families report ZERO active violations
+  over the repo — every convention (closed event vocabulary, guarded-by
+  lock discipline, virtual-clock tick purity) is a standing CI contract.
+- **Negative fixtures, one per family** (tests/graphlint_fixtures/
+  serve/): each seeded regression line carries a ``# VIOLATION: <rule>``
+  marker, so the assertions cannot drift from the files.
+- **CLI**: exit 1 over the fixture set with every family represented;
+  ``--changed-only`` mechanics; the bf16 registry debt rendered as
+  ``allowed`` records that do not fail the run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_dot_product_tpu.analysis import (
+    active_violations, run_analysis,
+)
+from distributed_dot_product_tpu.analysis import (
+    conclint, determlint, protolint,
+)
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, 'tests', 'graphlint_fixtures', 'serve')
+
+SERVELINT_RULES = (list(protolint.PROTO_RULES) + list(conclint.CONC_RULES)
+                   + list(determlint.DETERM_RULES))
+
+
+def _expected(path):
+    """``{(rule, line)}`` from the fixture's own ``# VIOLATION: rule``
+    markers — the file annotates its seeded regressions."""
+    out = set()
+    with open(path, encoding='utf-8') as f:
+        for i, line in enumerate(f, 1):
+            if '# VIOLATION:' in line:
+                rule = line.split('# VIOLATION:')[1].strip().split()[0]
+                out.add((rule, i))
+    return out
+
+
+# -- clean-tree gate ----------------------------------------------------
+
+def test_servelint_clean_tree_gate():
+    """Zero ACTIVE servelint violations repo-wide: emit sites match the
+    schema, annotated fields stay behind their locks, threads are
+    daemon+named, tick paths stay on the injected clock."""
+    violations = run_analysis(rules=SERVELINT_RULES, jaxpr=False)
+    active = active_violations(violations)
+    assert active == [], '\n'.join(v.render() for v in active)
+
+
+def test_real_time_contract_covers_the_waived_sites():
+    """The determlint allowlist is load-bearing: with the scheduler /
+    loadgen entries removed, the closure DOES flag their deliberate
+    real-time reads — the contract table is what keeps the tree green,
+    not a dead rule."""
+    import unittest.mock as mock
+    table = dict(determlint.REAL_TIME_CONTRACT)
+    table['serve/scheduler.py'] = {}
+    table['serve/loadgen.py'] = {}
+    with mock.patch.object(determlint, 'REAL_TIME_CONTRACT', table):
+        pkg = os.path.join(REPO, 'distributed_dot_product_tpu')
+        vs = determlint.lint_paths([os.path.join(pkg, 'serve')],
+                                   repo_root=REPO)
+    assert {v.rule for v in vs} == {'tick-determinism'}
+    hit_files = {os.path.basename(v.file) for v in vs}
+    assert hit_files == {'scheduler.py', 'loadgen.py'}, hit_files
+
+
+# -- negative fixtures --------------------------------------------------
+
+@pytest.mark.parametrize('fixture, linter', [
+    ('fx_proto_events.py', protolint),
+    ('fx_conc_guarded.py', conclint),
+    ('fx_tick_clock.py', determlint),
+])
+def test_rule_catches_fixture(fixture, linter):
+    path = os.path.join(FIXTURES, fixture)
+    violations = linter.lint_file(path, repo_root=REPO)
+    got = {(v.rule, v.line) for v in violations}
+    want = _expected(path)
+    assert want == got, (f'{fixture}: expected exactly {sorted(want)}, '
+                         f'got {sorted(got)}')
+    assert all(v.file and v.file.endswith(fixture) for v in violations)
+    assert not any(v.allowed for v in violations)
+
+
+def test_determlint_transitive_closure_reaches_helper():
+    """The sleep lives in a helper the tick root calls — the closure,
+    not the root body, is the enforcement surface."""
+    path = os.path.join(FIXTURES, 'fx_tick_clock.py')
+    vs = determlint.lint_file(path, repo_root=REPO)
+    assert any('time.sleep' in v.message and '_throttle' in v.message
+               for v in vs), '\n'.join(v.render() for v in vs)
+
+
+def test_conclint_locked_suffix_and_pragma_are_exempt():
+    path = os.path.join(FIXTURES, 'fx_conc_guarded.py')
+    vs = conclint.lint_file(path, repo_root=REPO)
+    lines = {v.line for v in vs}
+    with open(path, encoding='utf-8') as f:
+        src = f.readlines()
+    locked_line = next(i for i, l in enumerate(src, 1)
+                       if '_compact_locked' in l and 'def' in l)
+    assert not any(locked_line <= ln <= locked_line + 2 for ln in lines)
+
+
+# -- CLI ----------------------------------------------------------------
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.run(
+        [sys.executable, '-m', 'distributed_dot_product_tpu.analysis',
+         *args], capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=540)
+
+
+def test_cli_nonzero_on_servelint_fixtures():
+    """Exit 1 over the fixture set with each family represented —
+    including the planted unknown event kind, the off-lock write and
+    the time.time() in a tick path (the acceptance criteria trio)."""
+    res = _cli('--no-jaxpr',
+               os.path.join('tests', 'graphlint_fixtures', 'serve',
+                            'fx_proto_events.py'),
+               os.path.join('tests', 'graphlint_fixtures', 'serve',
+                            'fx_conc_guarded.py'),
+               os.path.join('tests', 'graphlint_fixtures', 'serve',
+                            'fx_tick_clock.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    for rule in ('event-vocab', 'event-fields', 'reject-reason',
+                 'guarded-by', 'thread-discipline', 'tick-determinism'):
+        assert rule in res.stdout, f'{rule} missing from CLI output'
+
+
+def test_cli_rule_filter_runs_single_family():
+    res = _cli('--no-jaxpr', '--rule', 'guarded-by',
+               os.path.join('tests', 'graphlint_fixtures', 'serve',
+                            'fx_conc_guarded.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert 'guarded-by' in res.stdout
+    assert 'thread-discipline' not in res.stdout
+
+
+def test_cli_list_rules_names_servelint():
+    res = _cli('--list-rules')
+    assert res.returncode == 0
+    for rule in SERVELINT_RULES:
+        assert rule in res.stdout
+
+
+def test_cli_changed_only_bad_ref_is_usage_error():
+    res = _cli('--changed-only', 'definitely-not-a-ref')
+    assert res.returncode == 2, res.stdout + res.stderr
+    assert 'changed-only' in res.stderr
+
+
+def test_cli_changed_only_rejects_explicit_paths():
+    res = _cli('--changed-only', 'HEAD', 'distributed_dot_product_tpu')
+    assert res.returncode == 2
+
+
+def test_changed_files_mechanics():
+    from distributed_dot_product_tpu.analysis.__main__ import (
+        changed_files,
+    )
+    files = changed_files('HEAD')
+    assert all(os.path.isfile(f) and f.endswith('.py') for f in files)
+    with pytest.raises(RuntimeError):
+        changed_files('definitely-not-a-ref')
+
+
+# -- bf16 registry debt: visible, allowed, non-failing ------------------
+
+@pytest.mark.slow
+def test_bf16_debt_renders_allowed_in_json(devices):
+    """The flax Dense bf16-accum debt (ROADMAP item 3a) is VISIBLE as
+    allowed records in json output and never fails the CLI."""
+    res = _cli('--no-ast', '--format', 'json', '--rule', 'f32-accum')
+    assert res.returncode == 0, res.stdout + res.stderr
+    records = json.loads(res.stdout)
+    allowed = [r for r in records if r['allowed']]
+    assert {r['entrypoint'] for r in allowed} >= {
+        'attention.fwd_flash_bf16', 'decode.seq_parallel_step_bf16',
+        'lm.loss_bf16'}
+    assert all(r['rule'] == 'f32-accum' for r in allowed)
+    assert not [r for r in records if not r['allowed']]
+
+
+def test_bf16_variants_trace_clean_inline(devices):
+    """In-process twin of the slow CLI check: the three serving-dtype
+    entries trace, and every violation they report is the waived
+    f32-accum debt."""
+    from distributed_dot_product_tpu.analysis.jaxpr_rules import (
+        lint_entrypoints,
+    )
+    from distributed_dot_product_tpu.analysis.registry import (
+        default_entrypoints,
+    )
+    entries = default_entrypoints()
+    subset = {name: entries[name] for name in
+              ('attention.fwd_flash_bf16', 'lm.loss_bf16')}
+    vs = lint_entrypoints(subset)
+    assert vs, 'the bf16 debt disappeared — flax owns its dots now? ' \
+               'drop the allow list and celebrate'
+    assert all(v.allowed and v.rule == 'f32-accum' for v in vs), \
+        '\n'.join(v.render() for v in vs)
