@@ -107,6 +107,16 @@ def parse_args():
                              'QK^T on the MXU int8 path; decode mode: '
                              'an int8-trained model decoding through '
                              'its append-time int8 K mirror')
+    parser.add_argument('--weight-quant', choices=['off', 'int8'],
+                        default='off',
+                        help='decode/decode-serve modes: int8 WEIGHT '
+                             'quantization for the projection/head '
+                             'matmuls (per-output-channel scales, '
+                             's8xs8->s32 with in-kernel dequant — '
+                             'models/dense.py). Rows record weight '
+                             'bytes + kv bytes next to time, so the '
+                             'quantized row is judged against its '
+                             'bf16 twin on BYTES MOVED as well')
     parser.add_argument('--kv-heads', type=int, default=None,
                         help='attn/train modes: grouped-query K/V head '
                              'count (< --heads, must divide it); default '
@@ -738,6 +748,19 @@ def _append_record(path, record):
     return record
 
 
+def _probe_paged_int8(h_kv, d):
+    """A FIXED-SHAPE mirror-carrying paged cache for the eligibility
+    flag recorded on decode rows — a code canary for the categorical
+    capability (mirror pools ride the fused kernel), not a probe of
+    this row's page geometry (eligibility depends on page size vs the
+    VMEM cap, not on h_kv/d; the row's slab cache has no page size)."""
+    from distributed_dot_product_tpu.models.decode import (
+        init_paged_cache,
+    )
+    return init_paged_cache(1, h_kv, 64, d, pages=2, page_size=16,
+                            qk_quant='int8')
+
+
 def run_decode(args):
     """``--mode decode``: steady-state KV-cache decode latency through
     the module surface (one token per step against a part-filled cache).
@@ -754,15 +777,31 @@ def run_decode(args):
     # qk_quant='int8': the cache carries an append-time int8 K mirror —
     # the decode step streams it instead of the bf16 K (half the K
     # bytes on a bandwidth-bound step).
+    weight_quant = (None if args.weight_quant == 'off'
+                    else args.weight_quant)
     model = DistributedDotProductAttn(
         key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
         causal=True, use_rope=args.use_rope, softmax_impl='flash',
-        qk_quant=args.qk_quant, dtype=dtype,
+        qk_quant=args.qk_quant, weight_quant=weight_quant, dtype=dtype,
         decode_impl=(None if args.decode_impl == 'auto'
                      else args.decode_impl))
     b = args.batch
     x0 = jnp.zeros((b, 16, h * d), dtype)
-    params = model.init(jax.random.key(0), x0, x0, x0, None)
+    if weight_quant == 'int8':
+        # Load/convert-time quantization: init the FLOAT twin's params
+        # and convert — exactly the deployment flow (a trained float
+        # checkpoint quantized once at load).
+        from distributed_dot_product_tpu.models.dense import (
+            quantize_dense_params,
+        )
+        float_model = DistributedDotProductAttn(
+            key_dim=h * d, num_heads=h, num_kv_heads=args.kv_heads,
+            causal=True, use_rope=args.use_rope, softmax_impl='flash',
+            qk_quant=args.qk_quant, dtype=dtype)
+        params = quantize_dense_params(
+            float_model.init(jax.random.key(0), x0, x0, x0, None))
+    else:
+        params = model.init(jax.random.key(0), x0, x0, x0, None)
     fill = t_max - 64  # leave headroom for the timed decode steps
     cache = model.make_decode_cache(b, t_max, dtype=dtype)
     # Fill the cache directly with random projected operands: the timed
@@ -875,12 +914,20 @@ def run_decode(args):
     k_bytes = (t_max * d * 1 + t_max * 4 if args.qk_quant == 'int8'
                else t_max * d * elem)
     cache_bytes = b * h_kv * (t_max * d * elem + k_bytes)
+    # Weight bytes the step streams (the four projection kernels +
+    # scales/biases) — int8 weights roughly quarter the f32 twin's and
+    # halve the bf16 twin's, so the quantized row must beat its twin
+    # on kv+weight bytes, not just kv bytes.
+    from distributed_dot_product_tpu.models.dense import (
+        dense_param_bytes,
+    )
+    weight_bytes = dense_param_bytes(params)
     # The path actually measured (auto resolves per backend), so
     # kernel-vs-XLA tables read straight off the records — resolved by
     # the SAME function decode_step uses, so the label cannot drift
     # from the code path.
     from distributed_dot_product_tpu.models.decode import (
-        _resolve_decode_impl,
+        _resolve_decode_impl, decode_kernel_eligible,
     )
     impl_resolved = _resolve_decode_impl(
         None if args.decode_impl == 'auto' else args.decode_impl,
@@ -890,6 +937,15 @@ def run_decode(args):
         'kv_heads': h_kv, 'head_dim': d, 'dtype': args.dtype,
         'use_rope': args.use_rope, 'world': 1,
         'batch': b, 'chain': chain, 'qk_quant': args.qk_quant,
+        'weight_quant': weight_quant,
+        'weight_bytes': weight_bytes,
+        'kv_bytes': cache_bytes,
+        'step_bytes': cache_bytes + weight_bytes,
+        # The tentpole-c acceptance probe: quantized decode must be
+        # kernel-eligible ON THE PAGE POOL (mirror pools present) —
+        # recorded on every row so the CI smoke reads it off the twin.
+        'paged_int8_kernel_eligible': bool(decode_kernel_eligible(
+            _probe_paged_int8(h_kv, d), qk_quant='int8')),
         'decode_impl': impl_resolved,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,
@@ -911,13 +967,15 @@ def run_decode(args):
     }
     gq = '' if h_kv == h else f'/kv{h_kv}'
     bc = '' if (b == 1 and chain == 1) else f' B={b} chain={chain}'
+    wq = '' if weight_quant is None else f'/w{weight_quant}'
     ttft = ('' if prefill_time is None
             else f" TTFT {record['ttft_ms']:.1f} ms")
-    print(f"decode[{impl_resolved}] t_max={t_max} fill={fill} "
+    print(f"decode[{impl_resolved}{wq}] t_max={t_max} fill={fill} "
           f"H={h}{gq} d={d}{bc}: "
           f"{record['ms_per_step']:.3f} ms/step "
           f"{record['tokens_per_s']:,.0f} tok/s "
-          f"({record['cache_gb_per_s']:.0f} GB/s over the cache)"
+          f"({record['cache_gb_per_s']:.0f} GB/s over the cache, "
+          f"{record['step_bytes'] / 2**20:.2f} MiB kv+weights/step)"
           + ttft)
     _append_record(args.file, record)
     return record
@@ -982,7 +1040,8 @@ def run_decode_serve(args):
         return KernelEngine(slots=slots, t_max=t_max, vocab=256, heads=h,
                             head_dim=d, prefill_chunk=8, seed=0,
                             decode_impl=(None if args.decode_impl == 'auto'
-                                         else args.decode_impl), **extra)
+                                         else args.decode_impl),
+                            weight_quant=args.weight_quant, **extra)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 256, size=prompt_len).astype(np.int32)
@@ -1113,6 +1172,8 @@ def run_decode_serve(args):
         'prompt_len': prompt_len, 'max_new_tokens': max_new,
         'decode_impl': impl_resolved,
         'cache_mode': args.cache_mode,
+        'weight_quant': eng.weight_quant,
+        'weight_bytes': eng.weight_bytes,
         'kv_budget_bytes': kv_budget_bytes,
         'max_concurrent': peak['busy'],
         'platform': jax.devices()[0].platform,

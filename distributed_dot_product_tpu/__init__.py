@@ -59,6 +59,9 @@ from distributed_dot_product_tpu.models.decode import (  # noqa: F401
     decode_attention, decode_kernel_eligible, decode_step, init_cache,
     init_slot_cache, reset_slot, slots_all_finite,
 )
+from distributed_dot_product_tpu.models.dense import (  # noqa: F401
+    OwnedDense, quantize_dense_params, quantize_kernel,
+)
 from distributed_dot_product_tpu.models.transformer import (  # noqa: F401
     TransformerBlock, TransformerStack,
 )

@@ -19,12 +19,14 @@ and run on whatever devices are visible; mesh-using entries need >= 2
 devices (the CLI forces an 8-device CPU platform — see
 analysis/__main__.py — and the test suite already runs on one).
 
-Precision convention for examples: entries that trace through
-``flax.linen.Dense`` projections register at f32 — flax Dense emits
-bf16-accumulating dots at bf16 and owning that is a separate project —
-while the raw-op entries (flash kernels, decode steps, the LM head
-einsum) register at bf16/int8, because those are the paths whose
-fp32-accumulation contract this linter enforces.
+Precision convention for examples: every projection matmul is the
+OWNED dense (models/dense.py — explicit ``preferred_element_type``
+accumulation), so module-level entries register at the serving dtype
+(bf16, plus int8-weight twins) right alongside the raw-op entries
+(flash kernels, decode steps, the LM head einsum) — the
+fp32/i32-accumulation contract is enforced end to end with zero
+waivers (the flax ``linen.Dense`` debt that used to force f32
+registration is retired).
 """
 
 import dataclasses
@@ -53,11 +55,12 @@ class TraceSpec:
     ``allow``: rule ids whose violations on THIS entry are known,
     documented debt — reported with ``allowed=True`` (visible in
     ``--format json``) but never failing the CLI or the gate. The
-    registration line carries a matching ``# graphlint: allow[...]``
-    comment so the waiver stays greppable; used for the flax
-    ``linen.Dense`` bf16-accumulation debt (ROADMAP item 3a), whose
-    offending dots trace into flax's own source where a line pragma
-    cannot live.
+    registration line should carry a matching ``# graphlint:
+    allow[...]`` comment so the waiver stays greppable. Currently
+    UNUSED: the last waivers (the flax ``linen.Dense``
+    bf16-accumulation debt) were retired by the owned dense
+    (models/dense.py), and the gate test asserts the waiver set stays
+    empty — adding one is a reviewed decision, not a default.
     """
     name: str
     fn: Callable

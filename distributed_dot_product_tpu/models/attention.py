@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from distributed_dot_product_tpu.models import features
+from distributed_dot_product_tpu.models.dense import OwnedDense
 from distributed_dot_product_tpu.models.ring_attention import (
     _layout_positions, local_attention_reference, ring_attention,
 )
@@ -164,6 +165,14 @@ class DistributedDotProductAttn(nn.Module):
     # gating). Applies to decode/decode_sharded; prefill always runs
     # the flash kernel.
     decode_impl: Optional[str] = None
+    # 'int8' = int8 WEIGHT quantization for the four projection
+    # matmuls (models/dense.py): kernels stored int8 with per-output-
+    # channel scales (quantize_dense_params at load/convert time),
+    # activations quantized per row in the forward, dot on the MXU
+    # s8×s8→s32 path with in-kernel dequant — half the weight bytes a
+    # bandwidth-bound decode step streams. Orthogonal to qk_quant
+    # (which quantizes the SCORE operands).
+    weight_quant: Optional[str] = None
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
 
@@ -200,6 +209,9 @@ class DistributedDotProductAttn(nn.Module):
                                  'position and require causal=True')
         if self.qk_quant is not None:
             features.check('qk_quant', self.softmax_impl)
+        if self.weight_quant not in (None, 'int8'):
+            raise ValueError(f"weight_quant must be None or 'int8', "
+                             f'got {self.weight_quant!r}')
         if self.decode_impl not in (None, 'auto', 'kernel', 'xla'):
             raise ValueError(f"decode_impl must be None, 'auto', "
                              f"'kernel' or 'xla', got "
@@ -233,9 +245,12 @@ class DistributedDotProductAttn(nn.Module):
             if self.head_dim % 2:
                 raise ValueError(
                     f'use_rope needs an even head dim, got {self.head_dim}')
-        dense = lambda feat, name: nn.Dense(  # noqa: E731
+        # OwnedDense, not nn.Dense: the projection dots request fp32
+        # accumulation explicitly (and carry the int8 weight path) —
+        # see models/dense.py for why flax Dense can't be linted.
+        dense = lambda feat, name: OwnedDense(  # noqa: E731
             feat, use_bias=self.add_bias, name=name, dtype=self.dtype,
-            param_dtype=self.param_dtype)
+            param_dtype=self.param_dtype, weight_quant=self.weight_quant)
         # Same four projections as reference module.py:36-39. Under GQA
         # the queries/values projections (the gathered, softmax-table
         # side — standard attention's K/V under the module's K-first
@@ -846,10 +861,10 @@ def graphlint_entrypoints():
     ring ppermute, ulysses all_to_all) for the collective-axis rule,
     and the full sequence-sharded decode step (make_decode_step) for
     the donation + cache-alias rules on the exact callable a serving
-    loop holds. Registered at f32: flax Dense projections emit
-    bf16-accumulating dots at bf16 (tracked separately); the bf16
-    fp32-accumulation contract is enforced on the raw-kernel entries
-    (ops/, models/decode.py)."""
+    loop holds. The projections are the owned dense (models/dense.py)
+    with explicit fp32 accumulation, so the bf16 serving-dtype twins
+    trace CLEAN — zero f32-accum waivers (the retired ROADMAP item 3a
+    debt) — and the int8-weight twin pins the s8×s8→s32 path."""
     import functools
 
     def _module(softmax_impl, **kw):
@@ -857,8 +872,7 @@ def graphlint_entrypoints():
             key_dim=8, num_heads=2, causal=True, offset=2,
             softmax_impl=softmax_impl, **kw)
 
-    def _fwd_spec(name, softmax_impl, dtype=jnp.float32, allow=(),
-                  **kw):
+    def _fwd_spec(name, softmax_impl, dtype=jnp.float32, **kw):
         import jax
         from distributed_dot_product_tpu.analysis.registry import (
             TraceSpec,
@@ -873,7 +887,7 @@ def graphlint_entrypoints():
             return apply_seq_parallel(module, p, mesh, k, q, v, None)
 
         return TraceSpec(name=name, fn=fn, args=(params, x, x, x),
-                         mesh_axes=(SEQ_AXIS,), allow=tuple(allow))
+                         mesh_axes=(SEQ_AXIS,))
 
     def _bwd_spec(name, softmax_impl, **kw):
         import jax
@@ -888,7 +902,7 @@ def graphlint_entrypoints():
         return base.replace(fn=jax.grad(loss, argnums=(0, 1)))
 
     def seq_parallel_step(name='decode.seq_parallel_step',
-                          dtype=jnp.float32, allow=()):
+                          dtype=jnp.float32):
         import jax
         from distributed_dot_product_tpu.analysis.registry import (
             TraceSpec,
@@ -907,20 +921,23 @@ def graphlint_entrypoints():
             mesh_axes=(SEQ_AXIS,), prejitted=True,
             cache_in=lambda a: [a[4].k, a[4].v],
             cache_out=lambda o: [o[0].k, o[0].v],
-            expect_donation=True, min_donated=2, allow=tuple(allow))
+            expect_donation=True, min_donated=2)
 
     # The *_bf16 twins trace the module-level surfaces at SERVING
-    # dtype, so the aliasing/donation/upcast contracts are enforced on
-    # the program a bf16 deployment actually runs. Their flax
-    # linen.Dense projections emit bf16-accumulating dots — the known
-    # ROADMAP item 3a debt, waived per-entry (visible as allowed
-    # records in `--format json`) until the owned dense ships:
+    # dtype, so the aliasing/donation/upcast/f32-accum contracts are
+    # enforced on the program a bf16 deployment actually runs — the
+    # owned-dense projections accumulate in fp32, so these trace with
+    # ZERO waivers. The _wq8 twin traces the int8-WEIGHT serving
+    # program (s8×s8→s32 projection dots + in-kernel dequant).
     return {
         'attention.fwd_flash': functools.partial(
             _fwd_spec, 'attention.fwd_flash', 'flash'),
-        'attention.fwd_flash_bf16': functools.partial(  # graphlint: allow[f32-accum] flax Dense bf16-accum debt
+        'attention.fwd_flash_bf16': functools.partial(
             _fwd_spec, 'attention.fwd_flash_bf16', 'flash',
-            dtype=jnp.bfloat16, allow=('f32-accum',)),
+            dtype=jnp.bfloat16),
+        'attention.fwd_flash_wq8': functools.partial(
+            _fwd_spec, 'attention.fwd_flash_wq8', 'flash',
+            dtype=jnp.bfloat16, weight_quant='int8'),
         'attention.bwd_full': functools.partial(
             _bwd_spec, 'attention.bwd_full', 'full'),
         'attention.fwd_ring': functools.partial(
@@ -928,7 +945,7 @@ def graphlint_entrypoints():
         'attention.fwd_ulysses': functools.partial(
             _fwd_spec, 'attention.fwd_ulysses', 'ulysses'),
         'decode.seq_parallel_step': seq_parallel_step,
-        'decode.seq_parallel_step_bf16': functools.partial(  # graphlint: allow[f32-accum] flax Dense bf16-accum debt
+        'decode.seq_parallel_step_bf16': functools.partial(
             seq_parallel_step, 'decode.seq_parallel_step_bf16',
-            dtype=jnp.bfloat16, allow=('f32-accum',)),
+            dtype=jnp.bfloat16),
     }

@@ -60,7 +60,8 @@ __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'reset_slot', 'slots_all_finite', 'decode_step',
            'decode_kernel_eligible', 'rollback_slots',
            'PagedDecodeCache', 'PagePool',
-           'init_paged_cache', 'paged_gather', 'paged_append_kv_slots',
+           'init_paged_cache', 'paged_gather', 'paged_gather_mirror',
+           'paged_append_kv_slots',
            'paged_append_rows', 'paged_reset_slot',
            'paged_rollback_slots', 'paged_copy_attach',
            'paged_transfer_pages']
@@ -487,11 +488,23 @@ class PagedDecodeCache(NamedTuple):
     page another slot owns: Pallas flushes every output block whether
     or not the kernel wrote it, and without the sink an idle slot's
     copy-through could race another slot's in-flight append on real
-    TPU (grid rows have no cross-row write ordering)."""
+    TPU (grid rows have no cross-row write ordering).
+
+    ``k_q_pool``/``k_scale_pool``: the optional int8 K mirror ON THE
+    PAGE POOL — ``(pages + 1, H_kv, page_size, d) int8`` and
+    ``(pages + 1, H_kv, page_size, 1) f32`` pools maintained by every
+    paged write exactly like the slab cache's ``k_q``/``k_scale``
+    (rows quantize once at append with the training kernels' per-row
+    rule, so the mirror is bit-identical to re-quantizing the pool).
+    With the mirror, quantized decode rides the fused kernel at paged
+    concurrency: the kernel streams the 1-byte mirror pages through
+    the same page-table BlockSpec redirect as the bf16 pool."""
     k_pool: jax.Array
     v_pool: jax.Array
     page_table: jax.Array
     length: jax.Array
+    k_q_pool: Optional[jax.Array] = None
+    k_scale_pool: Optional[jax.Array] = None
 
     @property
     def page_size(self):
@@ -517,19 +530,26 @@ class PagedDecodeCache(NamedTuple):
 
 
 def init_paged_cache(slots, kv_heads, t_max, head_dim, *, pages,
-                     page_size, v_head_dim=None, dtype=jnp.bfloat16):
+                     page_size, v_head_dim=None, dtype=jnp.bfloat16,
+                     qk_quant=None):
     """Zero paged cache: a ``pages``-page pool whose page size must
     divide the per-slot capacity ``t_max``. The pool is sized by the
     MEMORY budget, not ``slots × t_max`` — that decoupling is the whole
     point (``pages << slots · t_max/page_size`` serves more concurrent
     sequences than a slab of the same bytes whenever actual fill is
-    below worst case)."""
+    below worst case). ``qk_quant='int8'`` allocates the int8 K-mirror
+    pools for int8-trained models — quantized decode then rides the
+    fused kernel on the page pool (see :class:`PagedDecodeCache`)."""
     v_head_dim = v_head_dim or head_dim
     if page_size < 1 or t_max % page_size:
         raise ValueError(f'page_size {page_size} must divide t_max '
                          f'{t_max}')
     if pages < 1:
         raise ValueError(f'need pages >= 1, got {pages}')
+    if qk_quant not in (None, 'int8'):
+        raise ValueError(f"qk_quant must be None or 'int8', "
+                         f'got {qk_quant!r}')
+    quant = qk_quant == 'int8'
     # +1: the reserved write-sink row (see PagedDecodeCache).
     return PagedDecodeCache(
         k_pool=jnp.zeros((pages + 1, kv_heads, page_size, head_dim),
@@ -537,7 +557,11 @@ def init_paged_cache(slots, kv_heads, t_max, head_dim, *, pages,
         v_pool=jnp.zeros((pages + 1, kv_heads, page_size, v_head_dim),
                          dtype),
         page_table=jnp.full((slots, t_max // page_size), -1, jnp.int32),
-        length=jnp.zeros((slots,), jnp.int32))
+        length=jnp.zeros((slots,), jnp.int32),
+        k_q_pool=(jnp.zeros((pages + 1, kv_heads, page_size, head_dim),
+                            jnp.int8) if quant else None),
+        k_scale_pool=(jnp.zeros((pages + 1, kv_heads, page_size, 1),
+                                jnp.float32) if quant else None))
 
 
 def paged_gather(cache: PagedDecodeCache):
@@ -551,6 +575,15 @@ def paged_gather(cache: PagedDecodeCache):
     even when its host-tracked length runs ahead of its allocation.
     (Columns past ``length`` are masked regardless, so kernel-path
     flush garbage parked on the sink still contributes exactly 0.)"""
+    return _gather_pools(cache, cache.k_pool, cache.v_pool)
+
+
+def _gather_pools(cache: PagedDecodeCache, *pools):
+    """THE page-table gather (shared by the data and mirror slab
+    views, so the sink-redirect/clip semantics cannot drift between
+    them): each ``(pages + 1, H_kv, page_size, d·)`` pool →
+    ``(slots, H_kv, t_max, d·)``, unallocated entries reading the
+    reserved sink row."""
     pt = jnp.where(cache.page_table >= 0, cache.page_table,
                    cache.pages).reshape(-1)                # (B·np,)
     b, npg = cache.page_table.shape
@@ -562,7 +595,46 @@ def paged_gather(cache: PagedDecodeCache):
         x = x.reshape(b, npg, h_kv, ps, d)
         return jnp.moveaxis(x, 2, 1).reshape(b, h_kv, npg * ps, d)
 
-    return g(cache.k_pool), g(cache.v_pool)
+    return tuple(g(pool) for pool in pools)
+
+
+def paged_gather_mirror(cache: PagedDecodeCache):
+    """Slab view of the int8 K mirror — ``(k_q (B, H_kv, t_max, d),
+    k_scale (B, H_kv, t_max, 1))`` gathered through the page table
+    exactly like :func:`paged_gather`; the portable XLA quantized
+    decode attends against it (unallocated entries read the sink
+    page's zeros — zero scale, masked columns anyway)."""
+    if cache.k_q_pool is None:
+        raise ValueError('this paged cache carries no int8 K mirror — '
+                         "allocate it with init_paged_cache("
+                         "qk_quant='int8')")
+    return _gather_pools(cache, cache.k_q_pool, cache.k_scale_pool)
+
+
+def _paged_scatter_indices(cache: PagedDecodeCache, start, count, n):
+    """Drop-mode scatter targets for ``n`` candidate rows per slot at
+    logical positions ``start..`` — THE page/row index computation
+    every per-slot paged writer shares (appends and the mirror fixup),
+    so the two writers provably target the same pool rows. ``start
+    (B,)`` is row 0's logical position (−1 = slot writes nothing);
+    ``count (B,)`` how many of the ``n`` rows are real. Returns
+    ``(pg, rw) (B, n)`` with every invalid row (past its count, no
+    start, past the table reach, unallocated page) redirected ONE PAST
+    the pool end so ``.at[...].set(mode='drop')`` discards it (−1
+    would WRAP to the last pool page and corrupt it)."""
+    b, npg = cache.page_table.shape
+    ps = cache.page_size
+    pos = start[:, None] + jnp.arange(n)[None, :]          # (B, n)
+    pi = pos // ps
+    valid = jnp.logical_and(jnp.arange(n)[None, :] < count[:, None],
+                            start[:, None] >= 0)
+    pg = jnp.take_along_axis(cache.page_table,
+                             jnp.clip(pi, 0, npg - 1), axis=1)
+    pg = jnp.where(jnp.logical_and(valid,
+                                   jnp.logical_and(pi < npg, pg >= 0)),
+                   pg, cache.pages + 1)                    # (B, n)
+    rw = pos % ps
+    return pg, rw
 
 
 def paged_append_kv_slots(cache: PagedDecodeCache, k_new, v_new, *,
@@ -575,8 +647,7 @@ def paged_append_kv_slots(cache: PagedDecodeCache, k_new, v_new, *,
     whose page-table entry is unallocated (−1) is DROPPED, never
     written anywhere (the host allocator must have reserved pages
     first; :class:`PagePool` is that allocator)."""
-    b, npg = cache.page_table.shape
-    ps = cache.page_size
+    b = cache.page_table.shape[0]
     t_max = cache.t_max
     n = k_new.shape[-2]
     if n > t_max:
@@ -600,28 +671,25 @@ def paged_append_kv_slots(cache: PagedDecodeCache, k_new, v_new, *,
                     f'generation loop')
 
     ok = cache.length + eff <= t_max                       # (B,)
-    pos = cache.length[:, None] + jnp.arange(n)[None, :]   # (B, n)
-    valid = jnp.logical_and(
-        jnp.arange(n)[None, :] < eff[:, None], ok[:, None])
-    pi = pos // ps
-    pg = jnp.take_along_axis(cache.page_table,
-                             jnp.clip(pi, 0, npg - 1), axis=1)
-    # Dropped rows point ONE PAST the pool end (past the sink row too):
-    # scatter mode='drop' discards out-of-bounds indices, whereas −1
-    # would WRAP to the last pool page (numpy indexing semantics) and
-    # corrupt it.
-    pg = jnp.where(jnp.logical_and(valid,
-                                   jnp.logical_and(pi < npg, pg >= 0)),
-                   pg, cache.pages + 1)                    # (B, n)
-    rw = pos % ps
+    pg, rw = _paged_scatter_indices(cache, cache.length,
+                                    jnp.where(ok, eff, 0), n)
 
     def write(pool, new):
         vals = jnp.moveaxis(new.astype(pool.dtype), 2, 1)  # (B, n, H, d)
         return pool.at[pg, :, rw, :].set(vals, mode='drop')
 
+    k_q_pool, k_scale_pool = cache.k_q_pool, cache.k_scale_pool
+    if cache.k_q_pool is not None:
+        # Maintain the pool mirror — the ONE quantize-and-scatter body
+        # (also the kernel path's post-hoc fixup), so the append rule
+        # and the fixup rule are provably the same computation.
+        k_q_pool, k_scale_pool = _paged_mirror_fixup(
+            cache, k_new, cache.length, jnp.where(ok, eff, 0))
     return cache._replace(k_pool=write(cache.k_pool, k_new),
                           v_pool=write(cache.v_pool, v_new),
-                          length=cache.length + eff)
+                          length=cache.length + eff,
+                          k_q_pool=k_q_pool,
+                          k_scale_pool=k_scale_pool)
 
 
 def paged_append_rows(cache: PagedDecodeCache, k_rows, v_rows, page_row,
@@ -646,8 +714,23 @@ def paged_append_rows(cache: PagedDecodeCache, k_rows, v_rows, page_row,
         vals = jnp.moveaxis(rows.astype(pool.dtype), 1, 0)  # (C, H, d)
         return pool.at[pg, :, rw, :].set(vals, mode='drop')
 
+    k_q_pool, k_scale_pool = cache.k_q_pool, cache.k_scale_pool
+    if cache.k_q_pool is not None:
+        # Registered prefixes carry mirror rows too — a quantized slot
+        # riding a shared prefix must stream identical int8 pages to a
+        # slot that prefilled the same tokens itself.
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        h_kv, d = cache.k_pool.shape[1], cache.k_pool.shape[-1]
+        ki, sk = _quantize_rows(k_rows.astype(cache.k_pool.dtype),
+                                h_kv, c, d)
+        k_q_pool = write(k_q_pool, ki.reshape(h_kv, c, d))
+        k_scale_pool = write(k_scale_pool, sk.reshape(h_kv, c, 1))
     return cache._replace(k_pool=write(cache.k_pool, k_rows),
-                          v_pool=write(cache.v_pool, v_rows))
+                          v_pool=write(cache.v_pool, v_rows),
+                          k_q_pool=k_q_pool,
+                          k_scale_pool=k_scale_pool)
 
 
 def paged_reset_slot(cache: PagedDecodeCache, slot, freed_pages):
@@ -670,7 +753,11 @@ def paged_reset_slot(cache: PagedDecodeCache, slot, freed_pages):
     return PagedDecodeCache(
         k_pool=clear(cache.k_pool), v_pool=clear(cache.v_pool),
         page_table=jnp.where(sel[:, None], -1, cache.page_table),
-        length=jnp.where(sel, 0, cache.length))
+        length=jnp.where(sel, 0, cache.length),
+        k_q_pool=(None if cache.k_q_pool is None
+                  else clear(cache.k_q_pool)),
+        k_scale_pool=(None if cache.k_scale_pool is None
+                      else clear(cache.k_scale_pool)))
 
 
 def paged_rollback_slots(cache: PagedDecodeCache, lengths, span):
@@ -708,7 +795,12 @@ def paged_rollback_slots(cache: PagedDecodeCache, lengths, span):
 
     return cache._replace(k_pool=clear(cache.k_pool),
                           v_pool=clear(cache.v_pool),
-                          length=new_len)
+                          length=new_len,
+                          k_q_pool=(None if cache.k_q_pool is None
+                                    else clear(cache.k_q_pool)),
+                          k_scale_pool=(None if cache.k_scale_pool is
+                                        None
+                                        else clear(cache.k_scale_pool)))
 
 
 def paged_copy_attach(cache: PagedDecodeCache, src_page, dst_page, slot,
@@ -729,7 +821,11 @@ def paged_copy_attach(cache: PagedDecodeCache, src_page, dst_page, slot,
     return cache._replace(
         k_pool=copy(cache.k_pool), v_pool=copy(cache.v_pool),
         length=jnp.where(sel, jnp.asarray(length_val, jnp.int32),
-                         cache.length))
+                         cache.length),
+        k_q_pool=(None if cache.k_q_pool is None
+                  else copy(cache.k_q_pool)),
+        k_scale_pool=(None if cache.k_scale_pool is None
+                      else copy(cache.k_scale_pool)))
 
 
 def paged_transfer_pages(cache: PagedDecodeCache, src_k_pool, src_v_pool,
@@ -757,8 +853,34 @@ def paged_transfer_pages(cache: PagedDecodeCache, src_k_pool, src_v_pool,
         rows = jnp.take(src_pool, srci, axis=0).astype(pool.dtype)
         return pool.at[dsti].set(rows, mode='drop')
 
-    return cache._replace(k_pool=put(cache.k_pool, src_k_pool),
-                          v_pool=put(cache.v_pool, src_v_pool))
+    new_k = put(cache.k_pool, src_k_pool)
+    k_q_pool, k_scale_pool = cache.k_q_pool, cache.k_scale_pool
+    if cache.k_q_pool is not None:
+        # Rebuild the mirror rows of the copied pages from the adopted
+        # K itself: the per-row rule is deterministic over the
+        # cache-dtype bits, so every FILLED row's mirror is bit-
+        # identical to the source's (unfilled tail rows get the eps
+        # scale instead of the init zero — both score exactly nothing
+        # under the mask) — and it works whether or not the SOURCE
+        # pool (a prefill pool may be unquantized) carries one.
+        from distributed_dot_product_tpu.ops.pallas_attention import (
+            _quantize_rows,
+        )
+        h_kv, ps = cache.k_pool.shape[1], cache.page_size
+        d = cache.k_pool.shape[-1]
+        w = dst.shape[0]
+        pages_k = jnp.take(new_k, jnp.minimum(dsti, cache.pages),
+                           axis=0)                 # (W, H, ps, d)
+        ki, sk = _quantize_rows(pages_k.reshape(w * h_kv, ps, d),
+                                w * h_kv, ps, d)
+        k_q_pool = k_q_pool.at[dsti].set(
+            ki.reshape(w, h_kv, ps, d), mode='drop')
+        k_scale_pool = k_scale_pool.at[dsti].set(
+            sk.reshape(w, h_kv, ps, 1), mode='drop')
+    return cache._replace(k_pool=new_k,
+                          v_pool=put(cache.v_pool, src_v_pool),
+                          k_q_pool=k_q_pool,
+                          k_scale_pool=k_scale_pool)
 
 
 class PagePool:
@@ -1019,35 +1141,107 @@ class PagePool:
         return self.attach(dst, pages, length)
 
 
-def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None):
+def _paged_mirror_fixup(cache: PagedDecodeCache, k_new, ap, nvec):
+    """Quantize this step's appended rows into the mirror pools — THE
+    mirror-maintenance body: :func:`paged_append_kv_slots` calls it on
+    every mirror-carrying append, and :func:`decode_step`'s kernel
+    path calls it post hoc when a non-int8 step left the mirror to
+    XLA (one definition, so the append rule and the fixup rule cannot
+    diverge). Per-row quantization of the CACHE-dtype value, scattered
+    through the page table with the usual drop-mode indices. ``ap
+    (B,)`` is each slot's first append column (−1 = none), ``nvec
+    (B,)`` the rows it appended; returns the updated
+    ``(k_q_pool, k_scale_pool)``."""
+    from distributed_dot_product_tpu.ops.pallas_attention import (
+        _quantize_rows,
+    )
+    b = cache.page_table.shape[0]
+    h_kv, d = cache.k_pool.shape[1], cache.k_pool.shape[-1]
+    n = k_new.shape[-2]
+    ki, sk = _quantize_rows(k_new.astype(cache.k_pool.dtype), b * h_kv,
+                            n, d)
+    pg, rw = _paged_scatter_indices(cache, ap, nvec, n)
+
+    def write(pool, new):
+        vals = jnp.moveaxis(new.astype(pool.dtype), 2, 1)
+        return pool.at[pg, :, rw, :].set(vals, mode='drop')
+
+    return (write(cache.k_q_pool, ki.reshape(b, h_kv, n, d)),
+            write(cache.k_scale_pool, sk.reshape(b, h_kv, n, 1)))
+
+
+def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None,
+                           explain=False):
     """Can :func:`decode_step` take the fused Pallas kernel for this
     call? The kernel covers the serving hot path — ``1 <= n <= K split``
     new rows per slot per step (n = 1 classic decode; n > 1 the fused
     VERIFY-k step of speculative decoding, whose rows then span at most
     two cache blocks), causal/window/ALiBi/GQA masking, and the int8
-    mirror at n = 1 — and leaves the long tail (packed segments,
-    quantized verify-k, mirror-less int8, K splits that don't divide
-    ``t_max``, verify widths past the split) to the XLA formulation.
-    Paged caches are kernel-native (the page size IS the K split, so
-    ``n <= page_size``) minus the int8 mirror, which the pool doesn't
-    carry yet — and the page size must sit under the same VMEM cap the
-    slab split honors (an oversized page would double-buffer a K+V
-    stream past the budget; those caches take the XLA path)."""
+    mirror at n = 1 on BOTH layouts (the slab's ``k_q``/``k_scale``
+    buffers and the page pool's ``k_q_pool``/``k_scale_pool`` —
+    quantized decode rides the kernel at paged concurrency) — and
+    leaves the long tail (packed segments, quantized verify-k,
+    mirror-less int8, K splits that don't divide ``t_max``, verify
+    widths past the split) to the XLA formulation. Paged caches are
+    otherwise kernel-native (the page size IS the K split, so
+    ``n <= page_size``), with the page size capped by the same VMEM
+    budget the slab split honors (an oversized page would
+    double-buffer a K+V stream past it).
+
+    ``explain=True`` returns ``(eligible, reason)`` — ``reason`` is
+    ``None`` when eligible, else a string naming the exact gap (the
+    string ``impl='kernel'``'s ValueError and ``impl='auto'``'s
+    fallback decision rest on), so a silent XLA fallback is one probe
+    away from an explanation."""
     from distributed_dot_product_tpu.ops.pallas_decode import (
         _BLOCK_K_CAP,
         decode_block_k,
     )
-    if n < 1 or segment_ids is not None:
-        return False
+
+    def verdict(reason):
+        ok = reason is None
+        return (ok, reason) if explain else ok
+
+    if n < 1:
+        return verdict(f'needs at least one query row (n={n})')
+    if segment_ids is not None:
+        return verdict('packed segment_ids are masked by the XLA '
+                       'formulation only')
     if qk_quant == 'int8' and n != 1:
-        return False            # quantized verify-k: XLA path only
+        return verdict(f'quantized verify-k (n={n} > 1) is XLA-only — '
+                       'the kernel appends the int8 mirror '
+                       'single-token')
     if isinstance(cache, PagedDecodeCache):
-        return (qk_quant is None and cache.page_size <= _BLOCK_K_CAP
-                and n <= cache.page_size)
+        if qk_quant == 'int8' and cache.k_q_pool is None:
+            return verdict(
+                'this paged cache carries no int8 K mirror — allocate '
+                "the mirror pools with init_paged_cache("
+                "qk_quant='int8') so quantized decode can ride the "
+                'kernel on the page pool')
+        if cache.page_size > _BLOCK_K_CAP:
+            return verdict(
+                f'page_size {cache.page_size} exceeds the K-split '
+                f'VMEM cap {_BLOCK_K_CAP} — the page is the K split '
+                f'and an oversized page double-buffers past the '
+                f'budget')
+        if n > cache.page_size:
+            return verdict(
+                f'verify-k width {n} exceeds the page size '
+                f'{cache.page_size} — k rows must span at most two '
+                f'pages')
+        return verdict(None)
     if qk_quant == 'int8' and cache.k_q is None:
-        return False
+        return verdict('this slab cache carries no int8 K mirror — '
+                       "allocate it with init_cache(qk_quant='int8')")
     bk = decode_block_k(cache.t_max)
-    return bk is not None and n <= bk
+    if bk is None:
+        return verdict(f'no usable K split divides t_max='
+                       f'{cache.t_max} (serving caches are powers of '
+                       f'two)')
+    if n > bk:
+        return verdict(f'verify-k width {n} exceeds the K split {bk} '
+                       f'— k rows must span at most two blocks')
+    return verdict(None)
 
 
 def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
@@ -1068,16 +1262,14 @@ def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
     if impl not in ('kernel', 'xla'):
         raise ValueError(f"decode impl must be None/'auto'/'kernel'/"
                          f"'xla', got {impl!r}")
-    if impl == 'kernel' and not decode_kernel_eligible(
-            cache, n, segment_ids, qk_quant):
-        raise ValueError(
-            'decode_step: the fused kernel does not cover this call '
-            '(needs 1 <= n <= the K split — the slab block from '
-            'decode_block_k, or the paged page size — so verify-k rows '
-            'span at most two blocks; no segment_ids; an int8 mirror '
-            "AND n=1 when qk_quant='int8'; a t_max the K split "
-            'divides; and a paged page size within the K-split VMEM '
-            "cap) — use impl='auto' to fall back")
+    if impl == 'kernel':
+        ok, reason = decode_kernel_eligible(cache, n, segment_ids,
+                                            qk_quant, explain=True)
+        if not ok:
+            raise ValueError(
+                f'decode_step: the fused kernel does not cover this '
+                f"call — {reason} — use impl='auto' to fall back to "
+                f'the XLA formulation')
     return impl
 
 
@@ -1165,8 +1357,15 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
             # paged step matches it bit for bit (the contract the tests
             # pin). The gather is O(t_max) traffic, the same order as
             # the attention read itself; the kernel path avoids it.
+            # Quantized decode gathers the mirror pools the same way,
+            # so the int8 scoring streams the pool's append-time int8
+            # rows — identical to the slab mirror's.
             gk, gv = paged_gather(cache)
-            attend = DecodeCache(k=gk, v=gv, length=cache.length)
+            gkq = gks = None
+            if qk_quant == 'int8' and cache.k_q_pool is not None:
+                gkq, gks = paged_gather_mirror(cache)
+            attend = DecodeCache(k=gk, v=gv, length=cache.length,
+                                 k_q=gkq, k_scale=gks)
         if per_slot and counts is not None:
             # Verify-k masking base: query row j of slot i sits at
             # position before[i] + j whatever the slot's REAL count —
@@ -1245,15 +1444,32 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     if paged:
         # Same fused program, page-table-redirected DMA: the BlockSpec
         # index maps read the prefetched page-table row, aliasing still
-        # writes only the append page(s) (ops/pallas_decode.py).
-        out, new_k, new_v, _, _ = flash_decode(
+        # writes only the append page(s) (ops/pallas_decode.py). With
+        # qk_quant='int8' the mirror POOLS ride along: scoring streams
+        # the 1-byte mirror pages through the same redirect, and the
+        # append maintains them in place — quantized decode at paged
+        # concurrency (eligibility guarantees the pools exist here).
+        quant_kernel = qk_quant == 'int8'
+        out, new_k, new_v, new_kq, new_ks = flash_decode(
             q, k_new, v_new, cache.k_pool, cache.v_pool, vt, ap,
-            n_new=nn, page_table=cache.page_table, scale=scale,
+            n_new=nn, page_table=cache.page_table,
+            k_q=cache.k_q_pool if quant_kernel else None,
+            k_scale=cache.k_scale_pool if quant_kernel else None,
+            qk_quant=qk_quant, scale=scale,
             window=window, alibi_slopes=alibi_slopes,
             interpret=interpret)
+        if cache.k_q_pool is not None and new_kq is None:
+            # Non-int8 step on a mirror-carrying pool: keep the mirror
+            # exact by quantizing the appended rows the append-op way
+            # (rare path — mirrors exist for int8 decoding).
+            new_kq, new_ks = _paged_mirror_fixup(cache, k_new, ap, nn)
+        elif cache.k_q_pool is None:
+            new_kq = new_ks = None
         return PagedDecodeCache(k_pool=new_k, v_pool=new_v,
                                 page_table=cache.page_table,
-                                length=new_length), out
+                                length=new_length,
+                                k_q_pool=new_kq,
+                                k_scale_pool=new_ks), out
 
     res = flash_decode(
         q, k_new, v_new, cache.k, cache.v, vt, ap, n_new=nn,
@@ -1371,10 +1587,10 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k, o[0].v],
             expect_donation=True, donate_argnums=(1,), min_donated=2)
 
-    def _paged_args():
+    def _paged_args(qk_quant=None):
         b, h, d = 2, 2, 8
         cache = init_paged_cache(b, h, 32, d, pages=6, page_size=8,
-                                 dtype=jnp.bfloat16)
+                                 dtype=jnp.bfloat16, qk_quant=qk_quant)
         # A realistic mid-serve table: slot 0 holds two pages (fill 10),
         # slot 1 one page (fill 3); pool page 3 stays free.
         cache = cache._replace(
@@ -1409,6 +1625,26 @@ def graphlint_entrypoints():
             cache_in=lambda a: [a[1].k_pool, a[1].v_pool],
             cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
             expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    def step_paged_kernel_int8():
+        # The tentpole composition: quantized decode ON the page pool
+        # through the fused kernel — the mirror POOLS must alias in
+        # place alongside the bf16 pools (4 aliased pairs), and every
+        # int8 dot must request its i32 accumulator.
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        cache, new = _paged_args(qk_quant='int8')
+        return TraceSpec(
+            name='decode.step_paged_kernel_int8',
+            fn=partial(decode_step, impl='kernel', qk_quant='int8',
+                       interpret=True),
+            args=(new, cache, new, new),
+            cache_in=lambda a: [a[1].k_pool, a[1].v_pool,
+                                a[1].k_q_pool, a[1].k_scale_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool,
+                                 o[0].k_q_pool, o[0].k_scale_pool],
+            expect_donation=True, donate_argnums=(1,), min_donated=4)
 
     def step_verify_slab():
         from distributed_dot_product_tpu.analysis.registry import (
@@ -1451,6 +1687,7 @@ def graphlint_entrypoints():
         'decode.step_sharded': step_sharded,
         'decode.step_paged_xla': step_paged_xla,
         'decode.step_paged_kernel': step_paged_kernel,
+        'decode.step_paged_kernel_int8': step_paged_kernel_int8,
         'decode.step_verify_slab': step_verify_slab,
         'decode.step_verify_paged': step_verify_paged,
     }

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from distributed_dot_product_tpu.models.attention import (
     DistributedDotProductAttn,
 )
+from distributed_dot_product_tpu.models.dense import OwnedDense
 from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
 
 __all__ = ['TransformerBlock', 'TransformerStack']
@@ -58,20 +59,30 @@ class TransformerBlock(nn.Module):
     # to pick the mesh axis.
     axis_name: str = SEQ_AXIS
     dtype: Optional[jnp.dtype] = None
+    # 'int8': int8 weight quantization for the block's projection AND
+    # MLP matmuls (models/dense.py; quantize_dense_params converts a
+    # float checkpoint). Defaulted into attn_kwargs, so one knob
+    # quantizes the whole block.
+    weight_quant: Optional[str] = None
     attn_kwargs: Any = None
 
     def setup(self):
         kw = dict(self.attn_kwargs or {})
         kw.setdefault('dtype', self.dtype)
         kw.setdefault('axis_name', self.axis_name)
+        kw.setdefault('weight_quant', self.weight_quant)
         self.attn = DistributedDotProductAttn(
             key_dim=self.dim, num_heads=self.num_heads, **kw)
         self.ln1 = nn.LayerNorm(dtype=self.dtype, name='ln1')
         self.ln2 = nn.LayerNorm(dtype=self.dtype, name='ln2')
-        self.mlp_in = nn.Dense(self.mlp_ratio * self.dim,
-                               dtype=self.dtype, name='mlp_in')
-        self.mlp_out = nn.Dense(self.dim, dtype=self.dtype,
-                                name='mlp_out')
+        # OwnedDense (explicit fp32 accumulation + the int8 weight
+        # path) — see models/dense.py; param tree matches nn.Dense.
+        self.mlp_in = OwnedDense(self.mlp_ratio * self.dim,
+                                 dtype=self.dtype, name='mlp_in',
+                                 weight_quant=self.weight_quant)
+        self.mlp_out = OwnedDense(self.dim, dtype=self.dtype,
+                                  name='mlp_out',
+                                  weight_quant=self.weight_quant)
 
     def _mlp(self, h):
         return self.mlp_out(nn.gelu(self.mlp_in(h)))
@@ -114,13 +125,15 @@ class _ScanStackCore(nn.Module):
     mlp_ratio: int
     axis_name: str
     dtype: Any
+    weight_quant: Any
     attn_kwargs: Any
 
     def setup(self):
         self.block = TransformerBlock(
             dim=self.dim, num_heads=self.num_heads,
             mlp_ratio=self.mlp_ratio, axis_name=self.axis_name,
-            dtype=self.dtype, attn_kwargs=self.attn_kwargs, name='block')
+            dtype=self.dtype, weight_quant=self.weight_quant,
+            attn_kwargs=self.attn_kwargs, name='block')
 
     def layer(self, x, layer_idx, attn_mask, segment_ids, deterministic,
               dropout_seed):
@@ -168,6 +181,9 @@ class TransformerStack(nn.Module):
     mlp_ratio: int = 4
     axis_name: str = SEQ_AXIS
     dtype: Optional[jnp.dtype] = None
+    # One knob quantizes every block's projections + MLP (see
+    # TransformerBlock.weight_quant).
+    weight_quant: Optional[str] = None
     attn_kwargs: Any = None
     scan_layers: bool = False
     remat: bool = False
@@ -188,6 +204,7 @@ class TransformerStack(nn.Module):
                                  mlp_ratio=self.mlp_ratio,
                                  axis_name=self.axis_name,
                                  dtype=self.dtype,
+                                 weight_quant=self.weight_quant,
                                  attn_kwargs=self.attn_kwargs,
                                  name=f'block_{i}')
                 for i in range(self.n_layers)]
@@ -213,8 +230,8 @@ class TransformerStack(nn.Module):
                 'decode': dict(in_axes=0, out_axes=0, **common),
             })(dim=self.dim, num_heads=self.num_heads,
                mlp_ratio=self.mlp_ratio, axis_name=self.axis_name,
-               dtype=self.dtype, attn_kwargs=self.attn_kwargs,
-               name='layers')
+               dtype=self.dtype, weight_quant=self.weight_quant,
+               attn_kwargs=self.attn_kwargs, name='layers')
 
     def __call__(self, keys, queries, values, attn_mask=None,
                  segment_ids=None, deterministic=False,

@@ -351,8 +351,14 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     doesn't own). The K split IS
     the page size, the grid and kernel body are unchanged — paging
     costs one prefetched index lookup per block, not a new kernel —
-    and aliasing still writes only the single append page. The int8
-    mirror is not carried on the pool (XLA path covers paged int8).
+    and aliasing still writes only the single append page. With
+    ``qk_quant='int8'``, ``k_q``/``k_scale`` are the MIRROR POOLS
+    (``(pages + 1, H_kv, page_size, d) int8`` /
+    ``(pages + 1, H_kv, page_size, 1) f32``,
+    ``init_paged_cache(qk_quant='int8')``): scoring streams the int8
+    pages through the same page-table redirect — halved K traffic at
+    paged concurrency — and the mirror pages are appended in place
+    alongside the bf16 pool.
 
     Returns ``(out, cache_k, cache_v, k_q, k_scale)`` with
     ``out (B, H, k, dv)`` in ``cache_v.dtype`` — or, with
@@ -381,12 +387,11 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
             f"qk_quant='int8' is single-token in the fused kernel "
             f'(got {n} rows) — the XLA decode path covers quantized '
             f'verify-k')
-    if quantized and paged:
-        raise ValueError('the paged pool carries no int8 mirror — use '
-                         "the XLA decode path for qk_quant='int8'")
     if quantized and (k_q is None or k_scale is None):
-        raise ValueError("qk_quant='int8' needs the cache's k_q/k_scale "
-                         'mirror (init_cache(qk_quant=...))')
+        raise ValueError(
+            "qk_quant='int8' needs the cache's k_q/k_scale mirror — "
+            "init_cache(qk_quant='int8') for the slab buffers, "
+            "init_paged_cache(qk_quant='int8') for the mirror pools")
     if paged:
         n_pages, bk = cache_k.shape[0], cache_k.shape[2]
         ns = page_table.shape[1]            # logical pages per slot
@@ -508,6 +513,14 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
             blk = _write_blk(bi, ki, ap, nn)
             page = jnp.where(a >= 0, pt[br * ns + blk], sink)
             return (page * h_kv + bi % h_kv, 0, 0)
+
+        # Mirror-scale flat rows are (pages·H_kv, 1, page_size): one
+        # K-split block per pool page, so the block index is always 0
+        # and the ROW rides the same page-table redirect as the data
+        # pages — the data-pool maps ARE the scale maps (one
+        # definition, so a sink-redirect fix cannot miss its twin).
+        stream_idx_row = stream_idx
+        write_idx_row = write_idx
     else:
         def stream_idx(bi, ki, vt, ap, nn):
             return (bi, _stream_blk(bi, ki, vt), 0)
@@ -515,14 +528,15 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         def write_idx(bi, ki, vt, ap, nn):
             return (bi, _write_blk(bi, ki, ap, nn), 0)
 
-    # The int8 scale mirror rides as a (nb, 1, t_max) ROW vector (a
-    # size-1-axis reshape — a bitcast, not a transpose), blocked on the
-    # LAST axis, so the kernel consumes (1, BK) scale rows directly.
-    def stream_idx_row(bi, ki, vt, ap, nn):
-        return (bi, 0, _stream_blk(bi, ki, vt))
+        # The int8 scale mirror rides as a (nb, 1, t_max) ROW vector (a
+        # size-1-axis reshape — a bitcast, not a transpose), blocked on
+        # the LAST axis, so the kernel consumes (1, BK) scale rows
+        # directly.
+        def stream_idx_row(bi, ki, vt, ap, nn):
+            return (bi, 0, _stream_blk(bi, ki, vt))
 
-    def write_idx_row(bi, ki, vt, ap, nn):
-        return (bi, 0, _write_blk(bi, ki, ap, nn))
+        def write_idx_row(bi, ki, vt, ap, nn):
+            return (bi, 0, _write_blk(bi, ki, ap, nn))
 
     in_specs = [pl.BlockSpec((1, g_pad, d), const_idx)]
     args = [qf]
@@ -546,8 +560,16 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     args.append(kf)
     kq_in_pos = ks_in_pos = None
     if quantized:
-        kqf = k_q.reshape(nb, t_max, d)
-        ksf = k_scale.reshape(nb, 1, t_max)
+        if paged:
+            # Mirror POOLS flatten exactly like the data pools: pool
+            # page p's head hh at flat row p·H_kv + hh; the scale pool
+            # folds its size-1 last axis into a (…, 1, page_size) row
+            # vector per flat row (a bitcast, not a transpose).
+            kqf = k_q.reshape(n_pages * h_kv, bk, d)
+            ksf = k_scale.reshape(n_pages * h_kv, 1, bk)
+        else:
+            kqf = k_q.reshape(nb, t_max, d)
+            ksf = k_scale.reshape(nb, 1, t_max)
         in_specs += [pl.BlockSpec((1, bk, d), stream_idx),
                      pl.BlockSpec((1, 1, bk), stream_idx_row)]
         kq_in_pos = len(args)

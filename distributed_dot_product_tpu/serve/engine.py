@@ -76,6 +76,25 @@ def _resolve_decode_impl(decode_impl):
     return 'auto'
 
 
+def _resolve_weight_quant(weight_quant):
+    """Weight-precision selection: explicit argument wins ('off'/None =
+    float weights, 'int8' = per-output-channel int8 weights with
+    in-program s8×s8→s32 dequant — models/dense.quantize_kernel's rule);
+    else the ``DDP_TPU_WEIGHT_QUANT`` env knob — the deployment switch
+    the quantized-serving benchmark rows flip."""
+    if weight_quant is not None:
+        if weight_quant == 'off':
+            return None
+        if weight_quant not in ('int8',):
+            raise ValueError(f"weight_quant must be None/'off'/'int8', "
+                             f'got {weight_quant!r}')
+        return weight_quant
+    env = os.environ.get('DDP_TPU_WEIGHT_QUANT', '').strip().lower()
+    if env in ('1', 'true', 'int8'):
+        return 'int8'
+    return None
+
+
 def _resolve_cache_mode(cache_mode):
     """Cache-layout selection: explicit argument wins; else the
     ``DDP_TPU_PAGED_CACHE`` env knob (1/paged → page-pool cache); else
@@ -119,17 +138,29 @@ class KernelEngine:
     :meth:`register_prefix`/:meth:`start_with_prefix` give refcounted
     prefix sharing, :meth:`fork_slot` copy-on-write forks. Token
     streams are bit-identical to the slab engine per impl.
+
+    ``weight_quant='int8'`` (or ``DDP_TPU_WEIGHT_QUANT=int8``) stores
+    the four projection/head matrices int8 with per-output-channel
+    scales (``models/dense.quantize_kernel``); every projection and
+    the logits dot then quantize their activation rows on the fly and
+    run s8×s8→s32 with the dequantization applied to the s32 result —
+    half the weight bytes per step, deterministic streams (the
+    bit-identity guarantees hold per weight_quant setting, exactly as
+    they hold per decode impl), layout-oblivious (slab and paged
+    engines with the same seed + weight_quant emit identical
+    streams).
     """
 
     def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
                  prefill_chunk=8, seed=0, dtype=jnp.float32,
                  decode_impl=None, cache_mode=None, pages=None,
-                 page_size=None):
+                 page_size=None, weight_quant=None):
         if slots < 1 or t_max < 2:
             raise ValueError(f'need slots >= 1 and t_max >= 2, got '
                              f'{slots}/{t_max}')
         self.decode_impl = _resolve_decode_impl(decode_impl)
         self.cache_mode = _resolve_cache_mode(cache_mode)
+        self.weight_quant = _resolve_weight_quant(weight_quant)
         self.slots = slots
         self.t_max = t_max
         self.vocab = vocab
@@ -145,6 +176,18 @@ class KernelEngine:
         self._wk = jax.random.normal(ks[2], (dim, dim), dtype) * scale
         self._wv = jax.random.normal(ks[3], (dim, dim), dtype) * scale
         self._wo = jax.random.normal(ks[4], (dim, vocab), dtype) * scale
+        if self.weight_quant == 'int8':
+            # Load/convert-time quantization — the engine analog of
+            # models/dense.quantize_dense_params: weights stored int8
+            # (half/quarter the bytes), per-output-channel scales. The
+            # embedding stays float: it feeds a LOOKUP, not a matmul.
+            from distributed_dot_product_tpu.models.dense import (
+                quantize_kernel,
+            )
+            self._wq = quantize_kernel(self._wq)
+            self._wk = quantize_kernel(self._wk)
+            self._wv = quantize_kernel(self._wv)
+            self._wo = quantize_kernel(self._wo)
         if self.cache_mode == 'paged':
             ps = page_size or min(16, t_max)
             if t_max % ps:
@@ -215,14 +258,31 @@ class KernelEngine:
         self._transfers = {}
 
     # -- compiled bodies ------------------------------------------------
+    def _dot(self, x, w):
+        """``x (rows, in) · w`` — the one matmul body every engine
+        program routes through, so a precision change cannot miss a
+        call site. Float weights: a plain dot (the engine dtype is the
+        accumulation dtype — f32 by default). int8 weights (``w`` is
+        the ``(kernel_q, kernel_scale)`` pair): the SHARED
+        ``models/dense.quantized_dot`` body — one definition of the
+        s8×s8→s32 rule, so the engine's streams cannot drift from the
+        module path's."""
+        if self.weight_quant == 'int8':
+            from distributed_dot_product_tpu.models.dense import (
+                quantized_dot,
+            )
+            w_q, w_s = w
+            return quantized_dot(x, w_q, w_s).astype(self._embed.dtype)
+        return x @ w
+
     def _project(self, tokens):
         """tokens (S,) → q, k, v each (S, H, 1, D)."""
         s = tokens.shape[0]
         x = jnp.take(self._embed, tokens, axis=0)          # (S, dim)
         shape = (s, self.heads, 1, self.head_dim)
-        return ((x @ self._wq).reshape(shape),
-                (x @ self._wk).reshape(shape),
-                (x @ self._wv).reshape(shape))
+        return (self._dot(x, self._wq).reshape(shape),
+                self._dot(x, self._wk).reshape(shape),
+                self._dot(x, self._wv).reshape(shape))
 
     def _decode_impl(self, cache, tokens, active, poison):
         q, k, v = self._project(tokens)
@@ -231,7 +291,8 @@ class KernelEngine:
         # donation above, never copied).
         cache, out = decode_step(q, cache, k, v, slot_mask=active,
                                  impl=self.decode_impl)    # (S, H, 1, D)
-        logits = out.reshape(self.slots, -1) @ self._wo    # (S, vocab)
+        logits = self._dot(out.reshape(self.slots, -1),
+                           self._wo)                       # (S, vocab)
         logits = jnp.where(poison[:, None], jnp.nan, logits)
         finite = slots_all_finite(logits)
         # Fully-masked argmax input for a poisoned row would be NaN-
@@ -264,7 +325,7 @@ class KernelEngine:
         cache, out = decode_step(q, cache, k, v, slot_mask=active,
                                  counts=counts, impl=self.decode_impl)
         logits = jnp.stack(
-            [out[:, :, j].reshape(self.slots, -1) @ self._wo
+            [self._dot(out[:, :, j].reshape(self.slots, -1), self._wo)
              for j in range(w)], axis=1)           # (S, W, vocab)
         logits = jnp.where(poison[:, None, None], jnp.nan, logits)
         finite = slots_all_finite(logits)
@@ -280,9 +341,9 @@ class KernelEngine:
         or shared-prefix pages would attend with different K/V)."""
         x = jnp.take(self._embed, tokens, axis=0)          # (C, dim)
         c = tokens.shape[0]
-        k = jnp.moveaxis((x @ self._wk).reshape(
+        k = jnp.moveaxis(self._dot(x, self._wk).reshape(
             c, self.heads, self.head_dim), 0, 1)           # (H, C, D)
-        v = jnp.moveaxis((x @ self._wv).reshape(
+        v = jnp.moveaxis(self._dot(x, self._wv).reshape(
             c, self.heads, self.head_dim), 0, 1)
         return k, v
 
@@ -739,6 +800,19 @@ class KernelEngine:
         return True
 
     @property
+    def weight_bytes(self):
+        """Bytes of the four projection/head matrices a decode step
+        streams (int8 engines count the int8 kernels + their scales) —
+        the weights column of the quantized-vs-float benchmark twins.
+        The embedding is excluded: a step gathers S rows of it, not
+        the table."""
+        from distributed_dot_product_tpu.models.dense import (
+            dense_param_bytes,
+        )
+        return dense_param_bytes(
+            [self._wq, self._wk, self._wv, self._wo])
+
+    @property
     def free_pages(self):
         return self.pool.free_pages if self.pool is not None else None
 
@@ -821,5 +895,27 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
             expect_donation=True, min_donated=2)
 
+    def engine_decode_wq8():
+        # The int8-WEIGHT serving program: same decode step, weights
+        # stored int8 — the s8×s8→s32 projection dots must request
+        # their i32 accumulator and the cache contracts must survive
+        # the precision change.
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        eng = KernelEngine(slots=2, t_max=16, decode_impl='xla',
+                           weight_quant='int8')
+        tokens = jnp.zeros((2,), jnp.int32)
+        active = jnp.ones((2,), bool)
+        poison = jnp.zeros((2,), bool)
+        return TraceSpec(
+            name='serve.engine_decode_wq8', fn=eng._decode,
+            args=(eng.cache, tokens, active, poison),
+            prejitted=True,
+            cache_in=lambda a: [a[0].k, a[0].v],
+            cache_out=lambda o: [o[0].k, o[0].v],
+            expect_donation=True, min_donated=2)
+
     return {'serve.engine_decode': engine_decode,
-            'serve.engine_decode_paged': engine_decode_paged}
+            'serve.engine_decode_paged': engine_decode_paged,
+            'serve.engine_decode_wq8': engine_decode_wq8}

@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/8] ruff (generic hygiene) ==='
+echo '=== [1/9] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,17 +27,18 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/8] graphlint + servelint (jaxpr/domain/serving contracts) ==='
+echo '=== [2/9] graphlint + servelint (jaxpr/domain/serving contracts) ==='
 # Full pass: jaxpr rules over every registered entrypoint (incl. the
-# bf16 serving-dtype twins, whose flax-Dense f32-accum debt renders as
-# allowed records) + the AST families (host-pull/traced-bool/clock/
+# bf16 serving-dtype and int8-weight twins — the owned dense retired
+# the flax-Dense f32-accum waivers, so zero allowed records remain)
+# + the AST families (host-pull/traced-bool/clock/
 # silent-except) + servelint (protolint event-schema call sites,
 # conclint guarded-by/thread discipline, determlint tick-path
 # determinism). Fast pre-commit twin:
 #   python -m distributed_dot_product_tpu.analysis --changed-only origin/main
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/8] tier-1 tests ==='
+echo '=== [3/9] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -45,7 +46,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/8] smoke serve + event-log schema validation ==='
+echo '=== [4/9] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -55,7 +56,7 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/8] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+echo '=== [5/9] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
 # Speculative decoding's exactness guarantee, proven on a real burst
 # through the ENV knob a deployment would flip: the same traffic served
 # with the n-gram proposer (verify-k steps) and without (plain n=1
@@ -113,7 +114,7 @@ print(f'spec smoke OK: {len(base)} streams bit-identical, '
 PY
 fi
 
-echo '=== [6/8] serve-load smoke + SLO goodput gate ==='
+echo '=== [6/9] serve-load smoke + SLO goodput gate ==='
 # A seeded open-loop trace (virtual clock — minutes of simulated
 # traffic in seconds of wall time, CPU-deterministic) drives the
 # scheduler, then the goodput report computed FROM THE EVENT LOG ALONE
@@ -138,7 +139,7 @@ else
     rm -f "$slo_log" "$slo_row"
 fi
 
-echo '=== [7/8] disaggregated-serving smoke (router + 2 decode pools) ==='
+echo '=== [7/9] disaggregated-serving smoke (router + 2 decode pools) ==='
 # The 1-router/2-pool cocktail on the CPU mesh: the seeded trace through
 # the disaggregated topology AND its single-process twin, member logs
 # schema-validated (--require router.route / prefill.handoff), goodput
@@ -150,7 +151,7 @@ else
     scripts/smoke_router.sh || rc=1
 fi
 
-echo '=== [8/8] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [8/9] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for
@@ -166,6 +167,43 @@ else
       && JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.obs.perf \
           check --against PERF_BASELINE.json --current "$perf_now"; } || rc=1
     rm -f "$perf_now"
+fi
+
+echo '=== [9/9] weight-quant decode smoke (kv+weight bytes below the bf16 twin) ==='
+# The low-precision acceptance row: the SAME decode shape at bf16 and
+# at int8 weights + int8 K mirror — the quantized row must move fewer
+# kv+weight bytes per step AND be kernel-eligible on the paged pool
+# (decode_kernel_eligible(paged, qk_quant='int8') == True, i.e. the
+# mirror pools ride the fused kernel at paged concurrency).
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping weight-quant smoke stage'
+else
+    wq_rows="$(mktemp /tmp/ddp_wq_rows.XXXXXX.json)"
+    rm -f "$wq_rows"    # benchmark.py appends into a fresh JSON file
+    { JAX_PLATFORMS=cpu python benchmark.py --mode decode \
+          --seq-len 512 --heads 2 --head-dim 8 --iters 2 --no-ttft \
+          --dtype bf16 --file "$wq_rows" \
+      && JAX_PLATFORMS=cpu python benchmark.py --mode decode \
+          --seq-len 512 --heads 2 --head-dim 8 --iters 2 --no-ttft \
+          --dtype bf16 --weight-quant int8 --qk-quant int8 \
+          --file "$wq_rows" \
+      && python - "$wq_rows" <<'PY'; } || rc=1
+import json
+import sys
+
+rows = json.load(open(sys.argv[1]))
+bf16, wq8 = rows[-2], rows[-1]
+assert wq8['weight_quant'] == 'int8' and bf16['weight_quant'] is None
+assert wq8['step_bytes'] < bf16['step_bytes'], (
+    f"quantized row moves {wq8['step_bytes']} kv+weight bytes/step vs "
+    f"the bf16 twin's {bf16['step_bytes']} — the byte win is gone")
+assert wq8['paged_int8_kernel_eligible'] is True, (
+    'paged+int8 lost fused-kernel eligibility — quantized serving and '
+    '4x concurrency no longer compose')
+print(f"weight-quant smoke OK: {wq8['step_bytes']} vs "
+      f"{bf16['step_bytes']} bytes/step, paged int8 kernel-eligible")
+PY
+    rm -f "$wq_rows"
 fi
 
 exit $rc

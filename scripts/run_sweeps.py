@@ -207,6 +207,18 @@ SWEEPS = [
       '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
       '--kv-heads', '2', '--qk-quant', 'int8',
       '--decode-impl', 'kernel']),
+    # --- round-9 (ISSUE 14): end-to-end low precision — int8 WEIGHTS
+    # (+ the int8 K mirror) vs the bf16 twin rows above, both decode
+    # paths. Acceptance: the wq8 row beats its bf16 twin on kv+weight
+    # bytes AND time (rows record weight_bytes/step_bytes next to
+    # ms_per_step, so the comparison reads straight off the pairs);
+    # every row also records paged_int8_kernel_eligible. ---
+    *[(f'decode_benchmark_128k_chain_kv2_wq8_{impl}',
+       ['--mode', 'decode', '--dtype', 'bf16', '--seq-len', '131072',
+        '--heads', '8', '--head-dim', '96', '--decode-chain', '32',
+        '--kv-heads', '2', '--qk-quant', 'int8',
+        '--weight-quant', 'int8', '--decode-impl', impl])
+      for impl in ('xla', 'kernel')],
     # --- round-6: scheduler-vs-bare on both decode paths ---
     *[(f'decode_serve_{impl}',
        ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
@@ -221,6 +233,19 @@ SWEEPS = [
         '--serve-requests', '64', '--decode-impl', impl,
         '--cache-mode', 'paged', '--page-size', '256'])
       for impl in ('xla', 'kernel')],
+    # --- round-9 (ISSUE 14): quantized-WEIGHT serving twins of the
+    # slab/paged decode-serve rows — same shapes, engine weights int8
+    # (DDP_TPU_WEIGHT_QUANT's programmatic twin); rows record
+    # weight_bytes so the served-bytes win reads off the pairs. ---
+    *[(f'decode_serve{suffix}_wq8_{impl}',
+       ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
+        '--serve-requests', str(req), '--decode-impl', impl,
+        '--weight-quant', 'int8'] + extra)
+      for impl in ('xla', 'kernel')
+      for suffix, req, extra in (
+          ('', 32, []),
+          ('_paged', 64, ['--cache-mode', 'paged',
+                          '--page-size', '256']))],
     # --- round-8: speculative decoding B=1 twins — each row times a
     # non-spec scheduler burst AND the proposer-driven verify-k burst
     # on the same engine/prompts (baseline_tokens_per_s rides the

@@ -59,34 +59,23 @@ def _negatives_module():
 def test_clean_tree_gate(devices):
     """THE gate: zero ACTIVE violations across the package AST scan
     (astlint + the servelint families) and every registered
-    entrypoint's jaxpr. A contract break anywhere in ops/, models/,
-    serve/, obs/ or train.py fails here before it ships. Waived debt
-    (TraceSpec.allow — the flax Dense bf16-accum entries) is reported
-    ``allowed`` and must stay that way: it never fails the gate, but
-    it must also remain VISIBLE (asserted non-empty below, so the
-    waiver cannot silently swallow everything)."""
+    entrypoint's jaxpr — AND zero WAIVED ``f32-accum`` records. The
+    owned dense (models/dense.py) retired the flax ``linen.Dense``
+    bf16-accumulation debt the bf16 serving-dtype twins used to waive
+    (14 allowed records across three entries); asserting the waiver
+    set EMPTY is what keeps the debt from silently returning — a new
+    ``TraceSpec.allow=('f32-accum',)`` anywhere fails here and must be
+    argued in review, not slipped in as an "allowed" record."""
     from distributed_dot_product_tpu.analysis import active_violations
     violations = run_analysis()
     active = active_violations(violations)
     assert active == [], '\n'.join(v.render() for v in active)
     waived = [v for v in violations if v.allowed]
-    assert waived, ('expected the registered bf16 flax-Dense debt to '
-                    'render as allowed records — if the debt is paid, '
-                    'drop the TraceSpec.allow entries and this assert')
-    assert {v.rule for v in waived} == {'f32-accum'}
-    # The waiver is entry-wide, so pin the per-entry site COUNTS: a
-    # new bf16-accumulating dot in OUR kernels/decode path would ride
-    # the same entries as a fresh "allowed" record and exit 0 — this
-    # census turns silent debt growth into a reviewed gate failure
-    # (shrinkage too: a paid-down site updates the numbers here).
-    census = {}
-    for v in waived:
-        census[v.entrypoint] = census.get(v.entrypoint, 0) + 1
-    assert census == {
-        'attention.fwd_flash_bf16': 4,      # 3 in-proj + 1 out-proj
-        'decode.seq_parallel_step_bf16': 4,  # same Dense quartet
-        'lm.loss_bf16': 6,                   # attn quartet + 2 MLP
-    }, census
+    assert waived == [], (
+        'the zero-waiver contract broke — the owned-dense refactor '
+        'retired every f32-accum waiver, and new waived debt needs a '
+        'reviewed decision, not an allow= entry:\n'
+        + '\n'.join(v.render() for v in waived))
 
 
 def test_registry_covers_every_layer(devices):
@@ -108,6 +97,10 @@ def test_registry_covers_every_layer(devices):
         # bf16 so the cache/donation contracts gate the deployed dtype.
         'attention.fwd_flash_bf16', 'decode.seq_parallel_step_bf16',
         'lm.loss_bf16',
+        # low-precision end-to-end (PR 14): the int8-WEIGHT serving
+        # programs and the quantized decode step on the page pool.
+        'attention.fwd_flash_wq8', 'serve.engine_decode_wq8',
+        'decode.step_paged_kernel_int8',
     }
     assert expected <= names, f'missing: {expected - names}'
 

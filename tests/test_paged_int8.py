@@ -1,0 +1,246 @@
+# -*- coding: utf-8 -*-
+"""
+Paged int8 K mirror — quantized decode on the page pool (ISSUE 14c).
+
+The slab cache has carried an append-time int8 K mirror since the
+s8-decode fix; this file pins the mirror ON THE POOL:
+
+- **Mirror parity with the slab**: after identical appends, the
+  gathered mirror pools are bit-identical to the slab cache's
+  ``k_q``/``k_scale`` (same per-row rule, same append-once contract).
+- **Kernel-vs-XLA parity**: the fused kernel's paged int8 step matches
+  the gathered-slab XLA formulation to kernel rounding (exp2 vs exp),
+  and matches the SLAB int8 kernel bit for bit — the page-table
+  redirect changes addressing, never math.
+- **Eligibility is explained**: ``decode_kernel_eligible`` accepts
+  paged+int8 with the mirror and names the exact gap otherwise
+  (the former silent ``impl='auto'`` XLA fallback).
+- **Lifecycle ops keep the mirror exact**: rollback and reset zero
+  mirror rows/pages alongside the bf16 pools; a non-int8 kernel step
+  on a mirror-carrying pool still maintains it; cross-cache page
+  transfer rebuilds mirror rows bit-identically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.models.decode import (
+    append_kv_slots, decode_kernel_eligible, decode_step,
+    init_paged_cache, init_slot_cache, paged_append_kv_slots,
+    paged_gather_mirror, paged_reset_slot, paged_rollback_slots,
+    paged_transfer_pages,
+)
+
+B, H, D, PS, T = 2, 2, 8, 8, 32
+
+
+def _paged(qk_quant='int8', pages=8):
+    c = init_paged_cache(B, H, T, D, pages=pages, page_size=PS,
+                         dtype=jnp.bfloat16, qk_quant=qk_quant)
+    return c._replace(
+        page_table=jnp.array([[0, 1, 4, -1], [2, 3, -1, -1]], jnp.int32))
+
+
+def _slab_with_mirror():
+    base = init_slot_cache(B, H, T, D, dtype=jnp.bfloat16)
+    return base._replace(
+        k_q=jnp.zeros((B, H, T, D), jnp.int8),
+        k_scale=jnp.zeros((B, H, T, 1), jnp.float32))
+
+
+def _rows(key, n):
+    return jax.random.normal(jax.random.key(key), (B, H, n, D),
+                             jnp.bfloat16)
+
+
+def test_init_allocates_mirror_pools():
+    c = _paged()
+    assert c.k_q_pool.dtype == jnp.int8
+    assert c.k_q_pool.shape == c.k_pool.shape
+    assert c.k_scale_pool.shape == c.k_pool.shape[:-1] + (1,)
+    assert init_paged_cache(B, H, T, D, pages=4,
+                            page_size=PS).k_q_pool is None
+    with pytest.raises(ValueError, match='qk_quant'):
+        init_paged_cache(B, H, T, D, pages=4, page_size=PS,
+                         qk_quant='int4')
+
+
+def test_append_mirror_bit_identical_to_slab():
+    slab, paged = _slab_with_mirror(), _paged()
+    k, v = _rows(1, 5), _rows(2, 5)
+    counts = jnp.array([5, 3], jnp.int32)
+    slab = append_kv_slots(slab, k, v, counts=counts)
+    paged = paged_append_kv_slots(paged, k, v, counts=counts)
+    gq, gs = paged_gather_mirror(paged)
+    np.testing.assert_array_equal(np.asarray(gq), np.asarray(slab.k_q))
+    np.testing.assert_array_equal(np.asarray(gs),
+                                  np.asarray(slab.k_scale))
+
+
+def test_gather_mirror_requires_mirror():
+    with pytest.raises(ValueError, match='mirror'):
+        paged_gather_mirror(_paged(qk_quant=None))
+
+
+# -- eligibility --------------------------------------------------------
+
+def test_paged_int8_kernel_eligible_with_mirror():
+    assert decode_kernel_eligible(_paged(), qk_quant='int8') is True
+
+
+def test_eligibility_reasons_name_the_gap():
+    ok, reason = decode_kernel_eligible(_paged(qk_quant=None),
+                                        qk_quant='int8', explain=True)
+    assert not ok and 'mirror' in reason and 'init_paged_cache' in reason
+    ok, reason = decode_kernel_eligible(_paged(), n=2, qk_quant='int8',
+                                        explain=True)
+    assert not ok and 'verify-k' in reason
+    ok, reason = decode_kernel_eligible(_paged(), segment_ids=object(),
+                                        explain=True)
+    assert not ok and 'segment' in reason
+    ok, reason = decode_kernel_eligible(_paged(), explain=True)
+    assert ok and reason is None
+
+
+def test_forced_kernel_raises_with_reason():
+    c = _paged(qk_quant=None)
+    q = _rows(3, 1)
+    with pytest.raises(ValueError, match='mirror'):
+        decode_step(q, c, q, q, qk_quant='int8', impl='kernel',
+                    interpret=True)
+
+
+# -- decode parity ------------------------------------------------------
+
+def _filled(which):
+    k, v = _rows(1, 5), _rows(2, 5)
+    counts = jnp.array([5, 3], jnp.int32)
+    if which == 'slab':
+        return append_kv_slots(_slab_with_mirror(), k, v, counts=counts)
+    return paged_append_kv_slots(_paged(), k, v, counts=counts)
+
+
+def test_paged_int8_kernel_matches_slab_kernel():
+    """The page-table redirect changes ADDRESSING only: the paged int8
+    kernel step scores the same quantized rows as the slab int8 kernel
+    — outputs agree to K-split rounding (the slab splits at
+    ``decode_block_k(t_max)``, the pool at the page size, so the
+    online-softmax accumulation ORDER differs; the quantized scores
+    themselves are integer-exact)."""
+    q, kn, vn = _rows(3, 1), _rows(4, 1), _rows(5, 1)
+    _, out_s = decode_step(q, _filled('slab'), kn, vn, qk_quant='int8',
+                           impl='kernel', interpret=True)
+    _, out_p = decode_step(q, _filled('paged'), kn, vn, qk_quant='int8',
+                           impl='kernel', interpret=True)
+    np.testing.assert_allclose(np.asarray(out_s, np.float32),
+                               np.asarray(out_p, np.float32),
+                               atol=1e-2, rtol=1e-2)
+
+
+def test_paged_int8_kernel_vs_xla_parity():
+    """Kernel vs the gathered-slab XLA formulation: same quantized
+    scoring, exp2-vs-exp softmax rounding only — and the mirror the
+    kernel maintains in place equals the one the XLA append writes."""
+    q, kn, vn = _rows(3, 1), _rows(4, 1), _rows(5, 1)
+    ck, out_k = decode_step(q, _filled('paged'), kn, vn,
+                            qk_quant='int8', impl='kernel',
+                            interpret=True)
+    cx, out_x = decode_step(q, _filled('paged'), kn, vn,
+                            qk_quant='int8', impl='xla')
+    np.testing.assert_allclose(
+        np.asarray(out_k, np.float32), np.asarray(out_x, np.float32),
+        atol=2e-2, rtol=2e-2)
+    for a, b in zip(paged_gather_mirror(ck), paged_gather_mirror(cx)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(ck.length),
+                                  np.asarray(cx.length))
+
+
+def test_chained_paged_int8_kernel_tracks_slab():
+    """A chained quantized decode (the serving loop shape): every step
+    of the paged kernel run matches the slab kernel run to K-split
+    rounding, and the MIRRORS stay in bit-exact lockstep (append-time
+    quantization is split-order independent)."""
+    slab, paged = _filled('slab'), _filled('paged')
+    for i in range(4):
+        q, kn, vn = _rows(10 + i, 1), _rows(20 + i, 1), _rows(30 + i, 1)
+        slab, out_s = decode_step(q, slab, kn, vn, qk_quant='int8',
+                                  impl='kernel', interpret=True)
+        paged, out_p = decode_step(q, paged, kn, vn, qk_quant='int8',
+                                   impl='kernel', interpret=True)
+        np.testing.assert_allclose(np.asarray(out_s, np.float32),
+                                   np.asarray(out_p, np.float32),
+                                   atol=1e-2, rtol=1e-2)
+    gq, gs = paged_gather_mirror(paged)
+    np.testing.assert_array_equal(np.asarray(gq), np.asarray(slab.k_q))
+    np.testing.assert_array_equal(np.asarray(gs),
+                                  np.asarray(slab.k_scale))
+
+
+def test_non_int8_kernel_step_maintains_mirror():
+    """A bf16 decode step on a mirror-carrying pool (kernel path) must
+    leave the mirror exactly as the append ops would — the post-hoc
+    fixup contract."""
+    q, kn, vn = _rows(3, 1), _rows(4, 1), _rows(5, 1)
+    ck, _ = decode_step(q, _filled('paged'), kn, vn, impl='kernel',
+                        interpret=True)
+    cx, _ = decode_step(q, _filled('paged'), kn, vn, impl='xla')
+    for a, b in zip(paged_gather_mirror(ck), paged_gather_mirror(cx)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- lifecycle ----------------------------------------------------------
+
+def test_rollback_zeroes_mirror_rows():
+    paged = _filled('paged')
+    rolled = paged_rollback_slots(paged, jnp.array([3, 1], jnp.int32),
+                                  span=4)
+    gq, gs = paged_gather_mirror(rolled)
+    assert not np.asarray(gq)[0, :, 3:, :].any()
+    assert not np.asarray(gs)[0, :, 3:, :].any()
+    assert not np.asarray(gq)[1, :, 1:, :].any()
+    # Kept prefix rows untouched.
+    oq, os_ = paged_gather_mirror(paged)
+    np.testing.assert_array_equal(np.asarray(gq)[0, :, :3],
+                                  np.asarray(oq)[0, :, :3])
+
+
+def test_reset_zeroes_freed_mirror_pages():
+    paged = _filled('paged')
+    freed = jnp.array([0, 1, 4, -1], jnp.int32)   # slot 0's pages
+    cleared = paged_reset_slot(paged, 0, freed)
+    assert not np.asarray(cleared.k_q_pool)[np.asarray(freed[:3])].any()
+    assert not np.asarray(
+        cleared.k_scale_pool)[np.asarray(freed[:3])].any()
+    # Slot 1's pages keep their mirror bits.
+    np.testing.assert_array_equal(np.asarray(cleared.k_q_pool)[2],
+                                  np.asarray(paged.k_q_pool)[2])
+
+
+def test_transfer_rebuilds_mirror_rows():
+    """Adopting pages from an UNQUANTIZED source pool rebuilds the
+    destination mirror from the copied K bits — bit-identical to the
+    append-time rule on every filled row."""
+    src = init_paged_cache(B, H, T, D, pages=8, page_size=PS,
+                           dtype=jnp.bfloat16)
+    src = src._replace(
+        page_table=jnp.array([[0, 1, -1, -1], [2, -1, -1, -1]],
+                             jnp.int32))
+    k, v = _rows(1, PS), _rows(2, PS)
+    src = paged_append_kv_slots(src, k, v)
+    dst = _paged()
+    dst = paged_transfer_pages(dst, src.k_pool, src.v_pool,
+                               jnp.array([0, 2], jnp.int32),
+                               jnp.array([5, 6], jnp.int32))
+    # The reference mirror: append the same rows into a quantized pool.
+    ref = _paged()
+    ref = paged_append_kv_slots(ref, k, v)
+    np.testing.assert_array_equal(
+        np.asarray(dst.k_q_pool)[5], np.asarray(ref.k_q_pool)[0])
+    np.testing.assert_array_equal(
+        np.asarray(dst.k_q_pool)[6], np.asarray(ref.k_q_pool)[2])
+    np.testing.assert_array_equal(
+        np.asarray(dst.k_scale_pool)[5],
+        np.asarray(ref.k_scale_pool)[0])

@@ -12,8 +12,9 @@ Mirrors tests/test_graphlint.py's structure:
   serve/): each seeded regression line carries a ``# VIOLATION: <rule>``
   marker, so the assertions cannot drift from the files.
 - **CLI**: exit 1 over the fixture set with every family represented;
-  ``--changed-only`` mechanics; the bf16 registry debt rendered as
-  ``allowed`` records that do not fail the run.
+  ``--changed-only`` mechanics; the f32-accum rule over the full
+  registry reporting ZERO records (the former flax-Dense waived debt
+  is paid — the owned dense accumulates in f32 at every dtype).
 """
 
 import json
@@ -182,27 +183,26 @@ def test_changed_files_mechanics():
         changed_files('definitely-not-a-ref')
 
 
-# -- bf16 registry debt: visible, allowed, non-failing ------------------
+# -- f32-accum: zero debt, waived or active -----------------------------
 
 @pytest.mark.slow
-def test_bf16_debt_renders_allowed_in_json(devices):
-    """The flax Dense bf16-accum debt (ROADMAP item 3a) is VISIBLE as
-    allowed records in json output and never fails the CLI."""
+def test_f32_accum_json_reports_zero_records(devices):
+    """The flax Dense bf16-accum debt (ROADMAP item 3a) is PAID — the
+    owned dense accumulates in f32 at every dtype, so the f32-accum
+    rule over the full registry (bf16 and int8-weight twins included)
+    reports NOTHING, allowed or active, and the CLI exits 0."""
     res = _cli('--no-ast', '--format', 'json', '--rule', 'f32-accum')
     assert res.returncode == 0, res.stdout + res.stderr
     records = json.loads(res.stdout)
-    allowed = [r for r in records if r['allowed']]
-    assert {r['entrypoint'] for r in allowed} >= {
-        'attention.fwd_flash_bf16', 'decode.seq_parallel_step_bf16',
-        'lm.loss_bf16'}
-    assert all(r['rule'] == 'f32-accum' for r in allowed)
-    assert not [r for r in records if not r['allowed']]
+    assert records == [], records
 
 
 def test_bf16_variants_trace_clean_inline(devices):
-    """In-process twin of the slow CLI check: the three serving-dtype
-    entries trace, and every violation they report is the waived
-    f32-accum debt."""
+    """In-process twin of the slow CLI check: the serving-dtype
+    entries trace CLEAN — the owned dense (models/dense.py) retired
+    the flax-Dense f32-accum debt these entries used to waive, so
+    they report zero violations (waived or otherwise); the int8-weight
+    twin rides along to pin the s8×s8→s32 path."""
     from distributed_dot_product_tpu.analysis.jaxpr_rules import (
         lint_entrypoints,
     )
@@ -211,9 +211,7 @@ def test_bf16_variants_trace_clean_inline(devices):
     )
     entries = default_entrypoints()
     subset = {name: entries[name] for name in
-              ('attention.fwd_flash_bf16', 'lm.loss_bf16')}
+              ('attention.fwd_flash_bf16', 'lm.loss_bf16',
+               'attention.fwd_flash_wq8')}
     vs = lint_entrypoints(subset)
-    assert vs, 'the bf16 debt disappeared — flax owns its dots now? ' \
-               'drop the allow list and celebrate'
-    assert all(v.allowed and v.rule == 'f32-accum' for v in vs), \
-        '\n'.join(v.render() for v in vs)
+    assert vs == [], '\n'.join(v.render() for v in vs)
