@@ -21,6 +21,7 @@ neither knob (reference functions.py:103) and records them as null.
 """
 
 import argparse
+import dataclasses
 import json
 import os
 
@@ -175,10 +176,29 @@ def parse_args():
     parser.add_argument('--load-tenants', type=int, default=2,
                         help='serve-load mode: tenant count (stock '
                              'interactive/batchy mix)')
-    parser.add_argument('--arrival', choices=['poisson', 'bursty'],
+    parser.add_argument('--arrival',
+                        choices=['poisson', 'bursty', 'ramp', 'step'],
                         default='poisson',
                         help='serve-load mode: arrival process (bursty '
-                             '= ON/OFF modulated Poisson)')
+                             '= ON/OFF modulated Poisson; ramp/step '
+                             'climb the rate toward rate*ramp-factor '
+                             'across the trace — the deterministic '
+                             'autoscaling exercisers)')
+    parser.add_argument('--ramp-factor', type=float, default=4.0,
+                        help='serve-load mode, --arrival ramp/step: '
+                             'peak rate multiple')
+    parser.add_argument('--control', action='store_true',
+                        help='serve-load mode: arm the closed-loop '
+                             'controller (serve/control.py) on the '
+                             "run's virtual clock — watchdog-driven "
+                             'watermark/queue actuation, and with '
+                             '--topology elastic decode autoscaling '
+                             '(scale-up to --control-max-replicas); '
+                             'every action lands in the event log as '
+                             'a control.* record')
+    parser.add_argument('--control-max-replicas', type=int, default=3,
+                        help='--control + --topology: autoscaling '
+                             'ceiling for the decode pool')
     parser.add_argument('--load-tick', type=float, default=0.002,
                         help='serve-load mode: virtual seconds one '
                              'scheduler tick costs (the simulated '
@@ -1248,16 +1268,21 @@ def run_serve_load_topology(args):
     log_dir = args.event_log or tempfile.mkdtemp(
         prefix='ddp_serve_topo_')
     os.makedirs(log_dir, exist_ok=True)
-    member_names = (['router']
-                    + (['prefill'] if prefill_pools else [])
-                    + [f'r{i}' for i in range(decode_replicas)])
-    for name in member_names + ['twin']:
-        # Fresh logs per run: EventLog APPENDS (resuming seq), and a
-        # stale previous run would double every merged timeline.
+    # Fresh logs per run: EventLog APPENDS (resuming seq), and a stale
+    # previous run would double every merged timeline. Decode-member
+    # logs sweep by GLOB: autoscaling (--control) names replicas with
+    # a never-reused sequence, so a scale-down/up cycle can leave
+    # rN.jsonl files past any configured ceiling.
+    import glob
+    for name in ['router'] + (['prefill'] if prefill_pools else []) \
+            + ['twin']:
         obs.remove_log(os.path.join(log_dir, f'{name}.jsonl'))
+    for stale in glob.glob(os.path.join(log_dir, 'r[0-9]*.jsonl')):
+        obs.remove_log(stale)
     cfg = LoadGenConfig(
         seed=args.load_seed, rate=args.load_rate,
         requests=args.load_requests, arrival=args.arrival,
+        ramp_factor=args.ramp_factor,
         tenants=default_tenants(args.load_tenants), vocab=64,
         tick_seconds=args.load_tick)
     trace_path = os.path.join(log_dir, 'trace.json')
@@ -1266,6 +1291,11 @@ def run_serve_load_topology(args):
         queue_limit=args.queue_limit,
         max_new_tokens=max(t.new_hi for t in cfg.tenants),
         watchdog=False, spec=args.spec, spec_k=args.spec_k)
+    # The twin must run the STATIC config: the controller actuates
+    # knobs by mutating the schedulers' (shared) ServeConfig, so a
+    # controlled run would otherwise leak its final tightened
+    # watermark into the twin built afterwards.
+    twin_cfg = dataclasses.replace(serve_cfg)
     topo = TopologyConfig(
         prefill_pools=prefill_pools, decode_replicas=decode_replicas,
         slots=slots, t_max=t_max, page_size=args.page_size, vocab=64,
@@ -1277,11 +1307,24 @@ def run_serve_load_topology(args):
         router_config=RouterConfig(
             prefill_threshold=args.prefill_threshold),
         clock=clock, log_dir=log_dir)
+    controller = None
+    if args.control:
+        from distributed_dot_product_tpu.serve import (
+            ControlConfig, Controller,
+        )
+        controller = Controller(
+            router=router,
+            config=ControlConfig(
+                interval=0.01, scale_up_after=1, scale_down_after=20,
+                max_replicas=args.control_max_replicas),
+            clock=clock, event_log=router.event_log)
     try:
         with span('benchmark.serve_load_topology', seed=args.load_seed,
                   topology=args.topology):
             res = run_trace(router, load_trace(trace_path), clock,
-                            tick_seconds=cfg.tick_seconds)
+                            tick_seconds=cfg.tick_seconds,
+                            on_tick=(controller.tick if controller
+                                     else None))
     finally:
         # Member logs must close (flushing their tails) even when the
         # run under them crashes — those logs ARE the debugging record.
@@ -1316,7 +1359,7 @@ def run_serve_load_topology(args):
         head_dim=args.head_dim, prefill_chunk=8, seed=0,
         decode_impl=decode_impl, cache_mode='paged',
         page_size=args.page_size)
-    twin = Scheduler(twin_engine, serve_cfg, clock=clock_twin,
+    twin = Scheduler(twin_engine, twin_cfg, clock=clock_twin,
                      event_log=twin_log, fault_injector=False,
                      registry=MetricsRegistry())
     try:
@@ -1365,13 +1408,22 @@ def run_serve_load_topology(args):
         'ticks': res.ticks,
         'trace': trace_path,
         'event_logs': dict(sources),
+        'control': bool(args.control),
+        'control_actions': (list(controller.actions)
+                            if controller else []),
+        'replicas_final': len(router.pool.replicas),
     }
-    print(f"serve-load[topology {args.topology}] seed={args.load_seed} "
+    print(f"serve-load[topology {args.topology}"
+          f"{'+control' if args.control else ''}] "
+          f"seed={args.load_seed} "
           f"{cfg.arrival}@{cfg.rate:.0f}/s x{report.requests}: "
           f"goodput {report.goodput_pct:.1f}% vs single-process twin "
           f"{report_twin.goodput_pct:.1f}% "
           f"(routed {routed}, {record['handoffs']} handoffs, "
-          f"{record['prefix_hits']} prefix hits)")
+          f"{record['prefix_hits']} prefix hits"
+          + (f", {len(record['control_actions'])} control actions, "
+             f"{record['replicas_final']} replicas final"
+             if args.control else '') + ')')
     print(obs_slo.render_report(report))
     print(f'event logs: {log_dir}')
     _append_record(args.file, record)
@@ -1420,12 +1472,17 @@ def run_serve_load(args):
     cfg = LoadGenConfig(
         seed=args.load_seed, rate=args.load_rate,
         requests=args.load_requests, arrival=args.arrival,
+        ramp_factor=args.ramp_factor,
         tenants=default_tenants(args.load_tenants), vocab=64,
         tick_seconds=args.load_tick)
     serve_cfg = ServeConfig(
         queue_limit=args.queue_limit,
         max_new_tokens=max(t.new_hi for t in cfg.tenants),
         watchdog=False, spec=args.spec, spec_k=args.spec_k)
+    control_cfg = None
+    if args.control:
+        from distributed_dot_product_tpu.serve import ControlConfig
+        control_cfg = ControlConfig(interval=0.01)
     log_path = args.event_log or os.path.join(
         tempfile.gettempdir(), f'ddp_serve_load_{os.getpid()}.jsonl')
     # A fresh log per run: EventLog APPENDS (resuming seq), so a stale
@@ -1445,7 +1502,7 @@ def run_serve_load(args):
         with span('benchmark.serve_load', seed=args.load_seed):
             res = run_load(cfg, engine=engine, serve_config=serve_cfg,
                            registry=registry, event_log=event_log,
-                           clock=clock)
+                           clock=clock, control=control_cfg)
     finally:
         devmon.stop()
     devmon.poll_once()      # end-of-run device state
@@ -1490,6 +1547,7 @@ def run_serve_load(args):
         'cache_mode': args.cache_mode, 'spec': args.spec,
         'decode_impl': args.decode_impl,
         'queue_limit': serve_cfg.queue_limit,
+        'control': bool(args.control),
         'tick_seconds': cfg.tick_seconds,
         'platform': jax.devices()[0].platform,
         'device_kind': jax.devices()[0].device_kind,

@@ -222,22 +222,31 @@ class AnomalyWatchdog:
 
     def __init__(self, registry=None, watches: Sequence[Watch] = (),
                  *, profiler=None, event_log=None, min_interval=0.25,
-                 profile_seconds=2.0):
+                 profile_seconds=2.0, clock=None):
         self.registry = registry or tracing.get_registry()
         self.watches = list(watches)
         self.profiler = profiler
         self.event_log = event_log
         self.min_interval = float(min_interval)
         self.profile_seconds = float(profile_seconds)
+        # ``clock`` times the tick throttle, the per-watch breach
+        # cooldowns and the rate differentiation. Default REAL time
+        # (the live-serving contract this module documents); the
+        # closed-loop controller (serve/control.py) injects the
+        # scheduler's virtual clock so a seeded load run's breach
+        # sequence — and therefore its control history — replays
+        # bit-identically.
+        self.clock = clock or time.monotonic
         self._last_tick = None
         self.breaches = []      # [(watch name, verdict dict)]
         self._c_breach = self.registry.counter('anomaly.breaches')
 
     def tick(self, force=False):
         """Evaluate every watch once, throttled to ``min_interval``
-        REAL seconds unless ``force``. Returns the breaches fired this
-        evaluation as ``[(watch, verdict), ...]``."""
-        now = time.monotonic()
+        seconds on the watchdog's clock (REAL by default) unless
+        ``force``. Returns the breaches fired this evaluation as
+        ``[(watch, verdict), ...]``."""
+        now = self.clock()
         if not force and self._last_tick is not None \
                 and now - self._last_tick < self.min_interval:
             return []

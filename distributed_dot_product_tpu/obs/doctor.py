@@ -156,7 +156,11 @@ def diagnose(bundle) -> Incident:
         vote('nan_storm', 4.0, 'bundle dumped by the NaN-storm trigger')
 
     # -- cache-exhaustion evidence --------------------------------------
-    preempts = _count(events, 'serve.preempt')
+    # Drain preempts (serve.preempt with drain=true) are elastic
+    # membership changes, not a dry pool — they must not vote here.
+    preempts = sum(1 for r in events
+                   if r.get('event') == 'serve.preempt'
+                   and not r.get('drain'))
     if preempts:
         vote('cache_exhaustion', 2.0 * preempts,
              f'{preempts} page-pool preemption(s)')
@@ -202,6 +206,36 @@ def diagnose(bundle) -> Incident:
     if degraded:
         vote('overload', min(0.5 * degraded, 2.0),
              f'readiness DEGRADED under pressure {degraded}x')
+
+    # -- control-plane arcs (serve/control.py) --------------------------
+    # The controller's own record of the incident: tightening and
+    # scale-ups are overload evidence (the loop SAW more traffic than
+    # capacity and acted); page-driven tightening points at the pool.
+    adjusts = [r for r in events if r.get('event') == 'control.adjust']
+    tightened = [r for r in adjusts
+                 if str(r.get('reason', '')).startswith(
+                     ('breach', 'pressure'))]
+    if tightened:
+        vote('overload', min(0.5 * len(tightened), 2.0),
+             f'controller tightened admission {len(tightened)}x')
+    # 'breach:pages_free' and 'pressure:page_pool:<v>' both point at
+    # the paged KV pool as the tightening driver.
+    pages_driven = [r for r in tightened
+                    if 'page' in str(r.get('reason', ''))]
+    if pages_driven:
+        vote('cache_exhaustion', min(0.5 * len(pages_driven), 2.0),
+             f'controller tightened on page-pool signals '
+             f'{len(pages_driven)}x')
+    ups = _count(events, 'control.scale', direction='up')
+    if ups:
+        vote('overload', min(1.0 * ups, 4.0),
+             f'controller scaled decode replicas up {ups}x')
+    drains = _count(events, 'control.drain')
+    scale_downs = _count(events, 'control.scale', direction='down')
+    if adjusts or ups or drains or scale_downs:
+        notes.append(f'control plane acted in this window: '
+                     f'{len(adjusts)} knob adjust(s), {ups} scale-up(s), '
+                     f'{scale_downs} scale-down(s), {drains} drain(s)')
 
     # -- anomaly verdicts ride along as supporting context --------------
     anomalies = [r for r in events if r.get('event') == 'anomaly.detected']

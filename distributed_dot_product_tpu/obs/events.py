@@ -76,7 +76,14 @@ EVENT_SCHEMA = {
     'serve.quarantine': ('request_id', 'slot', 'requeued'),
     # Paged pool ran dry under this slot mid-stream: slot freed, request
     # requeued (True) or terminally evicted CACHE_EXHAUSTED (False).
+    # A controller drain (serve/control.py) emits the same arc with an
+    # extra `drain: true` — the request requeues onto ANOTHER replica.
     'serve.preempt': ('request_id', 'slot', 'requeued'),
+    # The degradation rung engaged: the request was admitted with a
+    # CAPPED token budget because pressure crossed `watermark`
+    # (`reason` names the source: queue / page_pool). State-exempt in
+    # the timeline automaton — it precedes the admit/reject verdict.
+    'serve.degrade': ('request_id', 'watermark', 'reason', 'tenant'),
     # -- disaggregated serving (serve/router.py, serve/replica.py) -----
     # The router placed a request on a decode replica: `target` names
     # it, `policy` how it was chosen (prefix / session / load). Lives
@@ -129,6 +136,24 @@ EVENT_SCHEMA = {
     # the cause (stall / exception / nan_storm / anomaly / sigterm /
     # http / manual), `path` the bundle directory.
     'postmortem.dump': ('trigger', 'path'),
+    # -- control plane (serve/control.py) ------------------------------
+    # The controller moved a scheduler knob: `knob` names it
+    # (degrade_watermark / queue_limit), `value` the new setting,
+    # `reason` why (breach:<watch> / pressure:<source>:<val> with
+    # source queue|page_pool / sustained_headroom). Extra fields:
+    # `previous` (the old value),
+    # `target` (the replica, in pool mode) — a run's control history
+    # reconstructs from these records alone.
+    'control.adjust': ('knob', 'value', 'reason'),
+    # The controller resized the decode pool: `direction` up/down,
+    # `replicas` the NEW pool size, `reason` the signal. A scale-down
+    # is always preceded by a control.drain of the victim.
+    'control.scale': ('direction', 'replicas', 'reason'),
+    # A decode replica was drained for removal: every in-flight and
+    # queued request preempted (serve.preempt, requeued=true, in the
+    # TARGET replica's log) and resubmitted through the router —
+    # `requeued` counts them; no stream drops without a typed reason.
+    'control.drain': ('target', 'requeued'),
     # -- SLO observatory (obs/slo.py) ----------------------------------
     # `slo check` found goodput below the committed SLO_BASELINE.json
     # tolerance (`metric` names the gate; `tenant` is present on

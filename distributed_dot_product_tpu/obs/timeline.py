@@ -78,6 +78,9 @@ class Timeline:
     # lifecycle legitimately spans up to three logs.
     routes: int = 0
     handoffs: int = 0
+    # Degradation-ladder engagements (serve.degrade — the rung used to
+    # fire silently): admissions of this request with a capped budget.
+    degrades: int = 0
 
     def phases(self):
         """Compact ``{phase: seconds}`` view for printing."""
@@ -126,6 +129,13 @@ def _validate(tl: Timeline):
             continue
         if ev == 'prefill.handoff':
             tl.handoffs += 1
+            continue
+        if ev == 'serve.degrade':
+            # The degrade rung fires at SUBMIT, before the admit (or
+            # queue-full reject) verdict, and a drained-and-requeued
+            # request may degrade again on resubmission after its
+            # terminal would have been legal — state-exempt, counted.
+            tl.degrades += 1
             continue
         if state == 'done':
             tl.errors.append(f'event {ev} after terminal state')

@@ -26,12 +26,23 @@ Composition (each piece standalone-testable):
   pool, each replica a Scheduler+KernelEngine with its own log/metrics.
 - :mod:`~distributed_dot_product_tpu.serve.router` — the front end:
   admission (typed NO_REPLICA), prefix-cache-aware and session-affine
-  placement, prefill→decode handoff orchestration.
+  placement, prefill→decode handoff orchestration, elastic pool
+  membership (add/drain replicas without dropping a stream).
+- :mod:`~distributed_dot_product_tpu.serve.policy` — the scheduling
+  policy layer: priority classes, per-tenant weighted fair share,
+  deadline-aware eviction, TTFT-tuned prefill/decode interleaving.
+- :mod:`~distributed_dot_product_tpu.serve.control` — the closed-loop
+  controller: watchdog-driven admission-watermark actuation and
+  elastic decode autoscaling with drain-by-preempt+requeue, every
+  action a closed-vocabulary ``control.*`` event.
 """
 
 from distributed_dot_product_tpu.serve.admission import (  # noqa: F401
     AdmissionController, RejectReason, RejectedError, Request,
     RequestResult,
+)
+from distributed_dot_product_tpu.serve.control import (  # noqa: F401
+    ControlConfig, Controller,
 )
 from distributed_dot_product_tpu.serve.engine import (  # noqa: F401
     KernelEngine,
@@ -43,6 +54,9 @@ from distributed_dot_product_tpu.serve.loadgen import (  # noqa: F401
     Arrival, LoadGenConfig, LoadResult, TenantSpec, VirtualClock,
     default_tenants, generate_trace, load_trace, run_load, run_trace,
     save_trace,
+)
+from distributed_dot_product_tpu.serve.policy import (  # noqa: F401
+    PolicyConfig, SchedulingPolicy, TenantPolicy,
 )
 from distributed_dot_product_tpu.serve.replica import (  # noqa: F401
     DecodeReplica, PrefillPool, ReplicaPool, TopologyConfig,
@@ -64,4 +78,5 @@ __all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
            'DecodeReplica', 'PrefillPool', 'ReplicaPool',
            'TopologyConfig', 'maybe_init_distributed',
            'parse_topology', 'Router', 'RouterConfig',
-           'build_serving']
+           'build_serving', 'PolicyConfig', 'TenantPolicy',
+           'SchedulingPolicy', 'ControlConfig', 'Controller']

@@ -185,6 +185,22 @@ class AdmissionController:
         """Queue fullness in [0, 1] — the degradation ladder's input."""
         return len(self._queue) / self.queue_limit
 
+    def queued_by_tenant(self):
+        """``{tenant: queued count}`` over the live queue — the
+        policy-relevant placement signal ``Scheduler.load()`` exposes
+        (fair-share routing and the controller's per-tenant view)."""
+        out: dict = {}
+        for req in self._queue:
+            out[req.tenant] = out.get(req.tenant, 0) + 1
+        return out
+
+    def oldest_deadline(self):
+        """Earliest absolute deadline among queued requests, or None
+        when nothing queued carries one — how urgent the backlog is."""
+        deadlines = [req.deadline for req in self._queue
+                     if req.deadline is not None]
+        return min(deadlines) if deadlines else None
+
     def _update_depth(self):
         if self._g_depth is not None:
             self._g_depth.set(len(self._queue))
@@ -286,12 +302,17 @@ class AdmissionController:
             self._c_admit.inc()
         self._count_tenant('serve.admitted', tenant)
 
-    def maybe_degrade(self, request: Request, pressure=None):
+    def maybe_degrade(self, request: Request, pressure=None,
+                      reason=None):
         """Above the pressure watermark, cap the request's token budget
         instead of rejecting it — rung one of the degradation ladder.
         ``pressure`` overrides the queue-depth default (the scheduler
         passes max(queue, page-pool) pressure on paged engines, so page
-        exhaustion degrades before it evicts before it rejects)."""
+        exhaustion degrades before it evicts before it rejects).
+        ``reason`` names the pressure source (``queue`` /
+        ``page_pool``) on the ``serve.degrade`` event — the rung used
+        to engage SILENTLY; now every degraded admission is a
+        closed-vocabulary record the timeline and doctor can see."""
         pressure = self.pressure if pressure is None else pressure
         if pressure >= self.degrade_watermark \
                 and request.max_new_tokens > self.degraded_max_new_tokens:
@@ -299,6 +320,10 @@ class AdmissionController:
             request.degraded = True
             if self._c_degraded is not None:
                 self._c_degraded.inc()
+            self._emit('serve.degrade', request_id=request.id,
+                       watermark=self.degrade_watermark,
+                       reason=reason or 'queue', pressure=pressure,
+                       tenant=request.tenant)
 
     def push(self, request: Request):
         """Enqueue an ADMITTED request; caller has already resolved the
@@ -323,24 +348,50 @@ class AdmissionController:
         self._queue.appendleft(request)
         self._update_depth()
 
-    def pop_ready(self, now=None) -> Tuple[Optional[Request],
-                                           List[Request]]:
+    def pop_ready(self, now=None, chooser=None) -> Tuple[
+            Optional[Request], List[Request]]:
         """Next serviceable request plus any that expired while queued
         (the caller finalizes those as typed DEADLINE_EXCEEDED
-        rejections — queue death is never silent)."""
+        rejections — queue death is never silent). ``chooser`` is the
+        policy hook (serve/policy.py): called with the FULL list of
+        live queued requests, it returns the index to admit — the
+        whole queue is deadline-swept first, so a policy pick never
+        skips past (and thereby hides) an expired request. Without a
+        chooser, FIFO semantics are byte-identical to before: only
+        the head's expired prefix is swept."""
         now = self.clock() if now is None else now
         expired = []
-        while self._queue:
-            req = self._queue.popleft()
+        if chooser is None:
+            while self._queue:
+                req = self._queue.popleft()
+                if req.cancelled:
+                    expired.append(req)   # caller records 'abandoned'
+                    continue
+                if req.deadline is not None and req.deadline <= now:
+                    if RejectReason.DEADLINE_EXCEEDED in self._c_reject:
+                        self._c_reject[
+                            RejectReason.DEADLINE_EXCEEDED].inc()
+                    expired.append(req)
+                    continue
+                self._update_depth()
+                return req, expired
+            self._update_depth()
+            return None, expired
+        live = []
+        for req in self._queue:
             if req.cancelled:
-                expired.append(req)   # caller records 'abandoned'
-                continue
-            if req.deadline is not None and req.deadline <= now:
+                expired.append(req)
+            elif req.deadline is not None and req.deadline <= now:
                 if RejectReason.DEADLINE_EXCEEDED in self._c_reject:
                     self._c_reject[RejectReason.DEADLINE_EXCEEDED].inc()
                 expired.append(req)
-                continue
+            else:
+                live.append(req)
+        if not live:
+            self._queue.clear()
             self._update_depth()
-            return req, expired
+            return None, expired
+        picked = live.pop(chooser(live))
+        self._queue = collections.deque(live)
         self._update_depth()
-        return None, expired
+        return picked, expired

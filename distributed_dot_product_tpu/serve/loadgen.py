@@ -113,14 +113,22 @@ class LoadGenConfig:
     exponential inter-arrivals, ``'bursty'`` modulates them with a
     two-state ON/OFF process (ON bursts at ``rate * burst_factor``,
     exponential dwells sized so the AVERAGE offered rate stays
-    ``rate``). ``tick_seconds`` is the virtual duration of one
-    scheduler tick — the simulated cost of the compiled decode step."""
+    ``rate``). ``'ramp'`` climbs the instantaneous rate linearly from
+    ``rate`` to ``rate * ramp_factor`` across the trace; ``'step'``
+    jumps it from ``rate`` to ``rate * ramp_factor`` at the
+    ``step_at`` fraction of the requests — the two deterministic
+    shapes that exercise elastic scale-up/scale-down
+    (serve/control.py). ``tick_seconds`` is the virtual duration of
+    one scheduler tick — the simulated cost of the compiled decode
+    step."""
     seed: int = 0
     rate: float = 200.0
     requests: int = 64
-    arrival: str = 'poisson'        # 'poisson' | 'bursty'
+    arrival: str = 'poisson'   # 'poisson' | 'bursty' | 'ramp' | 'step'
     burst_factor: float = 4.0
     burst_dwell_s: float = 0.25     # mean ON-state dwell
+    ramp_factor: float = 4.0        # peak rate multiple (ramp/step)
+    step_at: float = 0.5            # 'step': jump after this fraction
     tenants: List[TenantSpec] = dataclasses.field(
         default_factory=default_tenants)
     vocab: int = 64
@@ -130,15 +138,21 @@ class LoadGenConfig:
         if self.rate <= 0 or self.requests < 1:
             raise ValueError(f'need rate > 0 and requests >= 1, got '
                              f'{self.rate}/{self.requests}')
-        if self.arrival not in ('poisson', 'bursty'):
-            raise ValueError(f"arrival must be 'poisson' or 'bursty', "
-                             f'got {self.arrival!r}')
+        if self.arrival not in ('poisson', 'bursty', 'ramp', 'step'):
+            raise ValueError(f"arrival must be 'poisson', 'bursty', "
+                             f"'ramp' or 'step', got {self.arrival!r}")
         if self.arrival == 'bursty' and not self.burst_factor > 1.0:
             # The OFF dwell is sized from (burst_factor - 1); <= 1
             # would ask for a negative exponential scale deep inside
             # the generator — reject it here, typed.
             raise ValueError(f'bursty arrivals need burst_factor > 1, '
                              f'got {self.burst_factor}')
+        if self.arrival in ('ramp', 'step') and not self.ramp_factor > 0:
+            raise ValueError(f'{self.arrival} arrivals need '
+                             f'ramp_factor > 0, got {self.ramp_factor}')
+        if self.arrival == 'step' and not 0.0 <= self.step_at <= 1.0:
+            raise ValueError(f'step_at must sit in [0, 1], got '
+                             f'{self.step_at}')
         if not self.tenants:
             raise ValueError('need at least one TenantSpec')
 
@@ -187,6 +201,20 @@ def generate_trace(cfg: LoadGenConfig) -> List[Arrival]:
     for i in range(cfg.requests):
         if cfg.arrival == 'poisson':
             t += rng.exponential(1.0 / cfg.rate)
+        elif cfg.arrival in ('ramp', 'step'):
+            # Deterministic rate SHAPE over the request index: 'ramp'
+            # climbs linearly to rate*ramp_factor at the last arrival,
+            # 'step' jumps there after the step_at fraction. Each gap
+            # is exponential at the instantaneous rate — a seeded
+            # inhomogeneous-Poisson stand-in that round-trips through
+            # save_trace/load_trace unchanged (only times serialize).
+            if cfg.arrival == 'ramp':
+                frac = i / max(1, cfg.requests - 1)
+                r = cfg.rate * (1.0 + (cfg.ramp_factor - 1.0) * frac)
+            else:
+                r = (cfg.rate if i < cfg.requests * cfg.step_at
+                     else cfg.rate * cfg.ramp_factor)
+            t += rng.exponential(1.0 / r)
         else:
             # `gap` is ON-time until the next arrival (arrivals only
             # happen in the ON state, at rate*factor); OFF dwells are
@@ -298,13 +326,17 @@ class LoadResult:
 
 def run_trace(scheduler: Scheduler, trace: List[Arrival],
               clock: VirtualClock,
-              tick_seconds: float = 0.002) -> LoadResult:
+              tick_seconds: float = 0.002,
+              on_tick=None) -> LoadResult:
     """Drive ``scheduler`` (constructed on ``clock``) through
     ``trace`` open-loop: each tick submits every arrival whose time
     has come, runs ONE scheduler step, and advances virtual time by
     ``tick_seconds``; an idle scheduler jumps straight to the next
     arrival. Returns when the trace is exhausted and the scheduler
-    has drained."""
+    has drained. ``on_tick()`` (no arguments) runs after every step —
+    how a :class:`~distributed_dot_product_tpu.serve.control
+    .Controller` rides a router-driven run (a plain Scheduler's own
+    ``on_tick`` hook covers the single-scheduler case)."""
     if tick_seconds <= 0:
         raise ValueError(f'tick_seconds must be > 0, got {tick_seconds}')
     t0 = time.perf_counter()
@@ -331,6 +363,8 @@ def run_trace(scheduler: Scheduler, trace: List[Arrival],
                 rejected[a.request_id] = e.reason
         busy = scheduler.step()
         ticks += 1
+        if on_tick is not None:
+            on_tick()
         clock.advance(tick_seconds)
         if not busy and i < len(trace) and trace[i].at > clock():
             # Idle gap: jump to the next arrival instead of spinning
@@ -349,7 +383,7 @@ def run_trace(scheduler: Scheduler, trace: List[Arrival],
 
 def run_load(cfg: LoadGenConfig, *, engine, serve_config=None,
              registry=None, event_log=None, fault_injector=False,
-             clock=None) -> LoadResult:
+             clock=None, policy=None, control=None) -> LoadResult:
     """One-call surface: generate the trace for ``cfg``, build a
     virtual-clock :class:`Scheduler` over ``engine`` (watchdog off —
     real-time heartbeats are meaningless in virtual time), run it, and
@@ -358,7 +392,16 @@ def run_load(cfg: LoadGenConfig, *, engine, serve_config=None,
     EventLog built with ``clock=VirtualClock`` or let this function
     re-point it). ``fault_injector=False`` = explicitly unfaulted
     (the default trace is a LOAD experiment, not a fault one); pass an
-    injector to combine both."""
+    injector to combine both.
+
+    Closed-loop extras: ``policy`` (a :class:`~distributed_dot_product
+    _tpu.serve.policy.PolicyConfig`) arms fair-share/priority
+    admission and deadline-aware eviction; ``control`` (a
+    :class:`~distributed_dot_product_tpu.serve.control.ControlConfig`)
+    builds a :class:`~distributed_dot_product_tpu.serve.control
+    .Controller` on the run's virtual clock — its stock anomaly
+    watchdog and every knob change then replay bit-identically with
+    the seed."""
     cfg.validate()
     clock = clock or VirtualClock()
     if event_log is not None:
@@ -368,12 +411,20 @@ def run_load(cfg: LoadGenConfig, *, engine, serve_config=None,
     serve_config = serve_config or ServeConfig(
         queue_limit=16, max_new_tokens=max(t.new_hi
                                            for t in cfg.tenants))
-    if serve_config.watchdog:
-        serve_config = dataclasses.replace(serve_config, watchdog=False)
+    if serve_config.watchdog or (policy is not None
+                                 and serve_config.policy is None):
+        serve_config = dataclasses.replace(
+            serve_config, watchdog=False,
+            policy=serve_config.policy or policy)
     trace = generate_trace(cfg)
     sched = Scheduler(engine, serve_config, clock=clock,
                       registry=registry, event_log=event_log,
                       fault_injector=fault_injector)
+    if control is not None:
+        from distributed_dot_product_tpu.serve.control import Controller
+        controller = Controller(scheduler=sched, config=control,
+                                clock=clock, event_log=event_log)
+        sched.on_tick = lambda _s: controller.tick()
     try:
         return run_trace(sched, trace, clock,
                          tick_seconds=cfg.tick_seconds)

@@ -298,20 +298,58 @@ class ReplicaPool:
                 heads=topo.heads, head_dim=topo.head_dim,
                 seed=topo.seed, prefill_chunk=topo.prefill_chunk,
                 mesh=mesh, event_log=self.open_log('prefill'))
+        self._fault_injector = fault_injector
         self.replicas = []
-        for i in range(topo.decode_replicas):
-            name = f'r{i}'
-            engine = KernelEngine(
-                slots=topo.slots, t_max=topo.t_max, vocab=topo.vocab,
-                heads=topo.heads, head_dim=topo.head_dim,
-                prefill_chunk=topo.prefill_chunk, seed=topo.seed,
-                decode_impl=topo.decode_impl, cache_mode='paged',
-                page_size=topo.page_size, pages=topo.pages)
-            self.replicas.append(DecodeReplica(
-                name, engine, self.serve_config, clock=clock,
-                event_log=self.open_log(name),
-                fault_injector=fault_injector))
+        self.retired = []       # drained-and-removed members (results
+        #   and logs stay readable — their streams are history, not
+        #   garbage)
+        self._replica_seq = 0   # names never reuse: r0, r1, r2, ...
+        for _ in range(topo.decode_replicas):
+            self.add_replica()
         self._closed = False
+
+    def add_replica(self) -> DecodeReplica:
+        """Grow the decode pool by one member (elastic scale-up —
+        serve/control.py): a fresh paged engine + scheduler + event
+        log under the next never-reused name. Safe mid-run: programs
+        compile lazily on the new member's first dispatch, and the
+        shared clock/seed make its streams identical to any sibling's
+        for the same prompts."""
+        topo = self.topology
+        name = f'r{self._replica_seq}'
+        self._replica_seq += 1
+        engine = KernelEngine(
+            slots=topo.slots, t_max=topo.t_max, vocab=topo.vocab,
+            heads=topo.heads, head_dim=topo.head_dim,
+            prefill_chunk=topo.prefill_chunk, seed=topo.seed,
+            decode_impl=topo.decode_impl, cache_mode='paged',
+            page_size=topo.page_size, pages=topo.pages)
+        replica = DecodeReplica(
+            name, engine, self.serve_config, clock=self.clock,
+            event_log=self.open_log(name),
+            fault_injector=self._fault_injector)
+        self.replicas.append(replica)
+        return replica
+
+    def remove_replica(self, name):
+        """Drain one member and retire it from the pool (elastic
+        scale-down): every in-flight/queued request preempts out via
+        :meth:`~distributed_dot_product_tpu.serve.scheduler.Scheduler
+        .drain` and is RETURNED for the caller (the router) to
+        resubmit elsewhere — nothing is dropped without a typed
+        reason. The member's event log stays in :meth:`logs` and its
+        finalized results stay readable under :attr:`retired`."""
+        replica = next((r for r in self.replicas if r.name == name),
+                       None)
+        if replica is None:
+            raise KeyError(f'no replica named {name!r} in the pool')
+        if len(self.replicas) <= 1:
+            raise ValueError('cannot remove the last decode replica')
+        drained = replica.scheduler.drain()
+        replica.close()
+        self.replicas.remove(replica)
+        self.retired.append(replica)
+        return drained
 
     def open_log(self, name):
         """One member's event log under ``log_dir`` (None without one)

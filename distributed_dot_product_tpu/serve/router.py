@@ -98,8 +98,11 @@ class Router:
         self._by_name = {r.name: r for r in pool.replicas}
         self._sessions = {}
         # prefix key (tuple of prefix tokens) -> (replica, pid, rows);
-        # ordered by last hit for the per-replica LRU cap.
+        # ordered by last hit for the per-replica LRU cap. The reverse
+        # map (replica, pid) -> key lets a drain re-expand a stripped
+        # prompt back to its full token stream before resubmission.
         self._prefix_map = collections.OrderedDict()
+        self._pid_tokens = {}
         self._rids = itertools.count()
         reg = self.registry
         self._c_hits = reg.counter('router.prefix_hits')
@@ -133,6 +136,7 @@ class Router:
     # -- the cluster prefix cache ---------------------------------------
     def _cache_prefix(self, key, replica, pid, rows):
         self._prefix_map[key] = (replica.name, pid, rows)
+        self._pid_tokens[(replica.name, pid)] = key
         self._prefix_map.move_to_end(key)
         held = [k for k, (name, _, _) in self._prefix_map.items()
                 if name == replica.name]
@@ -155,6 +159,7 @@ class Router:
                              > pin_budget):
             victim = held.pop(0)
             _, old_pid, _ = self._prefix_map.pop(victim)
+            self._pid_tokens.pop((replica.name, old_pid), None)
             replica.engine.unregister_prefix(old_pid)
             self._c_unregistered.inc()
 
@@ -284,8 +289,11 @@ class Router:
 
     @property
     def results(self):
+        # Retired (drained) members' finalized results stay part of
+        # the run's record — a request that expired in a queue that
+        # was later drained terminated THERE.
         out = {}
-        for r in self.pool.replicas:
+        for r in self.pool.retired + self.pool.replicas:
             out.update(r.results)
         return out
 
@@ -302,8 +310,99 @@ class Router:
 
     def loads(self):
         """Per-replica placement signals, by name — the router's own
-        introspection surface (and the test hook)."""
+        introspection surface (and the test hook). Each entry carries
+        the scheduler's full probe: depth/slots/``accepting`` plus the
+        policy-relevant ``queued_by_tenant`` and ``oldest_deadline``
+        fields the controller sheds/places on."""
         return {r.name: r.load() for r in self.pool.replicas}
+
+    # -- elastic membership (serve/control.py drives these) -------------
+    def add_replica(self):
+        """Grow the decode pool by one member and enter it into the
+        placement ladder (it starts empty, so least-loaded routes the
+        next arrivals there)."""
+        replica = self.pool.add_replica()
+        self._by_name[replica.name] = replica
+        self.registry.gauge('router.replicas').set(
+            len(self.pool.replicas))
+        return replica
+
+    def drain_replica(self, name):
+        """Drain and retire one decode replica: every in-flight/queued
+        request preempts out (``serve.preempt`` ``requeued=true
+        drain=true`` in the member's log) and REQUEUES onto the
+        least-loaded remaining replica — via the admission queue's
+        front-push, which bypasses the bound the way every requeue of
+        ALREADY-ADMITTED work does (capacity may delay drained
+        streams, never drop them). Prompts that rode a registered
+        prefix are re-expanded to their full token stream first (the
+        stripped suffix alone would decode garbage). The member's
+        cluster prefix-cache entries and session pins are dropped; its
+        event log and finalized results stay readable. Each placement
+        leaves a ``router.route`` record (``policy='drain'``), so the
+        migration reconstructs from the logs alone. Returns the number
+        of requests requeued — every drained one, except a rider
+        whose registered prefix was LRU-evicted while it sat queued:
+        that one finalizes on the draining member with the typed
+        PREFIX_UNREGISTERED reason (never a stripped-prompt
+        resubmission)."""
+        if name not in self._by_name:
+            raise KeyError(f'no replica named {name!r}')
+        if len(self.pool.replicas) <= 1:
+            raise ValueError('cannot drain the last decode replica')
+        # Re-expansion table BEFORE the pool drops the member (the
+        # reverse map is exactly this lookup): the drained requests
+        # reference prefix ids registered there.
+        tokens_by_pid = {}
+        for (rname, pid), key in list(self._pid_tokens.items()):
+            if rname == name:
+                tokens_by_pid[pid] = key
+                del self._pid_tokens[(rname, pid)]
+                self._prefix_map.pop(key, None)
+        # Drain through the MEMBER first (its log and results are
+        # still open) so a request whose prefix vanished — LRU-evicted
+        # while it sat queued — can finalize THERE with the typed
+        # reason, mirroring _place_paged's arc; silently resubmitting
+        # its stripped suffix would decode a garbage continuation.
+        victim = self._by_name[name]
+        migrate = []
+        for req in victim.scheduler.drain():
+            if req.prefix_id is not None:
+                pre = tokens_by_pid.get(req.prefix_id)
+                if pre is None:
+                    victim.scheduler.admission.count_reject(
+                        RejectReason.PREFIX_UNREGISTERED,
+                        tenant=req.tenant)
+                    victim.scheduler._finalize_request(
+                        req, 'rejected',
+                        RejectReason.PREFIX_UNREGISTERED)
+                    continue
+                req.prompt = np.concatenate(
+                    [np.asarray(pre, np.int32), req.prompt])
+                req.prefix_id = None
+                req.prefix_len = 0
+            migrate.append(req)
+        self.pool.remove_replica(name)      # nothing left to drain
+        del self._by_name[name]
+        self._sessions = {s: n for s, n in self._sessions.items()
+                          if n != name}
+        self.registry.gauge('router.replicas').set(
+            len(self.pool.replicas))
+        loads = {r.name: r.load() for r in self.pool.replicas}
+        # Front-push reversed so the drained set keeps its admission
+        # order AHEAD of the target's own queue — it is older work.
+        for req in reversed(migrate):
+            target = min(self.pool.replicas,
+                         key=lambda r: (loads[r.name]['queued']
+                                        + loads[r.name]['busy'],
+                                        r.name))
+            loads[target.name]['queued'] += 1
+            target.scheduler.admission.push_front(req)
+            self._count_routed(target.name, req.tenant)
+            self._emit('router.route', request_id=req.id,
+                       target=target.name, policy='drain',
+                       tenant=req.tenant)
+        return len(migrate)
 
     def close(self):
         self.pool.close()

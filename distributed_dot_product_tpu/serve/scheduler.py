@@ -149,6 +149,11 @@ class ServeConfig:
     # anomaly/adaptive capture, which would otherwise spend its whole
     # bounded window on init and record nothing of the regression.
     profile_warmup: bool = False
+    # Scheduling policy (serve/policy.py): a PolicyConfig arms
+    # priority classes + per-tenant fair-share admission,
+    # deadline-aware eviction, and TTFT-tuned prefill interleaving.
+    # None keeps the mechanical FIFO / longest-idle behavior.
+    policy: Optional[object] = None
 
 
 class _SlotState(enum.Enum):
@@ -280,6 +285,17 @@ class Scheduler:
         # verify-k program; ticks with no proposals ride the plain
         # n=1 program — mixed batches share one verify dispatch with
         # per-slot counts.
+        # Scheduling policy (serve/policy.py): fair-share/priority
+        # admission, deadline-aware eviction, TTFT-tuned prefill
+        # interleave. Built once; every decision recomputes from live
+        # state, so controller knob changes need no resync.
+        if self.cfg.policy is not None:
+            from distributed_dot_product_tpu.serve.policy import (
+                SchedulingPolicy,
+            )
+            self._policy = SchedulingPolicy(self.cfg.policy)
+        else:
+            self._policy = None
         self._proposer = (proposer if proposer is not None
                           else self._resolve_proposer())
         if self._proposer is not None:
@@ -479,7 +495,9 @@ class Scheduler:
                         f'is not registered', request_id=req.id,
                         tenant=req.tenant)
             self.admission.validate(req)
-            self.admission.maybe_degrade(req, pressure=self._pressure())
+            pressure, source = self._pressure_info()
+            self.admission.maybe_degrade(req, pressure=pressure,
+                                         reason=source)
             if self.admission.full and self.cfg.evict_before_reject:
                 # Freeing a slot lets a queued request promote out of
                 # the queue, which is what makes room for this one.
@@ -707,18 +725,39 @@ class Scheduler:
         been idle at least ``min_evict_idle``. The evicted request
         terminates with status ``'evicted'`` and its partial tokens.
         ``exclude``: slot indices never chosen (the page-deficit ladder
-        evicts OTHERS to free pages before preempting the needy one)."""
+        evicts OTHERS to free pages before preempting the needy one).
+
+        With a policy armed (serve/policy.py), a DOOMED slot — one
+        whose request is predicted to miss its deadline anyway, from
+        the remaining budget and the live inter-token-gap percentile —
+        is preferred over the longest-idle one: the evicted stream was
+        already lost, the survivor may still retire in-SLO."""
         now = self.clock()
         busy = [s for s in self._slots if s.state is not _SlotState.FREE
                 and s.index not in exclude]
         if not busy:
             return False
-        victim = max(busy, key=lambda s: (now - s.last_progress,
-                                          -(s.request.admit_index or 0)))
-        if now - victim.last_progress < self.cfg.min_evict_idle:
-            return False
+        victim = None
+        if self._policy is not None:
+            victim = self._policy.eviction_victim(
+                [(s, s.request, s.produced) for s in busy], now,
+                self._gap_estimate())
+        if victim is None:
+            victim = max(busy,
+                         key=lambda s: (now - s.last_progress,
+                                        -(s.request.admit_index or 0)))
+            if now - victim.last_progress < self.cfg.min_evict_idle:
+                return False
         self._finish(victim, 'evicted')
         return True
+
+    def _gap_estimate(self):
+        """The live inter-token pace (policy's finish predictor): the
+        configured percentile of ``serve.token_seconds``, NaN until
+        the first gap lands (the policy then refuses to call anyone
+        doomed — no pace signal, no guess)."""
+        return self._h_token.percentile(
+            self._policy.cfg.gap_percentile)
 
     def _record_dropped(self, dropped):
         for req in dropped:
@@ -786,6 +825,20 @@ class Scheduler:
             return 'wait'
         return 'ok'
 
+    def _policy_chooser(self):
+        """The fair-share selection hook ``pop_ready`` calls with the
+        live queue, or None for FIFO. The weighted-share table is read
+        from the CURRENT slot occupancy — recomputed per pop, so two
+        slots filled in one tick see each other's placements."""
+        if self._policy is None:
+            return None
+        held: Dict[str, int] = {}
+        for s in self._slots:
+            if s.request is not None:
+                held[s.request.tenant] = held.get(s.request.tenant,
+                                                  0) + 1
+        return lambda live: self._policy.select(live, held)
+
     def _admit_into_free_slots(self):
         for slot in self._slots:
             if slot.state is not _SlotState.FREE:
@@ -795,7 +848,8 @@ class Scheduler:
             # (or the queue drains / the head has to wait for pages,
             # which stops admission for the whole tick).
             while True:
-                req, dropped = self.admission.pop_ready()
+                req, dropped = self.admission.pop_ready(
+                    chooser=self._policy_chooser())
                 self._record_dropped(dropped)
                 if req is None:
                     return
@@ -843,17 +897,24 @@ class Scheduler:
             else:
                 slot.state = _SlotState.PREFILL
 
+    def _pressure_info(self):
+        """``(pressure, source)``: the backpressure signal plus which
+        stream dominates it (``'queue'`` / ``'page_pool'``) — the
+        reason stamped on ``serve.degrade`` events."""
+        pressure, source = self.admission.pressure, 'queue'
+        if self._paged:
+            stats = self.engine.cache_stats()
+            pool = stats['pages_used'] / max(1, stats['pages'])
+            if pool > pressure:
+                pressure, source = pool, 'page_pool'
+        return pressure, source
+
     def _pressure(self):
         """Backpressure signal: queue depth, and on paged engines the
         page-pool fill — whichever is higher. A nearly-full pool caps
         new budgets and downgrades readiness exactly like a nearly-
         full queue (shorter streams → fewer pages committed)."""
-        pressure = self.admission.pressure
-        if self._paged:
-            stats = self.engine.cache_stats()
-            pressure = max(pressure,
-                           stats['pages_used'] / max(1, stats['pages']))
-        return pressure
+        return self._pressure_info()[0]
 
     def _update_readiness(self):
         if self.health.liveness is Liveness.STALLED or self._closed:
@@ -1031,10 +1092,71 @@ class Scheduler:
         busy = sum(s.state is not _SlotState.FREE for s in self._slots)
         out = {'queued': self.admission.depth, 'busy': busy,
                'free_slots': self.engine.slots - busy,
-               'accepting': not self.admission.full and not self._closed}
+               'accepting': not self.admission.full and not self._closed,
+               # Policy-relevant backlog shape (router placement and
+               # the controller's scale/shed decisions): who is
+               # queued, and how urgent the head of the backlog is.
+               'queued_by_tenant': self.admission.queued_by_tenant(),
+               'oldest_deadline': self.admission.oldest_deadline()}
         if self._paged:
             out['free_pages'] = self.engine.free_pages
         return out
+
+    # -- control-plane actuation (serve/control.py) --------------------
+    def set_watermark(self, value):
+        """Move the degradation watermark (controller actuation):
+        admission's threshold and the readiness ladder's move together
+        — the two copies can never drift. Returns the clamped value."""
+        value = min(1.0, max(0.05, float(value)))
+        self.cfg.degrade_watermark = value
+        self.admission.degrade_watermark = value
+        return value
+
+    def set_queue_limit(self, limit):
+        """Resize the admission bound (controller actuation): a
+        tightened bound flips ``accepting`` sooner, which is what
+        spills new arrivals to a standby replica through the router's
+        least-loaded ladder. Already-queued requests are never shed by
+        a shrink — the bound gates PUSHES only. Mirrors into
+        ``cfg.queue_limit`` like :meth:`set_watermark` does, so a
+        post-mortem bundle's introspection reports the bound the
+        incident actually ran under. Returns the clamped value."""
+        limit = max(1, int(limit))
+        self.cfg.queue_limit = limit
+        self.admission.queue_limit = limit
+        return limit
+
+    def drain(self):
+        """Preempt every in-flight request and empty the queue —
+        the scale-down arc (serve/control.py): each busy slot emits
+        ``serve.preempt`` (``requeued=True, drain=True``) and its
+        request resets to a fresh attempt (tokens regenerate
+        deterministically, same as a quarantine requeue — but the
+        drain charges no requeue budget: it is an operator action,
+        not a fault). Returns the drained requests in admission order
+        for the caller (the router) to resubmit elsewhere; expired/
+        cancelled queue entries finalize here with their typed
+        reasons, exactly as a tick would have."""
+        drained = []
+        for slot in self._slots:
+            if slot.state is _SlotState.FREE:
+                continue
+            req = slot.request
+            self._emit('serve.preempt', request_id=req.id,
+                       slot=slot.index, requeued=True, drain=True)
+            self._clear_slot(slot)
+            req.tokens = []
+            req.first_token_at = None
+            drained.append(req)
+        while True:
+            req, dropped = self.admission.pop_ready()
+            self._record_dropped(dropped)
+            if req is None:
+                break
+            drained.append(req)
+        self._g_active.set(0)
+        self._update_readiness()
+        return drained
 
     # -- the loop -------------------------------------------------------
     def step(self) -> bool:
@@ -1058,6 +1180,15 @@ class Scheduler:
         self.health.beat()
         self._admit_into_free_slots()
 
+        # Prefill interleave width, ONCE per tick: the policy's boost
+        # reads the TTFT p99 (a reservoir sort — not a per-slot cost),
+        # and only when a target is armed; everything else rides the
+        # stock one-chunk interleave.
+        chunks = 1
+        if self._policy is not None \
+                and self._policy.cfg.target_ttft is not None:
+            chunks = self._policy.prefill_chunks(
+                self._h_ttft.percentile(99))
         for slot in self._slots:
             if slot.state is not _SlotState.PREFILL:
                 continue
@@ -1069,10 +1200,16 @@ class Scheduler:
                 self._finish(slot, 'deadline_expired')
                 continue
             # ONE chunk per tick per slot: long prompts interleave with
-            # decoding instead of monopolizing the loop.
-            end = min(slot.prefill_pos + self.engine.prefill_chunk,
-                      len(req.prompt) - 1)
-            if end > slot.prefill_pos:
+            # decoding instead of monopolizing the loop. A policy with
+            # target_ttft armed may boost that to several chunks while
+            # the live TTFT p99 runs hot (serve/policy.py) — prompts
+            # reach their first token sooner, and the boost collapses
+            # back to 1 as soon as TTFT recovers.
+            for _ in range(chunks):
+                end = min(slot.prefill_pos + self.engine.prefill_chunk,
+                          len(req.prompt) - 1)
+                if end <= slot.prefill_pos:
+                    break
                 self.engine.prefill(slot.index,
                                     req.prompt[slot.prefill_pos:end],
                                     request_id=req.id)

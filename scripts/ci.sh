@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/9] ruff (generic hygiene) ==='
+echo '=== [1/10] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,7 +27,7 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/9] graphlint + servelint (jaxpr/domain/serving contracts) ==='
+echo '=== [2/10] graphlint + servelint (jaxpr/domain/serving contracts) ==='
 # Full pass: jaxpr rules over every registered entrypoint (incl. the
 # bf16 serving-dtype and int8-weight twins — the owned dense retired
 # the flax-Dense f32-accum waivers, so zero allowed records remain)
@@ -38,7 +38,7 @@ echo '=== [2/9] graphlint + servelint (jaxpr/domain/serving contracts) ==='
 #   python -m distributed_dot_product_tpu.analysis --changed-only origin/main
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/9] tier-1 tests ==='
+echo '=== [3/10] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -46,7 +46,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/9] smoke serve + event-log schema validation ==='
+echo '=== [4/10] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -56,7 +56,7 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/9] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+echo '=== [5/10] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
 # Speculative decoding's exactness guarantee, proven on a real burst
 # through the ENV knob a deployment would flip: the same traffic served
 # with the n-gram proposer (verify-k steps) and without (plain n=1
@@ -114,7 +114,7 @@ print(f'spec smoke OK: {len(base)} streams bit-identical, '
 PY
 fi
 
-echo '=== [6/9] serve-load smoke + SLO goodput gate ==='
+echo '=== [6/10] serve-load smoke + SLO goodput gate ==='
 # A seeded open-loop trace (virtual clock — minutes of simulated
 # traffic in seconds of wall time, CPU-deterministic) drives the
 # scheduler, then the goodput report computed FROM THE EVENT LOG ALONE
@@ -139,7 +139,7 @@ else
     rm -f "$slo_log" "$slo_row"
 fi
 
-echo '=== [7/9] disaggregated-serving smoke (router + 2 decode pools) ==='
+echo '=== [7/10] disaggregated-serving smoke (router + 2 decode pools) ==='
 # The 1-router/2-pool cocktail on the CPU mesh: the seeded trace through
 # the disaggregated topology AND its single-process twin, member logs
 # schema-validated (--require router.route / prefill.handoff), goodput
@@ -151,7 +151,7 @@ else
     scripts/smoke_router.sh || rc=1
 fi
 
-echo '=== [8/9] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [8/10] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for
@@ -169,7 +169,7 @@ else
     rm -f "$perf_now"
 fi
 
-echo '=== [9/9] weight-quant decode smoke (kv+weight bytes below the bf16 twin) ==='
+echo '=== [9/10] weight-quant decode smoke (kv+weight bytes below the bf16 twin) ==='
 # The low-precision acceptance row: the SAME decode shape at bf16 and
 # at int8 weights + int8 K mirror — the quantized row must move fewer
 # kv+weight bytes per step AND be kernel-eligible on the paged pool
@@ -204,6 +204,67 @@ print(f"weight-quant smoke OK: {wq8['step_bytes']} vs "
       f"{bf16['step_bytes']} bytes/step, paged int8 kernel-eligible")
 PY
     rm -f "$wq_rows"
+fi
+
+echo '=== [10/10] closed-loop control smoke (static vs controlled under a ramp) ==='
+# The control-plane acceptance row: the SAME seeded ramp trace (rate
+# climbing to 10x across the trace — deterministic overload) through a
+# 1-decode-replica topology twice. STATIC must breach the committed
+# per-tenant SLO floors (the trace is sized to break one replica);
+# CONTROLLED (the closed-loop controller autoscaling decode replicas
+# and actuating admission watermarks) must hold every tenant within
+# SLO_BASELINE.json tolerance. The controlled run's control history is
+# then validated as closed-vocabulary events from the log alone
+# (obs validate --require control.scale).
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping control-smoke stage'
+else
+    ctl_rows="$(mktemp /tmp/ddp_ctl_rows.XXXXXX.json)"
+    ctl_static="$(mktemp -d /tmp/ddp_ctl_static.XXXXXX)"
+    ctl_logs="$(mktemp -d /tmp/ddp_ctl_logs.XXXXXX)"
+    rm -f "$ctl_rows"    # benchmark.py appends into a fresh JSON file
+    { JAX_PLATFORMS=cpu python benchmark.py --mode serve-load \
+          --topology 0x1 --arrival ramp --load-rate 300 \
+          --ramp-factor 10 --load-requests 64 \
+          --event-log "$ctl_static" --file "$ctl_rows" \
+      && JAX_PLATFORMS=cpu python benchmark.py --mode serve-load \
+          --topology 0x1 --arrival ramp --load-rate 300 \
+          --ramp-factor 10 --load-requests 64 --control \
+          --event-log "$ctl_logs" --file "$ctl_rows" \
+      && JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.obs \
+          validate "$ctl_logs/router.jsonl" \
+          --require control.adjust,control.scale \
+      && python - "$ctl_rows" <<'PY'; } || rc=1
+import json
+import sys
+
+rows = json.load(open(sys.argv[1]))
+static, controlled = rows[-2], rows[-1]
+assert not static['control'] and controlled['control']
+base = json.load(open('SLO_BASELINE.json'))
+tol = base['tolerances']['tenant_goodput_abs']
+floors = {t: gp - tol for t, gp in base['per_tenant'].items()}
+breached = [t for t, gp in static['per_tenant'].items()
+            if gp < floors[t]]
+assert breached, (
+    f"the ramp trace no longer breaks the static config "
+    f"({static['per_tenant']} vs floors {floors}) — re-size the ramp "
+    f"so the control win stays measurable")
+held = {t: gp for t, gp in controlled['per_tenant'].items()}
+bad = [t for t, gp in held.items() if gp < floors[t]]
+assert not bad, (
+    f'controlled run breaches the per-tenant SLO floors for {bad}: '
+    f'{held} vs floors {floors} — the closed loop stopped holding '
+    f'goodput under the ramp')
+ups = [a for a in controlled['control_actions']
+       if a['action'] == 'scale' and a['direction'] == 'up']
+assert ups, 'controlled run never scaled up — the ramp was not acted on'
+print(f"control smoke OK: static {static['per_tenant']} (breached "
+      f"{breached}) vs controlled {held} within floors {floors}; "
+      f"{len(ups)} scale-up(s), {controlled['replicas_final']} "
+      f"replicas final")
+PY
+    rm -rf "$ctl_rows" "$ctl_static" "$ctl_logs"
 fi
 
 exit $rc

@@ -1,0 +1,312 @@
+# -*- coding: utf-8 -*-
+"""
+Policy-layer tests (serve/policy.py) + the satellite surfaces that
+ride with it: ramp/step arrival shapes (loadgen), the widened
+``Scheduler.load()`` probe, and the ``serve.degrade`` event the
+degradation rung now emits (it used to engage silently).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, LoadGenConfig, PolicyConfig, Request, Scheduler,
+    SchedulingPolicy, ServeConfig, TenantPolicy, TenantSpec,
+    VirtualClock, default_tenants, generate_trace, load_trace,
+    run_load, save_trace,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+def _req(tenant, rid='r', deadline=None, max_new_tokens=8):
+    return Request(prompt=np.array([1, 2], np.int32),
+                   max_new_tokens=max_new_tokens, deadline=deadline,
+                   id=rid, tenant=tenant)
+
+
+# -- fair share + priority classes --------------------------------------
+
+def test_select_weighted_fair_share():
+    pol = SchedulingPolicy(PolicyConfig(
+        tenants={'a': TenantPolicy(weight=1.0),
+                 'b': TenantPolicy(weight=1.0)}))
+    queue = [_req('a', 'a0'), _req('a', 'a1'), _req('b', 'b0')]
+    # a holds 2 slots, b none: b's share (0) wins despite queue order.
+    assert pol.select(queue, {'a': 2}) == 2
+    # Shares equal -> FIFO.
+    assert pol.select(queue, {'a': 1, 'b': 1}) == 0
+
+
+def test_select_respects_weights_and_priority():
+    pol = SchedulingPolicy(PolicyConfig(
+        tenants={'heavy': TenantPolicy(weight=4.0),
+                 'vip': TenantPolicy(priority=1)}))
+    queue = [_req('light', 'l0'), _req('heavy', 'h0')]
+    # heavy holds 2 of weight 4 (share 0.5) vs light 1 of weight 1
+    # (share 1.0): heavy is still below its entitlement.
+    assert pol.select(queue, {'heavy': 2, 'light': 1}) == 1
+    # A higher priority class boards first regardless of shares.
+    queue = [_req('heavy', 'h0'), _req('vip', 'v0')]
+    assert pol.select(queue, {'vip': 3}) == 1
+
+
+def test_fair_share_admission_in_scheduler():
+    """A tenant flooding the queue cannot starve the other: with the
+    policy armed, admissions interleave by weighted share instead of
+    FIFO order."""
+    clock = VirtualClock()
+    eng = KernelEngine(slots=2, t_max=64, vocab=32, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng, ServeConfig(queue_limit=16, max_new_tokens=6,
+                         watchdog=False, policy=PolicyConfig()),
+        clock=clock, registry=MetricsRegistry(),
+        fault_injector=False)
+    # 6 flooder requests queued ahead of 2 minority ones.
+    for i in range(6):
+        sched.submit([1, 2, 3], request_id=f'flood-{i}',
+                     tenant='flood')
+    for i in range(2):
+        sched.submit([1, 2, 3], request_id=f'mino-{i}', tenant='mino')
+    order = []
+    orig = sched._admit_into_free_slots
+
+    def spy():
+        before = {s.index: (s.request.id if s.request else None)
+                  for s in sched._slots}
+        orig()
+        for s in sched._slots:
+            rid = s.request.id if s.request else None
+            if rid is not None and before[s.index] != rid:
+                order.append(rid)
+
+    sched._admit_into_free_slots = spy
+    sched.run_until_idle()
+    sched.close()
+    # The FIRST pair admitted must split across tenants (FIFO would
+    # admit flood-0, flood-1).
+    assert {order[0], order[1]} == {'flood-0', 'mino-0'}, order
+    assert len(order) == 8
+    assert all(r.status == 'completed'
+               for r in sched.results.values())
+
+
+# -- deadline-aware eviction --------------------------------------------
+
+def test_eviction_victim_picks_the_doomed_stream():
+    pol = SchedulingPolicy(PolicyConfig())
+
+    class Slot:
+        def __init__(self, index):
+            self.index = index
+
+    s0, s1 = Slot(0), Slot(1)
+    # s0: 10 tokens to go, deadline in 0.05s, gap 0.01 -> misses by
+    # 0.05s. s1: 2 to go, deadline in 0.05s -> finishes in time.
+    doomed = pol.eviction_victim(
+        [(s0, _req('a', deadline=0.05, max_new_tokens=10), 0),
+         (s1, _req('a', deadline=0.05, max_new_tokens=2), 0)],
+        now=0.0, gap_estimate=0.01)
+    assert doomed is s0
+    # Nobody doomed -> None (caller falls back to longest-idle).
+    assert pol.eviction_victim(
+        [(s1, _req('a', deadline=10.0, max_new_tokens=2), 0)],
+        now=0.0, gap_estimate=0.01) is None
+    # No pace signal yet -> refuse to guess.
+    assert pol.eviction_victim(
+        [(s0, _req('a', deadline=0.0, max_new_tokens=10), 0)],
+        now=0.0, gap_estimate=float('nan')) is None
+
+
+def test_scheduler_evicts_doomed_not_longest_idle(devices):
+    """Under queue-full pressure the ladder evicts the stream that
+    will miss its deadline anyway, not the longest-idle one."""
+    clock = VirtualClock()
+    eng = KernelEngine(slots=2, t_max=64, vocab=32, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng, ServeConfig(queue_limit=1, max_new_tokens=32,
+                         watchdog=False, policy=PolicyConfig()),
+        clock=clock, registry=MetricsRegistry(),
+        fault_injector=False)
+    # Two running streams: `doomed` has a huge remaining budget and a
+    # deadline it cannot meet at the measured pace; `fine` has slack.
+    # (queue_limit=1: admit each into its slot before the next submit.)
+    sched.submit([1, 2], request_id='doomed', max_new_tokens=30,
+                 deadline=clock() + 0.05)
+    sched.step()
+    sched.submit([1, 2], request_id='fine', max_new_tokens=30,
+                 deadline=clock() + 100.0)
+    sched.step()
+    # Ticks to measure inter-token gaps (both streams decoding).
+    for _ in range(4):
+        sched.step()
+        clock.advance(0.01)
+    # Fill the queue, then one more submit forces the evict rung.
+    sched.submit([1, 2], request_id='q0')
+    sched.submit([1, 2], request_id='next')
+    assert sched.results['doomed'].status == 'evicted'
+    assert 'fine' not in sched.results
+    sched.run_until_idle()
+    sched.close()
+    assert sched.results['fine'].status in ('completed',
+                                            'deadline_expired')
+
+
+# -- prefill/decode interleave tuning -----------------------------------
+
+def test_prefill_chunks_scales_with_ttft_overrun():
+    pol = SchedulingPolicy(PolicyConfig(target_ttft=0.1,
+                                        max_prefill_boost=4))
+    assert pol.prefill_chunks(float('nan')) == 1     # no signal yet
+    assert pol.prefill_chunks(0.05) == 1             # in SLO
+    assert pol.prefill_chunks(0.16) == 3             # ~60% over
+    assert pol.prefill_chunks(0.3) == 4              # saturated
+    # Disabled without a target.
+    assert SchedulingPolicy(PolicyConfig()).prefill_chunks(9.0) == 1
+
+
+def test_prefill_boost_shortens_ttft(devices):
+    """With the boost armed and TTFT already hot, a long prompt
+    prefills several chunks per tick — fewer ticks to first token."""
+    def run(policy):
+        clock = VirtualClock()
+        eng = KernelEngine(slots=1, t_max=64, vocab=32, heads=2,
+                           head_dim=4, prefill_chunk=4, seed=5,
+                           decode_impl='xla')
+        sched = Scheduler(
+            eng, ServeConfig(queue_limit=4, max_new_tokens=4,
+                             watchdog=False, policy=policy),
+            clock=clock, registry=MetricsRegistry(),
+            fault_injector=False)
+        # Seed the TTFT histogram hot (as a regressing serve would).
+        sched._h_ttft.observe(1.0)
+        sched.submit(list(range(1, 25)), request_id='long')
+        ticks = 0
+        while sched.results.get('long') is None:
+            sched.step()
+            clock.advance(0.01)
+            ticks += 1
+        sched.close()
+        return ticks
+
+    plain = run(None)
+    boosted = run(PolicyConfig(target_ttft=0.1, max_prefill_boost=4))
+    assert boosted < plain, (boosted, plain)
+
+
+# -- ramp/step arrival shapes (loadgen satellite) -----------------------
+
+def test_ramp_trace_accelerates_and_round_trips(tmp_path):
+    cfg = LoadGenConfig(seed=3, rate=100.0, requests=60,
+                        arrival='ramp', ramp_factor=8.0)
+    trace = generate_trace(cfg)
+    again = generate_trace(cfg)                 # seeded
+    assert [a.at for a in trace] == [a.at for a in again]
+    gaps = [b.at - a.at for a, b in zip(trace, trace[1:])]
+    third = len(gaps) // 3
+    early = sum(gaps[:third]) / third
+    late = sum(gaps[-third:]) / third
+    # The rate climbs ~8x: late inter-arrival gaps are far tighter.
+    assert late < early / 3, (early, late)
+    # Round-trips byte-exactly through the trace serialization.
+    path = tmp_path / 'ramp.json'
+    save_trace(path, trace)
+    loaded = load_trace(path)
+    assert [a.at for a in loaded] == [a.at for a in trace]
+    assert all((a.prompt == b.prompt).all()
+               for a, b in zip(trace, loaded))
+
+
+def test_step_trace_jumps_at_the_step(tmp_path):
+    cfg = LoadGenConfig(seed=3, rate=100.0, requests=80,
+                        arrival='step', ramp_factor=10.0, step_at=0.5)
+    trace = generate_trace(cfg)
+    gaps = [b.at - a.at for a, b in zip(trace, trace[1:])]
+    pre = gaps[:38]
+    post = gaps[41:]
+    assert sum(post) / len(post) < sum(pre) / len(pre) / 3
+    save_trace(tmp_path / 't.json', trace)
+    assert ([a.at for a in load_trace(tmp_path / 't.json')]
+            == [a.at for a in trace])
+
+
+def test_ramp_step_validation():
+    with pytest.raises(ValueError, match='arrival'):
+        generate_trace(LoadGenConfig(arrival='sawtooth'))
+    with pytest.raises(ValueError, match='ramp_factor'):
+        generate_trace(LoadGenConfig(arrival='ramp', ramp_factor=0.0))
+    with pytest.raises(ValueError, match='step_at'):
+        generate_trace(LoadGenConfig(arrival='step', step_at=1.5))
+
+
+# -- widened load() probe (router/controller satellite) -----------------
+
+def test_load_probe_reports_tenant_backlog_and_urgency(devices):
+    clock = VirtualClock()
+    eng = KernelEngine(slots=1, t_max=64, vocab=32, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       decode_impl='xla')
+    sched = Scheduler(
+        eng, ServeConfig(queue_limit=8, max_new_tokens=4,
+                         watchdog=False),
+        clock=clock, registry=MetricsRegistry(), fault_injector=False)
+    sched.submit([1, 2], tenant='a')                 # takes the slot
+    sched.step()
+    sched.submit([1, 2], tenant='a', deadline=clock() + 9.0)
+    sched.submit([1, 2], tenant='b', deadline=clock() + 5.0)
+    sched.submit([1, 2], tenant='b')
+    load = sched.load()
+    assert load['queued_by_tenant'] == {'a': 1, 'b': 2}
+    assert load['oldest_deadline'] == pytest.approx(clock() + 5.0)
+    sched.run_until_idle()
+    sched.close()
+    assert sched.load()['queued_by_tenant'] == {}
+    assert sched.load()['oldest_deadline'] is None
+
+
+# -- serve.degrade event (bugfix satellite) -----------------------------
+
+def test_degrade_emits_event_and_timeline_stays_complete(tmp_path,
+                                                         devices):
+    clock = VirtualClock()
+    log = obs.EventLog(tmp_path / 'degrade.jsonl', clock=clock)
+    cfg = LoadGenConfig(seed=3, rate=5000.0, requests=24,
+                        tenants=default_tenants(2), vocab=32)
+    res = run_load(
+        cfg,
+        engine=KernelEngine(slots=2, t_max=64, vocab=32, heads=2,
+                            head_dim=4, prefill_chunk=4, seed=5,
+                            decode_impl='xla'),
+        serve_config=ServeConfig(queue_limit=8, max_new_tokens=24,
+                                 degrade_watermark=0.5,
+                                 watchdog=False),
+        registry=MetricsRegistry(), event_log=log, clock=clock)
+    log.close()
+    assert res.accounted
+    records, errors = obs.validate_file(log.path)
+    assert errors == [], errors
+    degrades = [r for r in records if r['event'] == 'serve.degrade']
+    assert degrades, 'overload never tripped the degrade rung'
+    for rec in degrades:
+        assert rec['watermark'] == 0.5
+        assert rec['reason'] == 'queue'
+        assert rec['tenant'] in ('t0', 't1')
+    # The automaton treats the rung as state-exempt: every lifecycle
+    # still reconstructs, and the degraded ones carry the count.
+    tls = obs.reconstruct(records)
+    assert all(tl.complete for tl in tls.values()), [
+        (rid, tl.errors) for rid, tl in tls.items() if not tl.complete]
+    assert sum(tl.degrades for tl in tls.values()) == len(degrades)
+
+
+def test_policy_config_validation():
+    with pytest.raises(ValueError, match='weight'):
+        SchedulingPolicy(PolicyConfig(
+            tenants={'a': TenantPolicy(weight=0.0)}))
+    with pytest.raises(ValueError, match='max_prefill_boost'):
+        SchedulingPolicy(PolicyConfig(max_prefill_boost=0))
+    with pytest.raises(ValueError, match='gap_percentile'):
+        SchedulingPolicy(PolicyConfig(gap_percentile=0))
