@@ -231,6 +231,24 @@ def parse_args():
                              'fresh prompt offloads to the prefill '
                              'pool (below it the replica prefills '
                              'locally)')
+    parser.add_argument('--chaos', action='store_true',
+                        help='--topology: seeded replica-crash chaos '
+                             'row — kill --chaos-victim at virtual '
+                             'tick --chaos-tick mid-trace, let the '
+                             "router's probes declare the loss and "
+                             'the recovery ledger re-place every '
+                             'in-flight stream, then run the SAME '
+                             'crash against a max_recoveries=0 '
+                             'no-recovery twin; the row records both '
+                             'goodputs, the recovered stream set and '
+                             'their bit-identity against the '
+                             'crash-free single-process twin, and the '
+                             'replica_lost flight bundle')
+    parser.add_argument('--chaos-victim', default='r1',
+                        help='--chaos: decode replica to kill')
+    parser.add_argument('--chaos-tick', type=int, default=40,
+                        help='--chaos: loadgen tick (virtual time '
+                             'coordinate) at which the victim dies')
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -1301,12 +1319,36 @@ def run_serve_load_topology(args):
         slots=slots, t_max=t_max, page_size=args.page_size, vocab=64,
         heads=args.heads, head_dim=args.head_dim, seed=0,
         decode_impl=decode_impl)
+    router_cfg = RouterConfig(prefill_threshold=args.prefill_threshold)
+    chaos = chaos_plan = flight_rec = flight_prev = None
+    if args.chaos:
+        from distributed_dot_product_tpu.obs import flight as obs_flight
+        from distributed_dot_product_tpu.serve import ChaosSchedule
+        from distributed_dot_product_tpu.utils.faults import (
+            ChaosInjector, ChaosPlan,
+        )
+        if decode_replicas < 2:
+            raise SystemExit(f'--chaos kills one decode replica '
+                             f'mid-trace: the topology needs >= 2 for '
+                             f'a survivor, got {args.topology}')
+        # Fast probe cadence on the virtual clock: the loss must be
+        # declared (and recovery land) inside the trace's own virtual
+        # window, not long after the survivors drained.
+        router_cfg = dataclasses.replace(
+            router_cfg, probe_interval=0.01, probe_backoff_max=0.02)
+        chaos_plan = ChaosPlan(
+            replica_crash=(args.chaos_victim, args.chaos_tick))
+        chaos = ChaosInjector(chaos_plan)
+        # The black box armed for the whole recovery run: the router's
+        # replica_lost trigger auto-dumps a bundle the moment it
+        # declares the loss.
+        flight_rec = obs_flight.FlightRecorder(
+            os.path.join(log_dir, 'flight'))
+        flight_prev = obs_flight.install(flight_rec)
     clock = VirtualClock()
     router = build_serving(
-        topo, serve_config=serve_cfg,
-        router_config=RouterConfig(
-            prefill_threshold=args.prefill_threshold),
-        clock=clock, log_dir=log_dir)
+        topo, serve_config=serve_cfg, router_config=router_cfg,
+        clock=clock, log_dir=log_dir, chaos=chaos)
     controller = None
     if args.control:
         from distributed_dot_product_tpu.serve import (
@@ -1318,17 +1360,25 @@ def run_serve_load_topology(args):
                 interval=0.01, scale_up_after=1, scale_down_after=20,
                 max_replicas=args.control_max_replicas),
             clock=clock, event_log=router.event_log)
+    on_tick = controller.tick if controller else None
+    if chaos is not None:
+        on_tick = ChaosSchedule(chaos, router, on_tick=on_tick)
     try:
         with span('benchmark.serve_load_topology', seed=args.load_seed,
                   topology=args.topology):
             res = run_trace(router, load_trace(trace_path), clock,
                             tick_seconds=cfg.tick_seconds,
-                            on_tick=(controller.tick if controller
-                                     else None))
+                            on_tick=on_tick)
     finally:
         # Member logs must close (flushing their tails) even when the
         # run under them crashes — those logs ARE the debugging record.
         router.close()
+        if flight_rec is not None:
+            # Disarm before the twin runs: the bundle must record the
+            # chaos run alone, and the no-recovery twin's loss must
+            # not be cooldown-shadowed into silence.
+            obs_flight.install(flight_prev)
+            flight_rec.stop()
     sources = router.pool.logs()
     spec = obs_slo.SloSpec(ttft=args.slo_ttft,
                            per_token=args.slo_token)
@@ -1369,6 +1419,111 @@ def run_serve_load_topology(args):
         twin.close()
         twin_log.close()
     report_twin = obs_slo.goodput(twin_path, spec)
+
+    chaos_extra = {}
+    if args.chaos:
+        # -- what the recovery actually did (from the router log) -----
+        revents = list(obs.read_events(dict(sources)['router']))
+        losses = [r for r in revents if r.get('event') == 'replica.lost']
+        recovered = [r['request_id'] for r in revents
+                     if r.get('event') == 'request.recovered'
+                     and r.get('requeued')]
+        lost_rejects = [r['request_id'] for r in revents
+                        if r.get('event') == 'request.recovered'
+                        and not r.get('requeued')]
+        probe_events = sum(1 for r in revents
+                           if r.get('event') == 'replica.probe')
+        if not losses:
+            raise SystemExit(
+                f'chaos: killing {args.chaos_victim} at tick '
+                f'{args.chaos_tick} never became a replica.lost '
+                f'declaration — the probe path is broken')
+        if not recovered:
+            raise SystemExit(
+                f'chaos: replica {args.chaos_victim} died with no '
+                f'stream to recover — move --chaos-tick into the busy '
+                f'part of the trace (died at tick {args.chaos_tick} '
+                f'of {res.ticks})')
+        if not flight_rec.dumps:
+            raise SystemExit('chaos: the replica loss produced no '
+                             'flight bundle (trigger replica_lost)')
+        # -- bit-identity: a recovered stream IS the crash-free stream.
+        # Degradation caps are load policy, not determinism — compare
+        # the streams both runs completed uncapped.
+        compared, mismatched = 0, []
+        for rid in recovered:
+            a, b = res.results.get(rid), res_twin.results.get(rid)
+            if (a is not None and b is not None
+                    and a.status == b.status == 'completed'
+                    and not a.degraded and not b.degraded):
+                compared += 1
+                if list(a.tokens) != list(b.tokens):
+                    mismatched.append(rid)
+        if mismatched:
+            raise SystemExit(
+                f'chaos: {len(mismatched)} recovered stream(s) '
+                f'diverged from the crash-free twin: '
+                f'{mismatched[:5]} — replay-prefill recovery broke '
+                f'the determinism contract')
+        # -- the no-recovery twin: SAME topology, SAME trace, SAME
+        # crash, max_recoveries=0 — every in-flight stream on the
+        # victim terminates as a typed REPLICA_LOST reject. What
+        # recovery is worth is the goodput gap between these two runs.
+        norec_dir = os.path.join(log_dir, 'norec')
+        os.makedirs(norec_dir, exist_ok=True)
+        for name in ['router'] + (['prefill'] if prefill_pools else []):
+            obs.remove_log(os.path.join(norec_dir, f'{name}.jsonl'))
+        for stale in glob.glob(os.path.join(norec_dir,
+                                            'r[0-9]*.jsonl')):
+            obs.remove_log(stale)
+        norec_chaos = ChaosInjector(chaos_plan)
+        clock_norec = VirtualClock()
+        router_norec = build_serving(
+            topo, serve_config=dataclasses.replace(twin_cfg),
+            router_config=dataclasses.replace(router_cfg,
+                                              max_recoveries=0),
+            clock=clock_norec, log_dir=norec_dir, chaos=norec_chaos)
+        try:
+            res_norec = run_trace(
+                router_norec, load_trace(trace_path), clock_norec,
+                tick_seconds=cfg.tick_seconds,
+                on_tick=ChaosSchedule(norec_chaos, router_norec))
+        finally:
+            router_norec.close()
+        report_norec = obs_slo.goodput(router_norec.pool.logs(), spec)
+        if not res_norec.accounted:
+            raise SystemExit('chaos: the no-recovery twin dropped a '
+                             'request without a typed terminal')
+        norec_lost = sorted(
+            rid for rid, rr in res_norec.results.items()
+            if rr.status == 'rejected'
+            and getattr(rr.reason, 'value', rr.reason)
+            == 'replica_lost')
+        if not norec_lost:
+            raise SystemExit('chaos: the no-recovery twin lost the '
+                             'same replica yet rejected nothing '
+                             'replica_lost — the typed terminal path '
+                             'is broken')
+        if report.goodput_pct < report_norec.goodput_pct:
+            raise SystemExit(
+                f'chaos: goodput WITH recovery '
+                f'({report.goodput_pct:.1f}%) fell below the '
+                f'no-recovery twin ({report_norec.goodput_pct:.1f}%) '
+                f'— recovery made things worse')
+        chaos_extra = {
+            'chaos': {'victim': args.chaos_victim,
+                      'tick': args.chaos_tick},
+            'replica_lost': [r.get('target') for r in losses],
+            'recovered': sorted(recovered),
+            'recovered_compared': compared,
+            'recovered_bitident': compared > 0 and not mismatched,
+            'replica_lost_rejects': sorted(lost_rejects),
+            'probe_events': probe_events,
+            'flight_bundle': flight_rec.dumps[-1]['path'],
+            'norec_goodput_pct': report_norec.goodput_pct,
+            'norec_counts': report_norec.counts,
+            'norec_replica_lost_rejects': norec_lost,
+        }
 
     counters = router.registry.snapshot()['counters']
     routed = {}
@@ -1413,6 +1568,17 @@ def run_serve_load_topology(args):
                             if controller else []),
         'replicas_final': len(router.pool.replicas),
     }
+    record.update(chaos_extra)
+    if args.chaos:
+        print(f"chaos[{args.chaos_victim}@tick {args.chaos_tick}]: "
+              f"{len(chaos_extra['recovered'])} stream(s) recovered "
+              f"({chaos_extra['recovered_compared']} bit-identical to "
+              f"the crash-free twin), "
+              f"{len(chaos_extra['replica_lost_rejects'])} typed "
+              f"replica_lost terminal(s); goodput with recovery "
+              f"{report.goodput_pct:.1f}% vs no-recovery twin "
+              f"{chaos_extra['norec_goodput_pct']:.1f}%; "
+              f"flight bundle {chaos_extra['flight_bundle']}")
     print(f"serve-load[topology {args.topology}"
           f"{'+control' if args.control else ''}] "
           f"seed={args.load_seed} "

@@ -4,10 +4,15 @@ Post-mortem bundle diagnosis — ``obs doctor BUNDLE``.
 
 Given a flight-recorder bundle (obs/flight.py), classify the incident
 FROM THE BUNDLE ALONE — no live process, no source log — and name who
-it hurt. The classifier scores five incident classes against the
+it hurt. The classifier scores six incident classes against the
 evidence in the ring's event window, the metric snapshot, the thread
 stacks and the MANIFEST trigger:
 
+- ``replica_loss``   — a decode replica died mid-stream: a
+  ``replica.lost`` declaration, probe-miss streaks, injected replica
+  crashes, ``request.recovered`` arcs and typed ``replica_lost``
+  terminals. The verdict names the LOST replica (from the declaration
+  — the dead member cannot speak for itself).
 - ``stuck_step``     — the decode loop stopped beating: watchdog
   liveness-stall transitions, a ``stall`` dump trigger, an injected
   ``stuck_step`` fault, a scheduler thread blocked in a sleep/step.
@@ -37,9 +42,11 @@ from distributed_dot_product_tpu.obs.timeline import reconstruct
 __all__ = ['Incident', 'diagnose', 'diagnose_bundles',
            'render_incident']
 
-# Classification order = tie-break priority (sharper findings first).
-CLASSES = ('stuck_step', 'nan_storm', 'cache_exhaustion',
-           'deadline_storm', 'overload')
+# Classification order = tie-break priority (sharper findings first —
+# a dead replica explains the deadline/overload storms downstream of
+# it, never the other way around).
+CLASSES = ('replica_loss', 'stuck_step', 'nan_storm',
+           'cache_exhaustion', 'deadline_storm', 'overload')
 
 _MAX_LISTED = 16    # request ids printed per affected category
 
@@ -60,7 +67,10 @@ class Incident:
     notes: List[str]
     # Multi-bundle diagnosis (one bundle per serving replica): the
     # replica whose bundle carries the primary class's strongest
-    # evidence — None on a single-bundle diagnosis.
+    # evidence — None on a single-bundle diagnosis. A `replica_loss`
+    # primary OVERRIDES this with the LOST replica's name (from the
+    # replica.lost declaration): the verdict points at the dead
+    # member, not at the router whose bundle narrates the loss.
     replica: Optional[str] = None
 
     def to_dict(self):
@@ -117,6 +127,39 @@ def diagnose(bundle) -> Incident:
         scores[cls]['evidence'].append(evidence)
 
     sched_section = (bundle.get('sections') or {}).get('scheduler') or {}
+
+    # -- replica-loss evidence ------------------------------------------
+    lost = [str(r.get('target')) for r in events
+            if r.get('event') == 'replica.lost'
+            and r.get('target') is not None]
+    if lost:
+        vote('replica_loss', 6.0 * len(lost),
+             f'replica.lost declared for {", ".join(lost)}')
+    inj_crash = (_count(events, 'fault.inject', kind='replica_crash')
+                 + _count(events, 'fault.inject', kind='handoff_crash')
+                 + _count(events, 'fault.inject',
+                          kind='probe_blackhole'))
+    if inj_crash:
+        vote('replica_loss', 4.0 * inj_crash,
+             f'injected fault: replica-scoped chaos x{inj_crash}')
+    if trigger == 'replica_lost':
+        vote('replica_loss', 4.0,
+             'bundle dumped by the replica_lost trigger')
+    recovered = _count(events, 'request.recovered')
+    if recovered:
+        vote('replica_loss', min(1.0 * recovered, 8.0),
+             f'{recovered} stream(s) resolved through the recovery '
+             f'ledger')
+    lost_rej = sum(1 for r in events
+                   if r.get('event') == 'serve.reject'
+                   and r.get('reason') == 'replica_lost')
+    if lost_rej:
+        vote('replica_loss', 2.0 * lost_rej,
+             f'{lost_rej} typed replica_lost terminal(s)')
+    probe_missed = _count(events, 'replica.probe', state='missed')
+    if probe_missed:
+        vote('replica_loss', min(0.5 * probe_missed, 2.0),
+             f'{probe_missed} liveness probe miss(es)')
 
     # -- stall evidence -------------------------------------------------
     stalls = _count(events, 'health.liveness', state='stalled')
@@ -297,7 +340,12 @@ def diagnose(bundle) -> Incident:
                      'active when the recorder ran?')
     return Incident(primary=primary, classes=scores, trigger=trigger,
                     reason=reason, window=window, tenants=tenants,
-                    affected=affected, anomalies=anomalies, notes=notes)
+                    affected=affected, anomalies=anomalies, notes=notes,
+                    # A replica_loss verdict names the DEAD member from
+                    # the declaration (the latest, if several fell).
+                    replica=(lost[-1]
+                             if primary == 'replica_loss' and lost
+                             else None))
 
 
 def diagnose_bundles(labeled) -> Incident:
@@ -362,6 +410,11 @@ def diagnose_bundles(labeled) -> Incident:
         where, inc = max(
             incidents, key=lambda li: li[1].classes[primary]['score'])
         trigger, reason = inc.trigger, inc.reason
+        if primary == 'replica_loss' and inc.replica is not None:
+            # The strongest evidence lives in the ROUTER's bundle (the
+            # corpse cannot narrate its own death) — but the verdict
+            # must name the replica that DIED, not the narrator.
+            where = inc.replica
     window = {'events': n_events,
               'first_ts': min(first_ts) if first_ts else None,
               'last_ts': max(last_ts) if last_ts else None,

@@ -63,7 +63,9 @@ EVENT_SCHEMA = {
     # deadline_exceeded, prompt_too_long, cache_exhausted (paged
     # KV-pool exhaustion — static impossibility at submit, or spent
     # preemption retries stamped on the terminal evict/retire),
-    # prefix_unregistered (unknown/unregistered shared prefix).
+    # prefix_unregistered (unknown/unregistered shared prefix),
+    # no_replica (router-level shed), replica_lost (in-flight stream's
+    # replica died and recovery could not re-place it).
     # `tenant` (schema >= 2): the tenant label load/SLO accounting
     # groups by — every admit/reject carries it, so per-tenant goodput
     # is derivable from the log alone (obs/slo.py).
@@ -99,6 +101,31 @@ EVENT_SCHEMA = {
     # (KernelEngine.adopt_prefix): `pages` moved, `rows` of KV they
     # cover. Lives in the PREFILL pool's log.
     'prefill.handoff': ('request_id', 'target', 'pages'),
+    # -- replica failure domains (serve/router.py, serve/replica.py) ---
+    # The router declared a decode replica dead: `target` names it,
+    # `reason` how the loss surfaced (crash / probe_timeout /
+    # handoff_crash), `in_flight` how many ledger entries were live on
+    # it at declaration time. Lives in the ROUTER's log — the dead
+    # replica's own log is torn at the crash point and closes nothing.
+    'replica.lost': ('target', 'reason', 'in_flight'),
+    # One router liveness probe verdict for `target`: `state` is
+    # 'ok' (answered, clears the miss streak) or 'missed' (no answer;
+    # an extra `misses` field carries the consecutive-miss count that
+    # drives the bounded exponential backoff toward declaration).
+    'replica.probe': ('target', 'state'),
+    # A (restarted) replica rejoined the pool through add_replica with
+    # a fresh pool: `target` is its NEW name (names are never reused),
+    # an extra `replicas` field carries the post-join pool size.
+    'replica.rejoin': ('target',),
+    # A stream that was in flight on a lost replica was resolved by the
+    # recovery ledger: requeued=True → re-dispatched to a survivor via
+    # replay-prefill (`target` names it; original-submit TTFT/deadline
+    # anchors preserved, so the survivor's terminal closes the arc);
+    # requeued=False → recovery budget/survivor set exhausted, a
+    # terminal serve.reject reason=replica_lost follows in this log.
+    # Always returns the request to 'queued' in the timeline automaton:
+    # its slot died with the replica.
+    'request.recovered': ('request_id', 'from_replica', 'requeued'),
     # -- speculative decoding (serve/scheduler.py spec ticks) ----------
     # A proposer guessed `proposed` continuation tokens for the slot
     # this tick (`proposer` names which: ngram/draft/custom).

@@ -12,6 +12,7 @@ lifecycles and checks them against the serving contract:
     admit → (prefill* | decode* | quarantine)* → retire(status)
   | reject(reason)                       # shed at submit or in queue
   | retire(abandoned)                    # cancelled while still queued
+  | recovered → re-admit | reject        # replica died mid-stream
 
 A :class:`Timeline` whose ``complete`` is False carries the specific
 violations in ``errors`` — the smoke audit (examples/serve_lm.py
@@ -81,6 +82,10 @@ class Timeline:
     # Degradation-ladder engagements (serve.degrade — the rung used to
     # fire silently): admissions of this request with a capped budget.
     degrades: int = 0
+    # Replica-loss recovery arcs (request.recovered, in the router's
+    # log): times this request's stream was resolved off a dead replica
+    # — re-dispatched to a survivor or terminally rejected replica_lost.
+    recoveries: int = 0
 
     def phases(self):
         """Compact ``{phase: seconds}`` view for printing."""
@@ -175,6 +180,21 @@ def _validate(tl: Timeline):
                 state = 'queued' if rec.get('requeued') else 'running'
                 if rec.get('requeued'):
                     _reset_delivered_latency(tl)
+        elif ev == 'request.recovered':
+            # The replica holding this stream died. The slot died with
+            # it, so the request returns to 'queued' whatever the
+            # requeued flag says: requeued=True is followed by a
+            # survivor's admit, requeued=False by a terminal
+            # serve.reject reason=replica_lost — both legal from
+            # 'queued'. This is how a recovery arc CLOSES across the
+            # dead replica's torn log: the victim's record ends
+            # mid-stream with no terminal, and the router log alone
+            # supplies the transition out of it. Delivered latency of
+            # the aborted attempt is discarded like any requeue; the
+            # next TTFT is still anchored at the ORIGINAL submit.
+            tl.recoveries += 1
+            state = 'queued'
+            _reset_delivered_latency(tl)
         elif ev == 'serve.retire':
             tl.status = rec.get('status')
             tl.reason = rec.get('reason')
@@ -230,7 +250,8 @@ def reconstruct(source) -> Dict[str, Timeline]:
         rid = rec.get('request_id')
         ev = rec.get('event', '')
         if rid is not None and ev.startswith(('serve.', 'spec.',
-                                              'router.', 'prefill.')):
+                                              'router.', 'prefill.',
+                                              'request.')):
             per_request.setdefault(rid, []).append(rec)
     return {rid: _validate(Timeline(request_id=rid, events=evs))
             for rid, evs in per_request.items()}

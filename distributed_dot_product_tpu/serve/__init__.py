@@ -51,9 +51,9 @@ from distributed_dot_product_tpu.serve.health import (  # noqa: F401
     HealthMonitor, Liveness, Readiness,
 )
 from distributed_dot_product_tpu.serve.loadgen import (  # noqa: F401
-    Arrival, LoadGenConfig, LoadResult, TenantSpec, VirtualClock,
-    default_tenants, generate_trace, load_trace, run_load, run_trace,
-    save_trace,
+    Arrival, ChaosSchedule, LoadGenConfig, LoadResult, TenantSpec,
+    VirtualClock, default_tenants, generate_trace, load_trace,
+    run_load, run_trace, save_trace,
 )
 from distributed_dot_product_tpu.serve.policy import (  # noqa: F401
     PolicyConfig, SchedulingPolicy, TenantPolicy,
@@ -79,4 +79,5 @@ __all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
            'TopologyConfig', 'maybe_init_distributed',
            'parse_topology', 'Router', 'RouterConfig',
            'build_serving', 'PolicyConfig', 'TenantPolicy',
-           'SchedulingPolicy', 'ControlConfig', 'Controller']
+           'SchedulingPolicy', 'ControlConfig', 'Controller',
+           'ChaosSchedule']

@@ -58,6 +58,11 @@ class RejectReason(enum.Enum):
     # at its bound (or the pool is empty). The router-level analog of
     # QUEUE_FULL, shed BEFORE any replica's ladder runs.
     NO_REPLICA = 'no_replica'
+    # Disaggregated serving: the decode replica holding this in-flight
+    # stream died, and the router could not re-place it — no surviving
+    # replica, or the per-request ``max_recoveries`` budget is spent.
+    # Terminal: the recovery ledger entry is finalized under this reason.
+    REPLICA_LOST = 'replica_lost'
 
 
 class RejectedError(Exception):

@@ -49,7 +49,7 @@ from distributed_dot_product_tpu.serve.scheduler import (
 __all__ = ['TenantSpec', 'LoadGenConfig', 'Arrival', 'VirtualClock',
            'generate_trace', 'run_trace', 'run_load', 'LoadResult',
            'default_tenants', 'TRACE_SCHEMA', 'save_trace',
-           'load_trace']
+           'load_trace', 'ChaosSchedule']
 
 # determlint: the driving loop lives on the virtual clock — real time
 # may only appear as the reporting-only wall_seconds accounting
@@ -379,6 +379,45 @@ def run_trace(scheduler: Scheduler, trace: List[Arrival],
         wall_seconds=time.perf_counter() - t0,
         offered_rate=(n / span if span > 0 else float('inf')),
         ticks=ticks)
+
+
+class ChaosSchedule:
+    """Seeded chaos timing for a :func:`run_trace` drive: counts the
+    loop's ticks and fires the plan's replica crash at EXACTLY its
+    tick. Tick indices are virtual-time coordinates — nothing here
+    reads a clock — so the same plan over the same serialized trace
+    replays the crash at the same virtual instant every run, which is
+    what makes the chaos benchmark's recovered-vs-twin token
+    comparison a bit-identity check instead of a flake. Use as the
+    run's ``on_tick``::
+
+        chaos = ChaosSchedule(ChaosInjector(plan), router)
+        run_trace(router, trace, clock, on_tick=chaos)
+
+    The kill lands on the MEMBER (``DecodeReplica.kill`` — its event
+    log tears mid-record); the ROUTER is told nothing. Its liveness
+    probes must detect the silence and declare the loss, exactly as
+    with a real dead process. An inner ``on_tick`` (a controller's)
+    chains after the crash check."""
+
+    def __init__(self, injector, router, on_tick=None):
+        self.injector = injector
+        self.router = router
+        self.on_tick = on_tick
+        self.tick = 0
+        self.killed = []
+
+    def __call__(self):
+        victim = self.injector.crash_due(self.tick)
+        if victim is not None:
+            replica = next((r for r in self.router.pool.replicas
+                            if r.name == victim), None)
+            if replica is not None and replica.alive:
+                replica.kill()
+                self.killed.append(victim)
+        self.tick += 1
+        if self.on_tick is not None:
+            self.on_tick()
 
 
 def run_load(cfg: LoadGenConfig, *, engine, serve_config=None,

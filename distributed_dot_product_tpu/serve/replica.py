@@ -238,6 +238,21 @@ class PrefillPool:
         self.engine.unregister_prefix(handle.prefix_id)
 
 
+class _DeadLog:
+    """Event sink for a crashed replica's teardown: a dead process
+    writes nothing, so the health monitor's clean 'stopped' transition
+    (Scheduler.close → HealthMonitor.stop) must NOT land after the
+    torn tail. Swallows emits instead of forwarding to the active log
+    — the crash is narrated by the ROUTER (replica.lost), not by the
+    corpse."""
+
+    def emit(self, event, **fields):
+        return None
+
+
+_DEAD_LOG = _DeadLog()
+
+
 class DecodeReplica:
     """One decode pool member: a paged :class:`KernelEngine` driven by
     its own :class:`Scheduler`, with its own event log and metrics
@@ -252,6 +267,7 @@ class DecodeReplica:
         self.engine = engine
         self.event_log = event_log
         self.registry = registry or tracing.MetricsRegistry()
+        self.alive = True
         self.scheduler = Scheduler(
             engine, config, clock=clock, registry=self.registry,
             event_log=event_log, fault_injector=fault_injector)
@@ -261,12 +277,44 @@ class DecodeReplica:
         return self.scheduler.results
 
     def load(self):
+        if not self.alive:
+            # A dead replica answers nothing — this shape only matters
+            # for callers that snapshot loads before the router has
+            # declared the loss (the prober, not the placement ladder,
+            # is what removes it from rotation).
+            return {'accepting': False, 'queued': 0, 'busy': 0,
+                    'free_slots': 0, 'queued_by_tenant': {},
+                    'oldest_deadline': None, 'free_pages': 0}
         return self.scheduler.load()
 
     def step(self):
+        if not self.alive:
+            return False
         return self.scheduler.step()
 
+    def kill(self):
+        """The crash seam: this replica's process "dies" mid-write.
+        Everything in flight is lost — slots, paged KV, registered
+        prefixes — and its event log is TORN: closed at the crash
+        point with a partial trailing record (what a buffered writer
+        leaves on power loss; ``read_events`` tolerates exactly this
+        tail). A crashed process emits nothing more, so the health
+        monitor's log is detached before teardown. Idempotent."""
+        if not self.alive:
+            return
+        self.alive = False
+        self.scheduler.health.event_log = _DEAD_LOG
+        self.scheduler.close()
+        if self.event_log is not None:
+            self.event_log.close()
+            with open(self.event_log.path, 'a', encoding='utf-8') as fh:
+                # A record cut mid-serialization: no newline, invalid
+                # JSON — the torn tail merge/reconstruct must absorb.
+                fh.write('{"schema":2,"seq":')
+
     def close(self):
+        if not self.alive:
+            return
         self.scheduler.close()
 
 
@@ -303,6 +351,9 @@ class ReplicaPool:
         self.retired = []       # drained-and-removed members (results
         #   and logs stay readable — their streams are history, not
         #   garbage)
+        self.lost = []          # crashed members: finalized results
+        #   stay readable, but unlike `retired` their in-flight work
+        #   was NOT drained — the router's recovery ledger owns it
         self._replica_seq = 0   # names never reuse: r0, r1, r2, ...
         for _ in range(topo.decode_replicas):
             self.add_replica()
@@ -350,6 +401,24 @@ class ReplicaPool:
         self.replicas.remove(replica)
         self.retired.append(replica)
         return drained
+
+    def mark_lost(self, name) -> DecodeReplica:
+        """Declare one member crashed and move it to :attr:`lost`.
+        Unlike :meth:`remove_replica` there is NO drain — a dead
+        scheduler cannot enumerate its queue; whatever was in flight is
+        the ROUTER's recovery ledger's to re-place — and no last-member
+        refusal: losing the whole pool is a fact, not a request.
+        :meth:`DecodeReplica.kill` runs here if the crash seam has not
+        fired already (probe-declared losses arrive with the member
+        already dead)."""
+        replica = next((r for r in self.replicas if r.name == name),
+                       None)
+        if replica is None:
+            raise KeyError(f'no replica named {name!r} in the pool')
+        replica.kill()
+        self.replicas.remove(replica)
+        self.lost.append(replica)
+        return replica
 
     def open_log(self, name):
         """One member's event log under ``log_dir`` (None without one)

@@ -33,6 +33,21 @@ across the logs. When NO replica can accept, the router sheds with the
 typed ``NO_REPLICA`` reason BEFORE any replica's ladder runs: capacity
 probing (``Scheduler.load()``), never a reject in one log and an admit
 in another.
+
+**Failure domains.** A decode replica that dies mid-stream (the crash
+seam :meth:`~distributed_dot_product_tpu.serve.replica.DecodeReplica
+.kill`, or a chaos plan's replica-scoped faults) is detected by the
+router's per-tick liveness probes — timeout with bounded exponential
+backoff before :meth:`Router.mark_lost` declares it — and its in-flight
+streams are RECOVERED from the router's per-request ledger: prompts,
+tenant, deadline and original-submit TTFT anchors survive the crash on
+the router side, so each stream re-dispatches to a survivor via
+replay-prefill and, greedy decoding being a pure function of
+prompt + seed, continues bit-identically. Recovery is bounded
+(``max_recoveries`` per request, then the typed ``REPLICA_LOST``
+terminal) and fully narrated: ``replica.probe`` / ``replica.lost`` /
+``request.recovered`` / ``replica.rejoin`` in the router's log close
+every arc across the dead member's torn log.
 """
 
 import collections
@@ -44,8 +59,9 @@ from typing import Optional
 import numpy as np
 
 from distributed_dot_product_tpu.obs import events as obs_events
+from distributed_dot_product_tpu.obs import flight as obs_flight
 from distributed_dot_product_tpu.serve.admission import (
-    RejectedError, RejectReason,
+    RejectedError, RejectReason, Request, RequestResult,
 )
 from distributed_dot_product_tpu.serve.replica import (
     ReplicaPool, TopologyConfig,
@@ -77,6 +93,22 @@ class RouterConfig:
     # entry cap — decode slots must keep the rest of the pool.
     prefix_pin_fraction: float = 0.5
     session_affinity: bool = True
+    # -- failure domains -----------------------------------------------
+    # Times one request may be re-placed after losing its replica
+    # before the typed REPLICA_LOST terminal (0 = no recovery: every
+    # in-flight stream on a lost replica rejects — the chaos
+    # benchmark's no-recovery twin).
+    max_recoveries: int = 1
+    # Liveness probing (router clock, virtual in tests): probe each
+    # replica every `probe_interval`; a miss re-probes with bounded
+    # exponential backoff (`interval * backoff**misses`, capped at
+    # `probe_backoff_max`) and `probe_misses` consecutive misses
+    # declare the replica lost. Timeout-then-declare, never first-miss
+    # — a single dropped probe must not trigger a recovery storm.
+    probe_interval: float = 0.05
+    probe_misses: int = 3
+    probe_backoff: float = 2.0
+    probe_backoff_max: float = 0.2
 
 
 class Router:
@@ -89,14 +121,31 @@ class Router:
 
     def __init__(self, pool: ReplicaPool,
                  config: Optional[RouterConfig] = None, *,
-                 clock=time.monotonic, event_log=None, registry=None):
+                 clock=time.monotonic, event_log=None, registry=None,
+                 chaos=None):
         self.pool = pool
         self.cfg = config or RouterConfig()
         self.clock = clock
         self.event_log = event_log
         self.registry = registry or tracing.MetricsRegistry()
+        self.chaos = chaos          # ChaosInjector (utils/faults.py)
         self._by_name = {r.name: r for r in pool.replicas}
         self._sessions = {}
+        # -- failure domains -------------------------------------------
+        # The recovery ledger: everything needed to re-place a stream
+        # whose replica dies, keyed by request id. The scheduler-side
+        # Request object dies WITH the replica (a real crash loses the
+        # process memory), so recovery rebuilds from this router-side
+        # record alone: the FULL original prompt (prefix stripping
+        # undone — greedy replay-prefill regenerates bit-identically),
+        # the resolved token budget, tenant, the ABSOLUTE deadline and
+        # the original submit instant (TTFT stays anchored there across
+        # recoveries — a crash does not reset a request's clock).
+        self._ledger = {}
+        # Terminal results the ROUTER owns (REPLICA_LOST rejects have
+        # no live scheduler to finalize on) — merged into `results`.
+        self._lost_results = {}
+        self._probe_state = {}      # name -> {'next': t, 'misses': n}
         # prefix key (tuple of prefix tokens) -> (replica, pid, rows);
         # ordered by last hit for the per-replica LRU cap. The reverse
         # map (replica, pid) -> key lets a drain re-expand a stripped
@@ -110,9 +159,12 @@ class Router:
         self._c_handoffs = reg.counter('router.handoffs')
         self._c_handoff_pages = reg.counter('router.handoff_pages')
         self._c_unregistered = reg.counter('router.prefix_unregistered')
+        self._c_lost = reg.counter('router.replicas_lost')
+        self._c_recovered = reg.counter('router.recovered')
         reg.gauge('router.replicas').set(len(pool.replicas))
         self._routed_series = {}
         self._noreplica_series = {}
+        self._lostreject_series = {}
 
     # -- observability ---------------------------------------------------
     def _emit(self, event, _log=None, **fields):
@@ -203,6 +255,15 @@ class Router:
                 prefill.engine.cache, handle.pages, handle.length)
         finally:
             prefill.release(handle)
+        if self.chaos is not None \
+                and self.chaos.crash_on_handoff(replica.name):
+            # The worst crash instant: pages adopted, placement not
+            # yet recorded. The replica dies HERE — its other streams
+            # recover through the ledger, and the caller re-places the
+            # request being handed off on a survivor (it was never
+            # submitted anywhere, so nothing about it is lost).
+            self.mark_lost(replica.name, reason='handoff_crash')
+            return None
         self._cache_prefix(key, replica, pid, rows)
         self._c_handoffs.inc()
         self._c_handoff_pages.inc(needed)
@@ -210,6 +271,24 @@ class Router:
                    request_id=rid, target=replica.name, pages=needed,
                    rows=rows, tenant=tenant)
         return pid
+
+    def _shed_no_replica(self, rid, tenant):
+        """The router-level typed shed: counted, logged, raised."""
+        key = (tenant,)
+        c = self._noreplica_series.get(key)
+        if c is None:
+            c = self._noreplica_series[key] = self.registry.counter(
+                'router.rejected.no_replica',
+                labels={'tenant': tenant})
+        c.inc()
+        self._emit('serve.reject', request_id=rid,
+                   reason=RejectReason.NO_REPLICA.value,
+                   queued=False, tenant=tenant)
+        raise RejectedError(
+            RejectReason.NO_REPLICA,
+            f'request {rid}: no decode replica accepting '
+            f'({len(self.pool.replicas)} replicas, every queue at '
+            f'its bound)')
 
     # -- submission surface ----------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, deadline=None,
@@ -229,21 +308,7 @@ class Router:
         accepting = [r for r in self.pool.replicas
                      if loads[r.name]['accepting']]
         if not accepting:
-            key = (tenant,)
-            c = self._noreplica_series.get(key)
-            if c is None:
-                c = self._noreplica_series[key] = self.registry.counter(
-                    'router.rejected.no_replica',
-                    labels={'tenant': tenant})
-            c.inc()
-            self._emit('serve.reject', request_id=rid,
-                       reason=RejectReason.NO_REPLICA.value,
-                       queued=False, tenant=tenant)
-            raise RejectedError(
-                RejectReason.NO_REPLICA,
-                f'request {rid}: no decode replica accepting '
-                f'({len(self.pool.replicas)} replicas, every queue at '
-                f'its bound)')
+            self._shed_no_replica(rid, tenant)
         key = (tuple(int(t) for t in prompt[:-1])
                if len(prompt) > 1 else None)
         replica = prefix_id = None
@@ -272,10 +337,40 @@ class Router:
                 pid = self._handoff(rid, replica, key, tenant)
                 if pid is not None:
                     prefix_id, sub_prompt = pid, prompt[-1:]
+        if not replica.alive:
+            # The chosen replica died during the KV handoff (chaos
+            # seam in _handoff): re-place on the least-loaded survivor
+            # — the request was never submitted anywhere, so this is a
+            # fresh placement, not a recovery — or shed typed.
+            survivors = [r for r in self.pool.replicas
+                         if r.load()['accepting']]
+            if not survivors:
+                self._shed_no_replica(rid, tenant)
+            loads = {r.name: r.load() for r in survivors}
+            replica = min(survivors,
+                          key=lambda r: (loads[r.name]['queued']
+                                         + loads[r.name]['busy'],
+                                         r.name))
+            prefix_id, sub_prompt, policy = None, prompt, 'load'
         req = replica.scheduler.submit(
             sub_prompt, max_new_tokens=max_new_tokens,
             deadline=deadline, request_id=rid, prefix_id=prefix_id,
             tenant=tenant)
+        # Ledger entry AFTER the replica admitted it (a typed reject
+        # raised above leaves nothing to recover): the full ORIGINAL
+        # prompt (prefix stripping undone on replay), the RESOLVED
+        # budget (degradation caps survive recovery — a crash must not
+        # un-shed load), and the original submit/deadline anchors.
+        self._ledger[req.id] = {
+            'prompt': np.asarray(prompt, np.int32),
+            'max_new_tokens': req.max_new_tokens,
+            'deadline': req.deadline,
+            'tenant': req.tenant,
+            'session': session,
+            'submitted_at': req.submitted_at,
+            'replica': replica.name,
+            'recoveries': 0,
+        }
         if session is not None:
             self._sessions[session] = replica.name
         self._count_routed(replica.name, tenant)
@@ -285,16 +380,30 @@ class Router:
 
     # -- driving surface -------------------------------------------------
     def step(self) -> bool:
-        return self.pool.step_all()
+        self._probe_tick()
+        busy = self.pool.step_all()
+        # A pending detection keeps the topology "busy": a dead member
+        # contributes no work, but until the probe timeout declares it
+        # lost its in-flight streams are neither running nor recovered
+        # — an idle-looking tick here must not end the run with those
+        # streams unaccounted.
+        return busy or any(
+            not r.alive or self._probe_state.get(r.name, {}).get(
+                'misses', 0) > 0
+            for r in self.pool.replicas)
 
     @property
     def results(self):
-        # Retired (drained) members' finalized results stay part of
-        # the run's record — a request that expired in a queue that
-        # was later drained terminated THERE.
+        # Retired (drained) and lost (crashed) members' finalized
+        # results stay part of the run's record — a request that
+        # terminated on a member before it left the pool terminated
+        # THERE — and the router's own REPLICA_LOST terminals
+        # (recovery exhausted: no scheduler left to finalize on) top
+        # it off.
         out = {}
-        for r in self.pool.retired + self.pool.replicas:
+        for r in self.pool.retired + self.pool.lost + self.pool.replicas:
             out.update(r.results)
+        out.update(self._lost_results)
         return out
 
     def run_until_idle(self, max_ticks=100_000):
@@ -315,6 +424,194 @@ class Router:
         policy-relevant ``queued_by_tenant`` and ``oldest_deadline``
         fields the controller sheds/places on."""
         return {r.name: r.load() for r in self.pool.replicas}
+
+    # -- failure domains -------------------------------------------------
+    def _probe_ok(self, replica):
+        """One liveness probe: does the member answer? A chaos
+        blackhole (process alive, network dead) and a dead process
+        look identical from here — that is the point: loss is declared
+        from the ROUTER's observation, never from shared memory."""
+        if self.chaos is not None \
+                and self.chaos.blackholed(replica.name):
+            return False
+        return replica.alive
+
+    def _probe_tick(self):
+        """Per-tick liveness sweep on the router's (virtual) clock.
+        Misses re-probe with bounded exponential backoff and
+        ``probe_misses`` consecutive misses declare the member lost —
+        a timeout, not a first-miss hair trigger."""
+        now = self.clock()
+        cfg = self.cfg
+        for replica in list(self.pool.replicas):
+            st = self._probe_state.get(replica.name)
+            if st is None:
+                st = self._probe_state[replica.name] = {
+                    'next': now + cfg.probe_interval, 'misses': 0}
+                continue
+            if now < st['next']:
+                continue
+            if self._probe_ok(replica):
+                if st['misses']:
+                    # Only transitions are narrated: a healthy pool's
+                    # probe stream stays out of the log.
+                    self._emit('replica.probe', target=replica.name,
+                               state='ok')
+                st['misses'] = 0
+                st['next'] = now + cfg.probe_interval
+                continue
+            st['misses'] += 1
+            self._emit('replica.probe', target=replica.name,
+                       state='missed', misses=st['misses'])
+            if st['misses'] >= cfg.probe_misses:
+                self.mark_lost(replica.name, reason='probe_timeout')
+                continue
+            st['next'] = now + min(
+                cfg.probe_interval * cfg.probe_backoff ** st['misses'],
+                cfg.probe_backoff_max)
+
+    def mark_lost(self, name, *, reason='crash'):
+        """Declare one decode replica dead and recover its in-flight
+        streams from the recovery ledger. The dead member's prefix-map
+        entries, session pins and probe state drop immediately (its
+        pages are gone — a stale map entry would route a rider into a
+        crash); each stream still in flight there re-dispatches to the
+        least-loaded survivor via replay-prefill with its ORIGINAL
+        submit/deadline anchors (bounded by ``max_recoveries``, then
+        the typed REPLICA_LOST terminal — zero requests dropped
+        without a typed reason, with or without survivors). Returns
+        the number of streams re-dispatched."""
+        if name not in self._by_name:
+            raise KeyError(f'no replica named {name!r}')
+        victim = self.pool.mark_lost(name)   # kills it if still alive
+        del self._by_name[name]
+        self._probe_state.pop(name, None)
+        for (rname, pid), key in list(self._pid_tokens.items()):
+            if rname == name:
+                del self._pid_tokens[(rname, pid)]
+                self._prefix_map.pop(key, None)
+        self._sessions = {s: n for s, n in self._sessions.items()
+                          if n != name}
+        self._c_lost.inc()
+        self.registry.gauge('router.replicas').set(
+            len(self.pool.replicas))
+        # In flight = ledgered to the dead member, no terminal yet.
+        # Ledger (dict) order is submission order, so recovery
+        # front-pushes reversed to keep it — recovered work is OLDER
+        # than anything queued on the survivor.
+        inflight = [rid for rid, e in self._ledger.items()
+                    if e['replica'] == name
+                    and rid not in victim.results
+                    and rid not in self._lost_results]
+        self._emit('replica.lost', target=name, reason=reason,
+                   in_flight=len(inflight))
+        self._flight_dump(
+            'replica_lost',
+            f'replica {name} lost ({reason}), '
+            f'{len(inflight)} streams in flight')
+        survivors = list(self.pool.replicas)
+        loads = {r.name: r.load() for r in survivors}
+        recovered = 0
+        for rid in reversed(inflight):
+            entry = self._ledger[rid]
+            entry['recoveries'] += 1
+            if not survivors \
+                    or entry['recoveries'] > self.cfg.max_recoveries:
+                self._emit('request.recovered', request_id=rid,
+                           from_replica=name, requeued=False,
+                           recoveries=entry['recoveries'])
+                key = (entry['tenant'],)
+                c = self._lostreject_series.get(key)
+                if c is None:
+                    c = self._lostreject_series[key] = \
+                        self.registry.counter(
+                            'router.rejected.replica_lost',
+                            labels={'tenant': entry['tenant']})
+                c.inc()
+                self._emit('serve.reject', request_id=rid,
+                           reason=RejectReason.REPLICA_LOST.value,
+                           queued=True, tenant=entry['tenant'])
+                self._lost_results[rid] = RequestResult(
+                    id=rid, status='rejected', tokens=[],
+                    prompt_len=len(entry['prompt']),
+                    reason=RejectReason.REPLICA_LOST,
+                    finished_at=self.clock(), tenant=entry['tenant'])
+                continue
+            # Replay-prefill re-dispatch: rebuild the request from the
+            # ledger alone (the scheduler-side object died with the
+            # process). Greedy streams are prompt + seed pure, so the
+            # survivor regenerates the SAME tokens from scratch; the
+            # original submit anchor keeps TTFT/deadline honest across
+            # the crash.
+            target = min(survivors,
+                         key=lambda r: (loads[r.name]['queued']
+                                        + loads[r.name]['busy'],
+                                        r.name))
+            loads[target.name]['queued'] += 1
+            req = Request(prompt=entry['prompt'],
+                          max_new_tokens=entry['max_new_tokens'],
+                          deadline=entry['deadline'], id=rid,
+                          tenant=entry['tenant'])
+            req.submitted_at = entry['submitted_at']
+            target.scheduler.admission.push_front(req)
+            entry['replica'] = target.name
+            if entry['session'] is not None:
+                self._sessions[entry['session']] = target.name
+            recovered += 1
+            self._c_recovered.inc()
+            self._count_routed(target.name, entry['tenant'])
+            self._emit('request.recovered', request_id=rid,
+                       from_replica=name, requeued=True,
+                       target=target.name,
+                       recoveries=entry['recoveries'])
+            self._emit('router.route', request_id=rid,
+                       target=target.name, policy='recovery',
+                       tenant=entry['tenant'])
+        return recovered
+
+    def rejoin_replica(self):
+        """A restarted replica rejoins through the existing
+        :meth:`add_replica` path with a fresh pool (names never reuse
+        — the ghost's torn log keeps its name). It starts empty, so
+        least-loaded placement routes the next arrivals there; nothing
+        from before the crash is trusted."""
+        replica = self.add_replica()
+        self._emit('replica.rejoin', target=replica.name,
+                   replicas=len(self.pool.replicas))
+        return replica
+
+    def introspection(self):
+        """Router state for the flight recorder's post-mortem bundle:
+        membership, the probe ledger and the recovery ledger's shape —
+        what a post-incident doctor needs to see next to the dead
+        member's torn log."""
+        return {
+            'replicas': [r.name for r in self.pool.replicas],
+            'lost': [r.name for r in self.pool.lost],
+            'retired': [r.name for r in self.pool.retired],
+            'probes': {n: dict(st)
+                       for n, st in self._probe_state.items()},
+            'ledger_size': len(self._ledger),
+            'lost_terminals': len(self._lost_results),
+            'sessions': len(self._sessions),
+            'prefix_entries': len(self._prefix_map),
+        }
+
+    def _flight_dump(self, trigger, reason=''):
+        """One rate-limited post-mortem bundle through the process
+        flight recorder (no-op while none is installed). Never raises:
+        the black box must not take down the recovery it records."""
+        rec = obs_flight.get_recorder()
+        if rec is None:
+            return None
+        try:
+            return rec.maybe_dump(
+                trigger=trigger, reason=reason,
+                sections={'router': self.introspection()})
+        except Exception as e:
+            tracing.log_exception('router.flight_dump', e,
+                                  registry=self.registry)
+            return None
 
     # -- elastic membership (serve/control.py drives these) -------------
     def add_replica(self):
@@ -398,6 +695,11 @@ class Router:
                                         r.name))
             loads[target.name]['queued'] += 1
             target.scheduler.admission.push_front(req)
+            # Keep the recovery ledger pointed at the member actually
+            # holding the stream: a later crash must recover it from
+            # where it LIVES, not where it was first placed.
+            if req.id in self._ledger:
+                self._ledger[req.id]['replica'] = target.name
             self._count_routed(target.name, req.tenant)
             self._emit('router.route', request_id=req.id,
                        target=target.name, policy='drain',
@@ -418,7 +720,8 @@ class Router:
 def build_serving(topology: Optional[TopologyConfig] = None, *,
                   serve_config=None, router_config=None,
                   clock=time.monotonic, log_dir=None, mesh=None,
-                  fault_injector=False, registry=None) -> Router:
+                  fault_injector=False, registry=None,
+                  chaos=None) -> Router:
     """Wire a whole single-process topology: the
     :class:`~distributed_dot_product_tpu.serve.replica.ReplicaPool`
     (one paged engine + scheduler + event log per decode replica, plus
@@ -429,5 +732,10 @@ def build_serving(topology: Optional[TopologyConfig] = None, *,
     pool = ReplicaPool(topology, serve_config=serve_config,
                        clock=clock, log_dir=log_dir, mesh=mesh,
                        fault_injector=fault_injector)
-    return Router(pool, router_config, clock=clock,
-                  event_log=pool.open_log('router'), registry=registry)
+    router = Router(pool, router_config, clock=clock,
+                    event_log=pool.open_log('router'),
+                    registry=registry, chaos=chaos)
+    if chaos is not None and chaos.event_log is None:
+        # Injections narrate next to the loss/recovery arc they cause.
+        chaos.event_log = router.event_log
+    return router

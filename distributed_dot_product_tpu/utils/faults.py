@@ -39,7 +39,7 @@ import dataclasses
 import os
 import signal
 import time
-from typing import FrozenSet, Optional
+from typing import FrozenSet, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +49,8 @@ from distributed_dot_product_tpu.utils import checkpoint as _ckpt
 
 __all__ = ['FaultPlan', 'FaultInjector', 'SimulatedCrash', 'plan_from_env',
            'poison_batch', 'ServeFaultPlan', 'ServeFaultInjector',
-           'serve_plan_from_env', 'burst_prompts']
+           'serve_plan_from_env', 'burst_prompts',
+           'ChaosPlan', 'ChaosInjector', 'chaos_plan_from_env']
 
 
 class SimulatedCrash(BaseException):
@@ -397,4 +398,125 @@ class ServeFaultInjector:
         obs_events.emit('fault.inject', _log=self.event_log,
                         kind='abandon', admit_index=admit_index,
                         tokens_done=tokens_done)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Replica-scoped chaos (serve/replica.py, serve/router.py)
+#
+# The disaggregated layer's failure domain is a whole decode REPLICA, not
+# a slot: a crashed replica takes its in-flight streams, its paged KV and
+# its share of the cluster prefix cache down at once. Every knob here is
+# keyed by replica name and virtual-time tick so a crash replays
+# bit-identically (serve/loadgen.py ChaosSchedule drives crash_due from
+# run_trace's on_tick; the router consults the handoff/probe hooks).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    """Replica-scoped faults, keyed by name + virtual tick. Immutable;
+    runtime one-shot state lives in the :class:`ChaosInjector`."""
+    # Kill this replica when the loadgen reaches this tick (name, tick).
+    replica_crash: Optional[Tuple[str, int]] = None
+    # Kill this replica DURING its next prefill->decode KV handoff —
+    # after adopt_prefix, before the router records the placement (the
+    # worst moment: pages adopted, stream never admitted).
+    crash_in_handoff: Optional[str] = None
+    # This replica stops answering router liveness probes (process
+    # alive, network dead): loss must come from the probe timeout path.
+    probe_blackhole: Optional[str] = None
+    fire_once: bool = True
+
+    def any(self):
+        return (self.replica_crash is not None
+                or self.crash_in_handoff is not None
+                or self.probe_blackhole is not None)
+
+
+def chaos_plan_from_env(environ=None) -> ChaosPlan:
+    """Build a :class:`ChaosPlan` from ``DDP_TPU_FAULT_*`` env knobs
+    (an empty plan when none are set):
+
+    - ``DDP_TPU_FAULT_REPLICA_CRASH=r1:40``   kill replica r1 at tick 40
+    - ``DDP_TPU_FAULT_HANDOFF_CRASH=r1``      kill r1 mid-KV-handoff
+    - ``DDP_TPU_FAULT_PROBE_BLACKHOLE=r1``    r1 stops answering probes
+    """
+    env = os.environ if environ is None else environ
+
+    def _name(key):
+        v = env.get(key, '').strip()
+        return v or None
+
+    crash = None
+    spec = env.get('DDP_TPU_FAULT_REPLICA_CRASH', '').strip()
+    if spec:
+        name, _, tick = spec.rpartition(':')
+        if not name:
+            raise ValueError(
+                f'DDP_TPU_FAULT_REPLICA_CRASH={spec!r}: expected '
+                f'<replica>:<tick>')
+        crash = (name, int(tick))
+    return ChaosPlan(
+        replica_crash=crash,
+        crash_in_handoff=_name('DDP_TPU_FAULT_HANDOFF_CRASH'),
+        probe_blackhole=_name('DDP_TPU_FAULT_PROBE_BLACKHOLE'),
+    )
+
+
+class ChaosInjector:
+    """Runtime for a :class:`ChaosPlan`. Three hooks, all pure functions
+    of plan + one-shot state (no clock reads — chaos timing arrives as
+    tick indices from the loadgen, so a seeded trace replays the same
+    crash at the same virtual instant every run):
+
+    - :meth:`crash_due` — the loadgen's per-tick hook; returns the name
+      of the replica to kill at this tick (once), else None.
+    - :meth:`crash_on_handoff` — the router asks right after a KV
+      handoff lands on ``target``; True means kill it there.
+    - :meth:`blackholed` — the router's prober asks before counting a
+      probe answer; True means the replica never answers.
+    """
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self._crash_fired = False
+        self._handoff_fired = False
+        self._blackhole_announced = False
+        # Observability sink: the driver points this at the ROUTER's
+        # log — injections land next to the loss/recovery arc they
+        # cause; None falls back to the active log.
+        self.event_log = None
+
+    def crash_due(self, tick):
+        p = self.plan
+        if p.replica_crash is None:
+            return None
+        name, at_tick = p.replica_crash
+        if tick != at_tick or (p.fire_once and self._crash_fired):
+            return None
+        self._crash_fired = True
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='replica_crash', target=name, tick=tick)
+        return name
+
+    def crash_on_handoff(self, target):
+        p = self.plan
+        if p.crash_in_handoff != target \
+                or (p.fire_once and self._handoff_fired):
+            return False
+        self._handoff_fired = True
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='handoff_crash', target=target)
+        return True
+
+    def blackholed(self, name):
+        if self.plan.probe_blackhole != name:
+            return False
+        # Announce the blackhole once; the probe-miss stream itself is
+        # the router's to narrate (replica.probe state=missed).
+        if not self._blackhole_announced:
+            self._blackhole_announced = True
+            obs_events.emit('fault.inject', _log=self.event_log,
+                            kind='probe_blackhole', target=name)
         return True

@@ -1,0 +1,482 @@
+# -*- coding: utf-8 -*-
+"""
+Replica failure domains (ISSUE-16): crash-tolerant disaggregated
+serving with deterministic stream recovery. A decode replica dying
+mid-stream is detected by router liveness probes (never by shared
+memory), every in-flight stream it held is re-dispatched to a
+survivor by replay-prefill from the recovery ledger — bit-identical
+to a crash-free run, TTFT still anchored at the ORIGINAL submit — and
+the whole arc is auditable: the torn victim log merges, every request
+classifies exactly once, and ``obs doctor`` names the dead replica.
+Recovery that cannot happen (no survivor, budget spent) terminates
+with the typed ``REPLICA_LOST`` reject, never a silent drop.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import doctor as obs_doctor
+from distributed_dot_product_tpu.obs import flight as obs_flight
+from distributed_dot_product_tpu.obs.events import EventLog
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+from distributed_dot_product_tpu.serve import (
+    ChaosSchedule, LoadGenConfig, RejectReason, RouterConfig,
+    ServeConfig, TopologyConfig, VirtualClock, build_serving,
+    default_tenants, generate_trace, load_trace, run_trace, save_trace,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    ChaosInjector, ChaosPlan, chaos_plan_from_env,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+
+def _topo(replicas=2, slots=2, t_max=64, page_size=16, vocab=32,
+          **kw):
+    return TopologyConfig(decode_replicas=replicas, slots=slots,
+                          t_max=t_max, page_size=page_size,
+                          vocab=vocab, seed=3, **kw)
+
+
+def _serving(tmp_path, clock, *, chaos=None, replicas=2,
+             threshold=100, queue_limit=8, max_new=6, slots=2,
+             **router_kw):
+    """A serving topology with FAST probes on the virtual clock —
+    loss detection must land inside a test-sized run."""
+    router_kw.setdefault('probe_interval', 0.02)
+    router_kw.setdefault('probe_backoff_max', 0.04)
+    return build_serving(
+        _topo(replicas=replicas, slots=slots),
+        serve_config=ServeConfig(watchdog=False,
+                                 queue_limit=queue_limit,
+                                 max_new_tokens=max_new),
+        router_config=RouterConfig(prefill_threshold=threshold,
+                                   **router_kw),
+        clock=clock, log_dir=tmp_path / 'logs', chaos=chaos)
+
+
+def _settle(router, clock, dt=0.01, max_ticks=5000):
+    """run_until_idle with the clock ADVANCING: probe deadlines are
+    virtual-time, so a static clock would never detect a loss."""
+    ticks = 0
+    while router.step():
+        clock.advance(dt)
+        ticks += 1
+        assert ticks < max_ticks, 'topology never settled'
+    return router.results
+
+
+def _prompts(n, length=6):
+    return {f'p{i}': list(((np.arange(length) * 3 + i) % 32) + 1)
+            for i in range(n)}
+
+
+def _member(router, name):
+    return next(r for r in router.pool.replicas if r.name == name)
+
+
+def _events(router, name='router'):
+    return list(obs.read_events(dict(router.pool.logs())[name]))
+
+
+# -- the tentpole arc: kill -> probe -> recover, bit-identical ----------
+
+def test_crash_recovery_bit_identical_and_torn_log_merges(tmp_path,
+                                                          devices):
+    """ISSUE-16 acceptance in miniature: kill one of two replicas with
+    streams in flight. Probes declare the loss, the ledger re-places
+    every in-flight stream on the survivor, each recovered stream is
+    BIT-IDENTICAL to a crash-free single-replica run of the same
+    prompts, and every request reconstructs exactly once across the
+    merged logs — the victim's torn tail included."""
+    prompts = _prompts(4)
+
+    # Crash-free twin: same engine seed, same prompts, one replica.
+    clock_twin = VirtualClock()
+    twin = _serving(tmp_path / 'twin', clock_twin, replicas=1)
+    try:
+        for rid, p in prompts.items():
+            twin.submit(p, request_id=rid)
+        base = twin.run_until_idle()
+    finally:
+        twin.close()
+    assert all(base[rid].status == 'completed' for rid in prompts)
+
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock)
+    try:
+        for rid, p in prompts.items():
+            router.submit(p, request_id=rid)
+        for _ in range(2):          # streams decoding on BOTH members
+            router.step()
+            clock.advance(0.01)
+        victims = [rid for rid, e in router._ledger.items()
+                   if e['replica'] == 'r1']
+        assert victims, 'least-loaded placement left r1 empty'
+        _member(router, 'r1').kill()   # the process is gone, router
+        results = _settle(router, clock)   # ...finds out by probing
+    finally:
+        router.close()
+
+    assert [r.name for r in router.pool.replicas] == ['r0']
+    assert [r.name for r in router.pool.lost] == ['r1']
+    counters = router.registry.snapshot()['counters']
+    assert counters['router.replicas_lost'] == 1
+    assert counters['router.recovered'] == len(victims)
+
+    # Every stream completed, and recovered ones equal the twin's.
+    for rid in prompts:
+        assert results[rid].status == 'completed', results[rid]
+        assert results[rid].tokens == base[rid].tokens, rid
+
+    revs = _events(router)
+    lost = [r for r in revs if r['event'] == 'replica.lost']
+    assert len(lost) == 1 and lost[0]['target'] == 'r1'
+    assert lost[0]['reason'] == 'probe_timeout'
+    assert lost[0]['in_flight'] == len(victims)
+    recovered = {r['request_id'] for r in revs
+                 if r['event'] == 'request.recovered'
+                 and r['requeued']}
+    assert recovered == set(victims)
+    assert any(r['event'] == 'replica.probe'
+               and r['state'] == 'missed' for r in revs)
+
+    # The victim's log is TORN — kill() left a half-written record —
+    # yet it still reads, and the merged reconstruction classifies
+    # every request exactly once with a complete arc.
+    victim_path = dict(router.pool.logs())['r1']
+    with open(victim_path, encoding='utf-8') as fh:
+        tail = fh.read().rsplit('\n', 1)[-1]
+    assert tail == '{"schema":2,"seq":'
+    assert list(obs.read_events(victim_path))   # tolerated, not fatal
+    tls = reconstruct(router.pool.logs())
+    assert set(tls) == set(prompts)
+    for rid, tl in tls.items():
+        assert tl.complete, (rid, tl.errors)
+        assert tl.recoveries == (1 if rid in recovered else 0)
+
+
+def test_recovered_ttft_anchored_at_original_submit(tmp_path, devices):
+    """The recovery ledger preserves ``submitted_at``: a recovered
+    stream's TTFT is measured from the ORIGINAL submit, not from the
+    re-dispatch — recovery does not launder latency."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, max_new=8)
+    try:
+        router.submit(list(range(1, 7)), request_id='v')
+        router.step()
+        clock.advance(1.0)          # a full virtual second passes...
+        victim = router._ledger['v']['replica']
+        _member(router, victim).kill()
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    assert results['v'].status == 'completed'
+    tl = reconstruct(router.pool.logs())['v']
+    assert tl.complete and tl.recoveries == 1
+    # ...so the delivered TTFT must carry it. A re-dispatch anchor
+    # would report ~0.1s here.
+    assert tl.ttft is not None and tl.ttft >= 1.0, tl.ttft
+
+
+def test_recovery_budget_spent_is_a_typed_terminal(tmp_path, devices):
+    """``max_recoveries=0``: the in-flight stream on the dead replica
+    terminates as a typed REPLICA_LOST reject — accounted in
+    ``results``, complete in the timeline, never silently dropped."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, max_recoveries=0)
+    try:
+        for rid, p in _prompts(2).items():
+            router.submit(p, request_id=rid)
+        router.step()
+        victims = [rid for rid, e in router._ledger.items()
+                   if e['replica'] == 'r1']
+        assert victims
+        _member(router, 'r1').kill()
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    for rid in victims:
+        rr = results[rid]
+        assert rr.status == 'rejected'
+        assert rr.reason is RejectReason.REPLICA_LOST
+    counters = router.registry.snapshot()['counters']
+    assert counters[
+        'router.rejected.replica_lost{tenant=default}'] == len(victims)
+    revs = _events(router)
+    assert {r['request_id'] for r in revs
+            if r['event'] == 'request.recovered'
+            and not r['requeued']} == set(victims)
+    tls = reconstruct(router.pool.logs())
+    for rid in victims:
+        assert tls[rid].complete, tls[rid].errors
+        assert tls[rid].status == 'rejected'
+        assert tls[rid].reason == 'replica_lost'
+
+
+def test_no_survivor_is_a_typed_terminal(tmp_path, devices):
+    """The LAST replica dying has nowhere to recover to — same typed
+    terminal, regardless of the recovery budget."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, replicas=1)
+    try:
+        router.submit(list(range(1, 7)), request_id='solo')
+        router.step()
+        _member(router, 'r0').kill()
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    assert results['solo'].status == 'rejected'
+    assert results['solo'].reason is RejectReason.REPLICA_LOST
+    assert router.pool.replicas == []
+
+
+# -- the other two chaos seams ------------------------------------------
+
+def test_handoff_crash_falls_back_to_a_survivor(tmp_path, devices):
+    """A replica dying DURING the prefill->decode handoff (pages
+    adopted, stream never admitted): the router declares the loss
+    inline and re-places the request on a survivor in the same
+    submit — the caller never sees the crash."""
+    chaos = ChaosInjector(ChaosPlan(crash_in_handoff='r0'))
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, chaos=chaos, threshold=4)
+    prompt = list((np.arange(18) * 3 + 1) % 31 + 1)
+    try:
+        router.submit(prompt, request_id='h')
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    assert results['h'].status == 'completed'
+    assert [r.name for r in router.pool.replicas] == ['r1']
+    revs = _events(router)
+    lost = [r for r in revs if r['event'] == 'replica.lost']
+    assert len(lost) == 1 and lost[0]['target'] == 'r0'
+    assert lost[0]['reason'] == 'handoff_crash'
+    assert any(r['event'] == 'fault.inject'
+               and r['kind'] == 'handoff_crash' for r in revs)
+    assert reconstruct(router.pool.logs())['h'].complete
+
+
+def test_probe_blackhole_declares_loss(tmp_path, devices):
+    """A replica that stops ANSWERING (process alive, network dead)
+    is indistinguishable from a dead one at the router — the probe
+    timeout path declares it lost and recovery proceeds."""
+    chaos = ChaosInjector(ChaosPlan(probe_blackhole='r1'))
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock, chaos=chaos)
+    try:
+        for rid, p in _prompts(4).items():
+            router.submit(p, request_id=rid)
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    assert all(r.status == 'completed' for r in results.values())
+    revs = _events(router)
+    lost = [r for r in revs if r['event'] == 'replica.lost']
+    assert len(lost) == 1 and lost[0]['target'] == 'r1'
+    assert lost[0]['reason'] == 'probe_timeout'
+    assert any(r['event'] == 'fault.inject'
+               and r['kind'] == 'probe_blackhole' for r in revs)
+
+
+def test_rejoin_after_loss_restores_capacity(tmp_path, devices):
+    """``rejoin_replica`` after a loss: a FRESH member (never a name
+    reuse) joins, the rejoin is audited, and it serves."""
+    clock = VirtualClock()
+    router = _serving(tmp_path, clock)
+    try:
+        router.mark_lost('r1', reason='crash')
+        fresh = router.rejoin_replica()
+        assert fresh.name not in ('r0', 'r1')
+        assert len(router.pool.replicas) == 2
+        for rid, p in _prompts(4).items():
+            router.submit(p, request_id=rid)
+        results = _settle(router, clock)
+    finally:
+        router.close()
+    assert all(r.status == 'completed' for r in results.values())
+    rejoins = [r for r in _events(router)
+               if r['event'] == 'replica.rejoin']
+    assert len(rejoins) == 1
+    assert rejoins[0]['target'] == fresh.name
+    assert rejoins[0]['replicas'] == 2
+    counters = router.registry.snapshot()['counters']
+    assert any(k.startswith('router.routed{replica=' + fresh.name)
+               for k in counters)
+
+
+# -- seeded chaos replays bit-identically -------------------------------
+
+def test_chaos_schedule_replays_bit_identically(tmp_path, devices):
+    """The same seeded trace + the same ChaosPlan replay the crash at
+    the same virtual instant: two independent runs produce identical
+    results, tick counts, and recovery sets."""
+    cfg = LoadGenConfig(seed=7, rate=400.0, requests=12, vocab=32,
+                        tenants=default_tenants(2), tick_seconds=0.01)
+    trace_path = tmp_path / 'trace.json'
+    save_trace(trace_path, generate_trace(cfg))
+
+    def run(tag):
+        chaos = ChaosInjector(ChaosPlan(replica_crash=('r1', 8)))
+        clock = VirtualClock()
+        router = _serving(tmp_path / tag, clock, chaos=chaos,
+                          max_new=24)
+        sched = ChaosSchedule(chaos, router)
+        try:
+            res = run_trace(router, load_trace(trace_path), clock,
+                            tick_seconds=cfg.tick_seconds,
+                            on_tick=sched)
+        finally:
+            router.close()
+        recovered = sorted(
+            r['request_id'] for r in _events(router)
+            if r['event'] == 'request.recovered' and r['requeued'])
+        return res, sched, recovered
+
+    res_a, sched_a, rec_a = run('a')
+    res_b, sched_b, rec_b = run('b')
+    assert sched_a.killed == sched_b.killed == ['r1']
+    assert res_a.accounted and res_b.accounted
+    assert rec_a == rec_b and rec_a, 'crash missed the busy window'
+    assert res_a.ticks == res_b.ticks
+    assert ({rid: (rr.status, tuple(rr.tokens))
+             for rid, rr in res_a.results.items()}
+            == {rid: (rr.status, tuple(rr.tokens))
+                for rid, rr in res_b.results.items()})
+
+
+# -- audit surfaces: flight, doctor, events, timeline -------------------
+
+def test_replica_loss_auto_dumps_flight_bundle(tmp_path, devices):
+    """A replica loss is a postmortem moment: the ROUTER dumps the
+    armed flight recorder with trigger ``replica_lost`` — no operator
+    in the loop."""
+    with obs_flight.recording(base_dir=tmp_path / 'flight',
+                              registry=MetricsRegistry()) as rec:
+        clock = VirtualClock()
+        router = _serving(tmp_path, clock)
+        try:
+            for rid, p in _prompts(2).items():
+                router.submit(p, request_id=rid)
+            router.step()
+            _member(router, 'r1').kill()
+            _settle(router, clock)
+        finally:
+            router.close()
+        dumps = [d for d in rec.dumps if d['trigger'] == 'replica_lost']
+    assert len(dumps) == 1
+    bundle = obs_flight.load_bundle(dumps[0]['path'])
+    assert any(r.get('event') == 'replica.lost'
+               for r in bundle.get('events', []))
+
+
+def test_doctor_classifies_replica_loss_naming_the_dead(tmp_path):
+    """The ``replica_loss`` incident class wins on loss evidence and
+    the verdict names the DEAD replica — even when the bundle itself
+    came from the router."""
+    reg = MetricsRegistry()
+    with obs_flight.recording(base_dir=tmp_path / 'flight',
+                              registry=reg) as rec:
+        log = obs.EventLog(tmp_path / 'ev.jsonl')
+        log.emit('fault.inject', kind='replica_crash', target='r1',
+                 tick=40)
+        log.emit('replica.probe', target='r1', state='missed',
+                 misses=3)
+        log.emit('replica.lost', target='r1', reason='probe_timeout',
+                 in_flight=2)
+        log.emit('request.recovered', request_id='a',
+                 from_replica='r1', requeued=True)
+        log.emit('request.recovered', request_id='b',
+                 from_replica='r1', requeued=False)
+        log.emit('serve.reject', request_id='b',
+                 reason='replica_lost', tenant='t0', queued=True)
+        log.close()
+        path = rec.dump_bundle(trigger='replica_lost')
+    incident = obs_doctor.diagnose(obs_flight.load_bundle(path))
+    assert incident.primary == 'replica_loss'
+    assert incident.replica == 'r1'
+    out = obs_doctor.render_incident(incident)
+    assert 'replica_loss' in out and 'r1' in out
+
+
+def test_new_event_schemas_are_enforced(tmp_path):
+    """The four failure-domain events validate like every other
+    schema-2 event: all required fields or an immediate raise."""
+    log = EventLog(tmp_path / 'ev.jsonl')
+    log.emit('replica.lost', target='r1', reason='crash', in_flight=0)
+    log.emit('replica.probe', target='r1', state='missed', misses=1)
+    log.emit('replica.rejoin', target='r2', replicas=2)
+    log.emit('request.recovered', request_id='a', from_replica='r1',
+             requeued=True)
+    for ev, kw in [
+        ('replica.lost', {'target': 'r1', 'reason': 'crash'}),
+        ('replica.probe', {'target': 'r1'}),
+        ('replica.rejoin', {}),
+        ('request.recovered', {'request_id': 'a', 'requeued': True}),
+    ]:
+        with pytest.raises(ValueError):
+            log.emit(ev, **kw)
+    log.close()
+    assert len(list(obs.read_events(log.path))) == 4
+
+
+def test_timeline_recovery_arcs():
+    """The lifecycle automaton's two recovery arcs: recovered →
+    re-admit → complete (requeued) and recovered → typed reject
+    (terminal). Both CLOSE the arc; the delivered latency restarts."""
+    def tl_of(recs):
+        for i, r in enumerate(recs):
+            r.setdefault('seq', i)
+            r.setdefault('ts', float(i))
+            r.setdefault('schema', 2)
+        return reconstruct(recs)
+
+    tls = tl_of([
+        {'event': 'serve.admit', 'request_id': 'a', 'slot': 0,
+         'queue_wait': 0.1},
+        {'event': 'serve.decode', 'request_id': 'a', 'slot': 0,
+         'token_index': 0, 'ttft': 0.5},
+        {'event': 'request.recovered', 'request_id': 'a',
+         'from_replica': 'r1', 'requeued': True},
+        {'event': 'serve.admit', 'request_id': 'a', 'slot': 1,
+         'queue_wait': 0.2},
+        {'event': 'serve.decode', 'request_id': 'a', 'slot': 1,
+         'token_index': 0, 'ttft': 2.1},
+        {'event': 'serve.retire', 'request_id': 'a',
+         'status': 'completed', 'total_seconds': 2.5},
+    ])
+    tl = tls['a']
+    assert tl.complete, tl.errors
+    assert tl.recoveries == 1 and tl.admits == 2
+    # The crashed attempt's stream died with the replica: the
+    # DELIVERED latency is the survivor's (still original-anchored).
+    assert tl.ttft == 2.1
+
+    tls = tl_of([
+        {'event': 'serve.admit', 'request_id': 'b', 'slot': 0,
+         'queue_wait': 0.0},
+        {'event': 'request.recovered', 'request_id': 'b',
+         'from_replica': 'r1', 'requeued': False},
+        {'event': 'serve.reject', 'request_id': 'b',
+         'reason': 'replica_lost', 'tenant': 't0', 'queued': True},
+    ])
+    tl = tls['b']
+    assert tl.complete, tl.errors
+    assert tl.status == 'rejected' and tl.reason == 'replica_lost'
+    assert tl.recoveries == 1
+
+
+def test_chaos_plan_from_env():
+    plan = chaos_plan_from_env({
+        'DDP_TPU_FAULT_REPLICA_CRASH': 'r1:40',
+        'DDP_TPU_FAULT_HANDOFF_CRASH': 'r0',
+        'DDP_TPU_FAULT_PROBE_BLACKHOLE': 'r2',
+    })
+    assert plan.replica_crash == ('r1', 40)
+    assert plan.crash_in_handoff == 'r0'
+    assert plan.probe_blackhole == 'r2'
+    assert plan.any()
+    assert not chaos_plan_from_env({}).any()
+    with pytest.raises(ValueError, match='REPLICA_CRASH'):
+        chaos_plan_from_env({'DDP_TPU_FAULT_REPLICA_CRASH': '40'})
