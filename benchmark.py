@@ -249,6 +249,29 @@ def parse_args():
     parser.add_argument('--chaos-tick', type=int, default=40,
                         help='--chaos: loadgen tick (virtual time '
                              'coordinate) at which the victim dies')
+    parser.add_argument('--chaos-corrupt', default=None,
+                        metavar='PAGE:TICK',
+                        help='--topology: seeded KV-corruption chaos '
+                             'row — flip one bit in tracked page index '
+                             'PAGE of --chaos-victim at tick TICK, '
+                             'assert every flip is detected before any '
+                             'poisoned token is emitted and the victim '
+                             'streams heal bit-identical to the '
+                             'crash-free twin, then run the SAME flip '
+                             'against a checksums-off twin to count '
+                             'the silent wrong streams integrity '
+                             'prevents; the row records the detection/'
+                             'heal ledger, verify-time cost and both '
+                             'goodputs')
+    parser.add_argument('--chaos-prefill-crash', type=int, default=None,
+                        metavar='TICK',
+                        help='--topology: kill the prefill pool at '
+                             'tick TICK mid-trace — the router probes '
+                             'it like a replica, declares prefill.lost '
+                             'and falls back to flat prefill on the '
+                             'decode replicas (no stream blocks, every '
+                             'stream classified); the row records the '
+                             'fallback accounting')
     parser.add_argument('--no-ttft', action='store_true',
                         help='decode mode: skip the time-to-first-token '
                              'prefill-latency row (it compiles a full '
@@ -1320,28 +1343,59 @@ def run_serve_load_topology(args):
         heads=args.heads, head_dim=args.head_dim, seed=0,
         decode_impl=decode_impl)
     router_cfg = RouterConfig(prefill_threshold=args.prefill_threshold)
+    chaos_any = (args.chaos or args.chaos_corrupt
+                 or args.chaos_prefill_crash is not None)
     chaos = chaos_plan = flight_rec = flight_prev = None
-    if args.chaos:
+    corrupt_page = corrupt_tick = None
+    if chaos_any:
         from distributed_dot_product_tpu.obs import flight as obs_flight
         from distributed_dot_product_tpu.serve import ChaosSchedule
         from distributed_dot_product_tpu.utils.faults import (
             ChaosInjector, ChaosPlan,
         )
-        if decode_replicas < 2:
-            raise SystemExit(f'--chaos kills one decode replica '
-                             f'mid-trace: the topology needs >= 2 for '
-                             f'a survivor, got {args.topology}')
         # Fast probe cadence on the virtual clock: the loss must be
         # declared (and recovery land) inside the trace's own virtual
         # window, not long after the survivors drained.
         router_cfg = dataclasses.replace(
             router_cfg, probe_interval=0.01, probe_backoff_max=0.02)
-        chaos_plan = ChaosPlan(
-            replica_crash=(args.chaos_victim, args.chaos_tick))
+        plan_kw = {}
+        if args.chaos:
+            if decode_replicas < 2:
+                raise SystemExit(f'--chaos kills one decode replica '
+                                 f'mid-trace: the topology needs >= 2 '
+                                 f'for a survivor, got {args.topology}')
+            plan_kw['replica_crash'] = (args.chaos_victim,
+                                        args.chaos_tick)
+        if args.chaos_corrupt:
+            try:
+                page_s, tick_s = args.chaos_corrupt.split(':')
+                corrupt_page, corrupt_tick = int(page_s), int(tick_s)
+            except ValueError:
+                raise SystemExit(f'--chaos-corrupt wants PAGE:TICK, '
+                                 f'got {args.chaos_corrupt!r}')
+            if decode_replicas < 2:
+                raise SystemExit(f'--chaos-corrupt heals the victim '
+                                 f'streams on a CLEAN replica: the '
+                                 f'topology needs >= 2, got '
+                                 f'{args.topology}')
+            plan_kw['page_corrupt'] = (args.chaos_victim, corrupt_page,
+                                       corrupt_tick)
+            # Scrub every tick: detection latency must be one tick,
+            # never a token (transfer/attach sites verify regardless).
+            router_cfg = dataclasses.replace(
+                router_cfg, integrity_interval=0.0)
+        if args.chaos_prefill_crash is not None:
+            if not prefill_pools:
+                raise SystemExit('--chaos-prefill-crash kills the '
+                                 'prefill pool: the topology needs '
+                                 'P=1 (e.g. 1x2), got '
+                                 f'{args.topology}')
+            plan_kw['prefill_crash'] = args.chaos_prefill_crash
+        chaos_plan = ChaosPlan(**plan_kw)
         chaos = ChaosInjector(chaos_plan)
-        # The black box armed for the whole recovery run: the router's
-        # replica_lost trigger auto-dumps a bundle the moment it
-        # declares the loss.
+        # The black box armed for the whole run: the router's
+        # replica_lost / kv_corrupt / prefill_lost triggers auto-dump
+        # a bundle the moment the fault is declared.
         flight_rec = obs_flight.FlightRecorder(
             os.path.join(log_dir, 'flight'))
         flight_prev = obs_flight.install(flight_rec)
@@ -1361,8 +1415,10 @@ def run_serve_load_topology(args):
                 max_replicas=args.control_max_replicas),
             clock=clock, event_log=router.event_log)
     on_tick = controller.tick if controller else None
+    chaos_sched = None
     if chaos is not None:
-        on_tick = ChaosSchedule(chaos, router, on_tick=on_tick)
+        on_tick = chaos_sched = ChaosSchedule(chaos, router,
+                                              on_tick=on_tick)
     try:
         with span('benchmark.serve_load_topology', seed=args.load_seed,
                   topology=args.topology):
@@ -1525,6 +1581,151 @@ def run_serve_load_topology(args):
             'norec_replica_lost_rejects': norec_lost,
         }
 
+    corrupt_extra = {}
+    if args.chaos_corrupt:
+        # -- what the integrity layer actually did (router log) --------
+        revents = list(obs.read_events(dict(sources)['router']))
+        corrupt_events = [r for r in revents
+                          if r.get('event') == 'kv.corrupt']
+        injected = [r for r in revents
+                    if r.get('event') == 'fault.inject'
+                    and r.get('kind') == 'page_corrupt']
+        healed = [r['request_id'] for r in revents
+                  if r.get('event') == 'request.recovered'
+                  and r.get('reason') == 'kv_corrupt'
+                  and r.get('requeued')]
+        corrupt_rejects = [r['request_id'] for r in revents
+                           if r.get('event') == 'request.recovered'
+                           and r.get('reason') == 'kv_corrupt'
+                           and not r.get('requeued')]
+        if not chaos_sched.corrupted:
+            raise SystemExit(
+                f'chaos-corrupt: the bit flip never landed (no '
+                f'tracked page on {args.chaos_victim} from tick '
+                f'{corrupt_tick} of {res.ticks}) — move the tick into '
+                f'the busy part of the trace or lower '
+                f'--prefill-threshold')
+        if not corrupt_events:
+            raise SystemExit(
+                f'chaos-corrupt: {len(chaos_sched.corrupted)} flip(s) '
+                f'landed but NO kv.corrupt verdict was declared — the '
+                f'checksum verification path is broken')
+        if not flight_rec.dumps:
+            raise SystemExit('chaos-corrupt: the corruption produced '
+                             'no flight bundle (trigger kv_corrupt)')
+        # -- zero silent wrong tokens: greedy streams are prompt-pure,
+        # so EVERY delivered token must match the crash-free twin's
+        # stream PREFIX — whatever either run's terminal was (an
+        # evicted/expired stream's delivered tokens are still
+        # delivered). A single divergence means a poisoned page
+        # decoded into a delivered token.
+        compared, mismatched = 0, []
+        for rid, a in res.results.items():
+            b = res_twin.results.get(rid)
+            if b is None:
+                continue
+            n = min(len(a.tokens), len(b.tokens))
+            if n:
+                compared += 1
+                if list(a.tokens)[:n] != list(b.tokens)[:n]:
+                    mismatched.append(rid)
+        if mismatched:
+            raise SystemExit(
+                f'chaos-corrupt: {len(mismatched)} completed '
+                f'stream(s) diverged from the crash-free twin: '
+                f'{mismatched[:5]} — a corrupted page leaked into a '
+                f'delivered token')
+        # Verify-time cost, summed across every engine that digested
+        # (the row's price-of-integrity column).
+        verify_seconds = sum(r.engine.verify_seconds
+                             for r in router.pool.replicas)
+        if router.pool.prefill is not None:
+            verify_seconds += router.pool.prefill.engine.verify_seconds
+        # -- the no-integrity twin: SAME topology, SAME trace, SAME
+        # flip, kv_checksums=False — whatever completes WRONG there is
+        # exactly what the checksum layer is worth.
+        nointeg_dir = os.path.join(log_dir, 'nointeg')
+        os.makedirs(nointeg_dir, exist_ok=True)
+        for name in ['router'] + (['prefill'] if prefill_pools else []):
+            obs.remove_log(os.path.join(nointeg_dir, f'{name}.jsonl'))
+        for stale in glob.glob(os.path.join(nointeg_dir,
+                                            'r[0-9]*.jsonl')):
+            obs.remove_log(stale)
+        nointeg_chaos = ChaosInjector(chaos_plan)
+        clock_ni = VirtualClock()
+        router_ni = build_serving(
+            dataclasses.replace(topo, kv_checksums=False),
+            serve_config=dataclasses.replace(twin_cfg),
+            router_config=dataclasses.replace(
+                router_cfg, integrity_interval=None),
+            clock=clock_ni, log_dir=nointeg_dir, chaos=nointeg_chaos)
+        nointeg_sched = ChaosSchedule(nointeg_chaos, router_ni)
+        try:
+            res_ni = run_trace(router_ni, load_trace(trace_path),
+                               clock_ni, tick_seconds=cfg.tick_seconds,
+                               on_tick=nointeg_sched)
+        finally:
+            router_ni.close()
+        report_ni = obs_slo.goodput(router_ni.pool.logs(), spec)
+        if not nointeg_sched.corrupted:
+            raise SystemExit('chaos-corrupt: the flip landed in the '
+                             'integrity run but not in the '
+                             'no-integrity twin — the comparison is '
+                             'meaningless')
+        # Silently wrong = delivered tokens diverging from the twin
+        # stream's prefix (same prefix-pure comparison as above: the
+        # terminal class does not launder a poisoned token).
+        wrong = []
+        for rid, a in res_ni.results.items():
+            b = res_twin.results.get(rid)
+            if b is None:
+                continue
+            n = min(len(a.tokens), len(b.tokens))
+            if n and list(a.tokens)[:n] != list(b.tokens)[:n]:
+                wrong.append(rid)
+        wrong.sort()
+        corrupt_extra = {
+            'chaos_corrupt': {'victim': args.chaos_victim,
+                              'page': corrupt_page,
+                              'tick': corrupt_tick},
+            'corruptions_injected': len(chaos_sched.corrupted),
+            'corruptions_detected': len(corrupt_events),
+            'corrupt_sites': sorted({str(r.get('site'))
+                                     for r in corrupt_events}),
+            'corrupt_pages': sorted({int(p) for r in corrupt_events
+                                     for p in (r.get('pages') or [])}),
+            'corrupt_inject_events': len(injected),
+            'corrupt_healed': sorted(healed),
+            'corrupt_rejects': sorted(corrupt_rejects),
+            'corrupt_compared': compared,
+            'corrupt_bitident': compared > 0 and not mismatched,
+            'verify_seconds': verify_seconds,
+            'flight_bundle': flight_rec.dumps[-1]['path'],
+            'nointeg_goodput_pct': report_ni.goodput_pct,
+            'nointeg_counts': report_ni.counts,
+            'nointeg_wrong_streams': wrong,
+        }
+
+    prefill_extra = {}
+    if args.chaos_prefill_crash is not None:
+        revents = list(obs.read_events(dict(sources)['router']))
+        plost = [r for r in revents
+                 if r.get('event') == 'prefill.lost']
+        if not plost:
+            raise SystemExit(
+                f'chaos-prefill-crash: killing the prefill pool at '
+                f'tick {args.chaos_prefill_crash} never became a '
+                f'prefill.lost declaration — the probe path is broken')
+        if router.pool.prefill is not None:
+            raise SystemExit('chaos-prefill-crash: the router still '
+                             'holds a live prefill pool after the '
+                             'loss was declared')
+        prefill_extra = {
+            'chaos_prefill_crash': {'tick': args.chaos_prefill_crash},
+            'prefill_lost': [r.get('target') for r in plost],
+            'prefill_lost_reason': plost[-1].get('reason'),
+        }
+
     counters = router.registry.snapshot()['counters']
     routed = {}
     for key, n in counters.items():
@@ -1569,6 +1770,34 @@ def run_serve_load_topology(args):
         'replicas_final': len(router.pool.replicas),
     }
     record.update(chaos_extra)
+    record.update(corrupt_extra)
+    record.update(prefill_extra)
+    if args.chaos_corrupt:
+        print(f"chaos-corrupt[{args.chaos_victim} page {corrupt_page}"
+              f"@tick {corrupt_tick}]: "
+              f"{corrupt_extra['corruptions_injected']} flip(s) "
+              f"injected, {corrupt_extra['corruptions_detected']} "
+              f"kv.corrupt verdict(s) at "
+              f"{corrupt_extra['corrupt_sites']}, "
+              f"{len(corrupt_extra['corrupt_healed'])} victim(s) "
+              f"healed + {len(corrupt_extra['corrupt_rejects'])} typed "
+              f"kv_corrupt terminal(s), "
+              f"{corrupt_extra['corrupt_compared']} completed streams "
+              f"bit-identical to the twin; verify cost "
+              f"{corrupt_extra['verify_seconds'] * 1e3:.1f}ms; goodput "
+              f"with integrity {report.goodput_pct:.1f}% vs "
+              f"no-integrity twin "
+              f"{corrupt_extra['nointeg_goodput_pct']:.1f}% "
+              f"({len(corrupt_extra['nointeg_wrong_streams'])} "
+              f"SILENTLY WRONG stream(s) there); flight bundle "
+              f"{corrupt_extra['flight_bundle']}")
+    if args.chaos_prefill_crash is not None:
+        print(f"chaos-prefill[tick {args.chaos_prefill_crash}]: "
+              f"{prefill_extra['prefill_lost']} declared lost "
+              f"({prefill_extra['prefill_lost_reason']}); every later "
+              f"long prompt served by flat prefill "
+              f"({record.get('handoffs', 0)} handoffs before the "
+              f"loss); goodput {report.goodput_pct:.1f}%")
     if args.chaos:
         print(f"chaos[{args.chaos_victim}@tick {args.chaos_tick}]: "
               f"{len(chaos_extra['recovered'])} stream(s) recovered "

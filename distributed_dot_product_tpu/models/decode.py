@@ -45,6 +45,7 @@ RESULTS.md "KV-cache decode").
 """
 
 import math
+import zlib
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -59,7 +60,7 @@ __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'decode_attention', 'init_slot_cache', 'append_kv_slots',
            'reset_slot', 'slots_all_finite', 'decode_step',
            'decode_kernel_eligible', 'rollback_slots',
-           'PagedDecodeCache', 'PagePool',
+           'PagedDecodeCache', 'PagePool', 'PageChecksums',
            'init_paged_cache', 'paged_gather', 'paged_gather_mirror',
            'paged_append_kv_slots',
            'paged_append_rows', 'paged_reset_slot',
@@ -909,6 +910,7 @@ class PagePool:
         self.counts = np.zeros(slots, np.int32)       # pages per slot
         self.lengths = np.zeros(slots, np.int64)      # fill per slot
         self.dirty = False          # table changed since last mirror
+        self.quarantined = set()    # pages withdrawn from circulation
 
     # -- introspection --------------------------------------------------
     @property
@@ -947,9 +949,28 @@ class PagePool:
     def _unref(self, page):
         self.refcount[page] -= 1
         if self.refcount[page] == 0:
-            self._free.append(page)
+            # A quarantined page never re-enters the free list: the
+            # owner still zeroes it (True), but it stays withdrawn.
+            if page not in self.quarantined:
+                self._free.append(page)
             return True
         return False
+
+    def quarantine(self, pages):
+        """Withdraw ``pages`` from circulation permanently (corruption
+        verdict): free pages leave the free list now, referenced pages
+        are withheld by :meth:`_unref` when their last reference drops.
+        Returns the pages newly quarantined (idempotent)."""
+        fresh = []
+        for page in pages:
+            page = int(page)
+            if page in self.quarantined:
+                continue
+            self.quarantined.add(page)
+            if self.refcount[page] == 0 and page in self._free:
+                self._free.remove(page)
+            fresh.append(page)
+        return fresh
 
     def alloc_block(self, n):
         """Allocate ``n`` fresh pages as one unit (prefix
@@ -1139,6 +1160,91 @@ class PagePool:
         pages = [int(self.table[src, i])
                  for i in range(int(self.counts[src]))]
         return self.attach(dst, pages, length)
+
+
+class PageChecksums:
+    """Host-side per-page integrity table for a
+    :class:`PagedDecodeCache`: CRC32 over a page's K and V rows (plus
+    the int8 K-mirror rows when the cache carries one), recorded at
+    TRANSFER boundaries only — registry fills, prefill→decode slab
+    handoff, ``adopt_prefix``, recovery replay. Pure numpy/zlib over
+    host copies of the device pages; nothing here ever enters a
+    compiled program, so graphlint/determlint/perf baselines are
+    untouched by construction.
+
+    Coverage is deliberately registry-only: a slot's PRIVATE append
+    pages mutate every decode step and could only be covered by
+    per-step digests — exactly the cost the "verify at transfer, never
+    per step" contract forbids. Registered prefix pages are immutable
+    once filled (CoW guarantees divergent appends land on fresh
+    pages), so a digest recorded at fill time stays valid for the
+    page's whole tracked life.
+
+    The digest is a ``(kv_crc, mirror_crc)`` pair; ``mirror_crc`` is 0
+    for mirror-less caches. Cross-cache comparison (handoff source vs
+    destination) must compare ``kv_crc`` alone:
+    :func:`paged_transfer_pages` re-quantizes the destination mirror
+    from the adopted K and seeds unfilled tail rows with the eps
+    scale, so mirror bytes legitimately differ across caches."""
+
+    def __init__(self):
+        self._crc = {}              # page -> (kv_crc, mirror_crc)
+
+    def __contains__(self, page):
+        return int(page) in self._crc
+
+    def __len__(self):
+        return len(self._crc)
+
+    def pages(self):
+        """Tracked pages, sorted (deterministic iteration order)."""
+        return sorted(self._crc)
+
+    @staticmethod
+    def digest(cache, page):
+        """Compute ``page``'s ``(kv_crc, mirror_crc)`` from the live
+        cache buffers. One host transfer per pool slice; called only
+        at transfer boundaries."""
+        page = int(page)
+        crc = zlib.crc32(np.asarray(cache.k_pool[page]).tobytes())
+        crc = zlib.crc32(np.asarray(cache.v_pool[page]).tobytes(), crc)
+        mirror = 0
+        if cache.k_q_pool is not None:
+            mirror = zlib.crc32(
+                np.asarray(cache.k_q_pool[page]).tobytes())
+            mirror = zlib.crc32(
+                np.asarray(cache.k_scale_pool[page]).tobytes(), mirror)
+        return crc, mirror
+
+    def record(self, cache, pages):
+        """(Re)digest ``pages`` from ``cache`` and remember the result
+        — the page's content is declared canonical as of now."""
+        for page in pages:
+            self._crc[int(page)] = self.digest(cache, page)
+
+    def get(self, page):
+        return self._crc.get(int(page))
+
+    def drop(self, pages):
+        """Forget digests for pages leaving the tracked set (prefix
+        unregistration / pool zeroing)."""
+        for page in pages:
+            self._crc.pop(int(page), None)
+
+    def verify(self, cache, pages=None):
+        """Re-digest ``pages`` (default: every tracked page) against
+        the recorded values. Returns the sorted list of mismatching
+        pages — empty means clean. Unrecorded pages are skipped, not
+        failures (private append pages are out of coverage)."""
+        if pages is None:
+            pages = self.pages()
+        bad = []
+        for page in pages:
+            page = int(page)
+            want = self._crc.get(page)
+            if want is not None and self.digest(cache, page) != want:
+                bad.append(page)
+        return sorted(bad)
 
 
 def _paged_mirror_fixup(cache: PagedDecodeCache, k_new, ap, nvec):

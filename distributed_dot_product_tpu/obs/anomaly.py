@@ -339,6 +339,9 @@ def default_watches(*, queue_limit=None, paged=False,
     - ``pages_free`` (paged engines): static threshold below 1 —
       pool exhaustion (chains a flight dump).
     - ``reject_rate``: EWMA z-score on the summed typed-reject rate.
+    - ``kv_corrupt``: static threshold on the router's corruption
+      counter — ANY checksum-failed page chains a flight dump (the
+      post-mortem bundle is how the doctor attributes the verdict).
     """
     watches = [
         Watch(name='ttft_p99', metric='serve.ttft_seconds',
@@ -355,6 +358,9 @@ def default_watches(*, queue_limit=None, paged=False,
         Watch(name='reject_rate', metric='serve.rejected',
               signal='fn', fn=_reject_total, rate=True,
               detector=EwmaZScore(z=ttft_z), cooldown=cooldown),
+        Watch(name='kv_corrupt', metric='router.kv_corrupt',
+              signal='counter', detector=StaticThreshold(above=0),
+              cooldown=cooldown, actions=('dump',)),
     ]
     if paged:
         watches.append(

@@ -4,10 +4,16 @@ Post-mortem bundle diagnosis — ``obs doctor BUNDLE``.
 
 Given a flight-recorder bundle (obs/flight.py), classify the incident
 FROM THE BUNDLE ALONE — no live process, no source log — and name who
-it hurt. The classifier scores six incident classes against the
+it hurt. The classifier scores seven incident classes against the
 evidence in the ring's event window, the metric snapshot, the thread
 stacks and the MANIFEST trigger:
 
+- ``kv_corruption``  — KV pages failed checksum verification:
+  ``kv.corrupt`` verdicts, injected ``page_corrupt`` chaos, the
+  ``kv_corrupt`` dump trigger, corruption-tagged recovery arcs and
+  typed ``kv_corrupt`` terminals. The verdict names the DIRTY member
+  (from the kv.corrupt declaration) and the victim streams healed off
+  its poisoned pages.
 - ``replica_loss``   — a decode replica died mid-stream: a
   ``replica.lost`` declaration, probe-miss streaks, injected replica
   crashes, ``request.recovered`` arcs and typed ``replica_lost``
@@ -44,8 +50,10 @@ __all__ = ['Incident', 'diagnose', 'diagnose_bundles',
 
 # Classification order = tie-break priority (sharper findings first —
 # a dead replica explains the deadline/overload storms downstream of
-# it, never the other way around).
-CLASSES = ('replica_loss', 'stuck_step', 'nan_storm',
+# it, never the other way around; a corruption verdict explains the
+# expulsions and recoveries downstream of IT, so it outranks the
+# loss class its healing arc borrows).
+CLASSES = ('kv_corruption', 'replica_loss', 'stuck_step', 'nan_storm',
            'cache_exhaustion', 'deadline_storm', 'overload')
 
 _MAX_LISTED = 16    # request ids printed per affected category
@@ -128,6 +136,37 @@ def diagnose(bundle) -> Incident:
 
     sched_section = (bundle.get('sections') or {}).get('scheduler') or {}
 
+    # -- KV-corruption evidence -----------------------------------------
+    corrupt = [r for r in events if r.get('event') == 'kv.corrupt']
+    dirty = [str(r.get('target')) for r in corrupt
+             if r.get('target') is not None]
+    if corrupt:
+        pages = sorted({int(p) for r in corrupt
+                        for p in (r.get('pages') or [])})
+        vote('kv_corruption', 6.0 * len(corrupt),
+             f'kv.corrupt verdict(s) on {", ".join(sorted(set(dirty)))}'
+             f' — page(s) {pages} quarantined')
+    inj_corrupt = _count(events, 'fault.inject', kind='page_corrupt')
+    if inj_corrupt:
+        vote('kv_corruption', 4.0 * inj_corrupt,
+             f'injected fault: page_corrupt x{inj_corrupt}')
+    if trigger == 'kv_corrupt':
+        vote('kv_corruption', 4.0,
+             'bundle dumped by the kv_corrupt trigger')
+    corrupt_rec = sum(1 for r in events
+                      if r.get('event') == 'request.recovered'
+                      and r.get('reason') == 'kv_corrupt')
+    if corrupt_rec:
+        vote('kv_corruption', min(1.0 * corrupt_rec, 8.0),
+             f'{corrupt_rec} victim stream(s) healed off poisoned '
+             f'pages through the recovery ledger')
+    corrupt_rej = sum(1 for r in events
+                      if r.get('event') == 'serve.reject'
+                      and r.get('reason') == 'kv_corrupt')
+    if corrupt_rej:
+        vote('kv_corruption', 2.0 * corrupt_rej,
+             f'{corrupt_rej} typed kv_corrupt terminal(s)')
+
     # -- replica-loss evidence ------------------------------------------
     lost = [str(r.get('target')) for r in events
             if r.get('event') == 'replica.lost'
@@ -145,7 +184,11 @@ def diagnose(bundle) -> Incident:
     if trigger == 'replica_lost':
         vote('replica_loss', 4.0,
              'bundle dumped by the replica_lost trigger')
-    recovered = _count(events, 'request.recovered')
+    # Corruption-tagged recoveries vote for kv_corruption above, not
+    # here: the ledger arc is shared, the root cause is not.
+    recovered = sum(1 for r in events
+                    if r.get('event') == 'request.recovered'
+                    and r.get('reason') != 'kv_corrupt')
     if recovered:
         vote('replica_loss', min(1.0 * recovered, 8.0),
              f'{recovered} stream(s) resolved through the recovery '
@@ -308,8 +351,9 @@ def diagnose(bundle) -> Incident:
                    'rejected': tb['counts']['rejected'],
                    'incomplete': tb['counts']['incomplete']}
                for t, tb in sorted(report.per_tenant.items())}
-    affected = {'quarantined': [], 'preempted': [], 'rejected': [],
-                'failed': [], 'incomplete': [], 'in_flight': []}
+    affected = {'quarantined': [], 'preempted': [], 'recovered': [],
+                'rejected': [], 'failed': [], 'incomplete': [],
+                'in_flight': []}
     # The slot table at dump time: who was ON the device when the
     # incident hit (a mid-run bundle's events alone can't tell which
     # incompletes actually held slots).
@@ -322,6 +366,8 @@ def diagnose(bundle) -> Incident:
             affected['quarantined'].append(rid)
         if tl.preempts:
             affected['preempted'].append(rid)
+        if tl.recoveries:
+            affected['recovered'].append(rid)
         if tl.status == 'rejected':
             affected['rejected'].append(rid)
         elif tl.status in ('failed_nan', 'evicted', 'deadline_expired'):
@@ -338,14 +384,18 @@ def diagnose(bundle) -> Incident:
     if not events:
         notes.append('the bundle carries no events — was an event log '
                      'active when the recorder ran?')
+    # A replica_loss verdict names the DEAD member from the
+    # declaration (the latest, if several fell); a kv_corruption
+    # verdict names the DIRTY one the same way.
+    where = None
+    if primary == 'replica_loss' and lost:
+        where = lost[-1]
+    elif primary == 'kv_corruption' and dirty:
+        where = dirty[-1]
     return Incident(primary=primary, classes=scores, trigger=trigger,
                     reason=reason, window=window, tenants=tenants,
                     affected=affected, anomalies=anomalies, notes=notes,
-                    # A replica_loss verdict names the DEAD member from
-                    # the declaration (the latest, if several fell).
-                    replica=(lost[-1]
-                             if primary == 'replica_loss' and lost
-                             else None))
+                    replica=where)
 
 
 def diagnose_bundles(labeled) -> Incident:
@@ -410,10 +460,12 @@ def diagnose_bundles(labeled) -> Incident:
         where, inc = max(
             incidents, key=lambda li: li[1].classes[primary]['score'])
         trigger, reason = inc.trigger, inc.reason
-        if primary == 'replica_loss' and inc.replica is not None:
+        if primary in ('replica_loss', 'kv_corruption') \
+                and inc.replica is not None:
             # The strongest evidence lives in the ROUTER's bundle (the
-            # corpse cannot narrate its own death) — but the verdict
-            # must name the replica that DIED, not the narrator.
+            # corpse cannot narrate its own death, and the corruption
+            # verdict is the router's) — but the verdict must name the
+            # replica it happened ON, not the narrator.
             where = inc.replica
     window = {'events': n_events,
               'first_ts': min(first_ts) if first_ts else None,

@@ -126,6 +126,22 @@ EVENT_SCHEMA = {
     # Always returns the request to 'queued' in the timeline automaton:
     # its slot died with the replica.
     'request.recovered': ('request_id', 'from_replica', 'requeued'),
+    # KV page integrity (router-side verdict): pool page(s) of `target`
+    # (a decode replica or the prefill pool) failed checksum
+    # verification at `site` ('scrub' / 'attach' / 'fork' /
+    # 'handoff_src' / 'handoff_copy'); `pages` lists them. The pages
+    # are quarantined and every prefix built on them invalidated
+    # cluster-wide; request.recovered events (reason=kv_corrupt) for
+    # the victim streams follow in this log. No request_id: corruption
+    # is a page-level event — per-request arcs close through the
+    # recovered/terminal records.
+    'kv.corrupt': ('target', 'pages', 'site'),
+    # The router declared the shared prefill pool dead (probe timeout,
+    # same observational discipline as replica.lost): `target` names
+    # it, `reason` how the loss surfaced. Routing falls back to flat
+    # prefill on the decode replicas — no stream blocks on a dead
+    # pool; rebuild_prefill() restores offload under a fresh name.
+    'prefill.lost': ('target', 'reason'),
     # -- speculative decoding (serve/scheduler.py spec ticks) ----------
     # A proposer guessed `proposed` continuation tokens for the slot
     # this tick (`proposer` names which: ngram/draft/custom).

@@ -86,6 +86,11 @@ class Timeline:
     # log): times this request's stream was resolved off a dead replica
     # — re-dispatched to a survivor or terminally rejected replica_lost.
     recoveries: int = 0
+    # The subset of those displacements caused by a KV page corruption
+    # verdict (request.recovered with reason=kv_corrupt): the stream
+    # was expelled off poisoned pages and healed on a clean replica
+    # (or terminally rejected kv_corrupt past the recovery budget).
+    corruptions: int = 0
 
     def phases(self):
         """Compact ``{phase: seconds}`` view for printing."""
@@ -142,6 +147,18 @@ def _validate(tl: Timeline):
             # terminal would have been legal — state-exempt, counted.
             tl.degrades += 1
             continue
+        if ev == 'serve.preempt' and rec.get('expel'):
+            # Corruption-containment expulsion rides the DIRTY
+            # replica's log; at equal timestamps the merge may order
+            # it after the router's request.recovered already returned
+            # the request to 'queued' (or after the no-survivor
+            # terminal reject) — state-exempt, counted, slot freed if
+            # still held.
+            tl.preempts += 1
+            if state == 'running':
+                state = 'queued'
+                _reset_delivered_latency(tl)
+            continue
         if state == 'done':
             tl.errors.append(f'event {ev} after terminal state')
             continue
@@ -193,6 +210,10 @@ def _validate(tl: Timeline):
             # the aborted attempt is discarded like any requeue; the
             # next TTFT is still anchored at the ORIGINAL submit.
             tl.recoveries += 1
+            if rec.get('reason') == 'kv_corrupt':
+                # Displaced by a corruption verdict, not a dead
+                # replica — same automaton arc, separate tally.
+                tl.corruptions += 1
             state = 'queued'
             _reset_delivered_latency(tl)
         elif ev == 'serve.retire':

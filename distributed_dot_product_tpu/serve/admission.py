@@ -63,6 +63,12 @@ class RejectReason(enum.Enum):
     # replica, or the per-request ``max_recoveries`` budget is spent.
     # Terminal: the recovery ledger entry is finalized under this reason.
     REPLICA_LOST = 'replica_lost'
+    # KV page integrity: the stream's context touched a pool page that
+    # failed checksum verification, and the router could not heal it —
+    # recovery budget spent, or no clean replica to replay on. Terminal
+    # under the same ledger discipline as REPLICA_LOST; the page(s)
+    # stay quarantined.
+    KV_CORRUPT = 'kv_corrupt'
 
 
 class RejectedError(Exception):

@@ -42,6 +42,7 @@ and the tests pin it.
 
 import itertools
 import os
+import time
 
 import numpy as np
 
@@ -49,15 +50,30 @@ import jax
 import jax.numpy as jnp
 
 from distributed_dot_product_tpu.models.decode import (
-    PagePool, append_kv_slots, decode_step, init_paged_cache,
-    init_slot_cache, paged_append_rows, paged_copy_attach,
-    paged_reset_slot, paged_rollback_slots, paged_transfer_pages,
-    reset_slot, rollback_slots, slots_all_finite,
+    PageChecksums, PagePool, append_kv_slots, decode_step,
+    init_paged_cache, init_slot_cache, paged_append_rows,
+    paged_copy_attach, paged_reset_slot, paged_rollback_slots,
+    paged_transfer_pages, reset_slot, rollback_slots, slots_all_finite,
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
 
-__all__ = ['KernelEngine']
+__all__ = ['KernelEngine', 'PageCorruptionError']
+
+
+class PageCorruptionError(RuntimeError):
+    """A pool page's content no longer matches its recorded checksum.
+    ``pages`` names the dirty pages, ``site`` the transfer/attach
+    boundary that caught them ('scrub', 'attach', 'fork',
+    'handoff_src', 'handoff_copy') — the router turns this into the
+    `kv.corrupt` event + quarantine + heal arc."""
+
+    def __init__(self, pages, site):
+        self.pages = sorted(int(p) for p in pages)
+        self.site = site
+        super().__init__(
+            f'KV page corruption at {site}: page(s) {self.pages} fail '
+            f'checksum verification')
 
 
 def _resolve_decode_impl(decode_impl):
@@ -154,7 +170,7 @@ class KernelEngine:
     def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
                  prefill_chunk=8, seed=0, dtype=jnp.float32,
                  decode_impl=None, cache_mode=None, pages=None,
-                 page_size=None, weight_quant=None):
+                 page_size=None, weight_quant=None, kv_checksums=True):
         if slots < 1 or t_max < 2:
             raise ValueError(f'need slots >= 1 and t_max >= 2, got '
                              f'{slots}/{t_max}')
@@ -205,11 +221,18 @@ class KernelEngine:
                                           dtype=dtype)
             self._prefix_registry = {}
             self._prefix_counter = itertools.count()
+            # Per-page integrity table: registry/transfer pages only,
+            # digested at transfer boundaries on the host — never
+            # inside a compiled program ("verify at transfer, never
+            # per step"). kv_checksums=False is the no-integrity twin.
+            self.checksums = PageChecksums() if kv_checksums else None
         else:
             self.page_size = None
             self.pool = None
+            self.checksums = None
             self.cache = init_slot_cache(slots, heads, t_max, head_dim,
                                          dtype=dtype)
+        self.verify_seconds = 0.0   # host wall time spent digesting
         # Donated caches: appends write in place — see models/decode.py's
         # performance note. One compiled program each for the lifetime —
         # and the retrace sentinel (analysis/retrace.py) enforces it:
@@ -561,6 +584,8 @@ class KernelEngine:
         vec[:len(freed)] = freed
         self.cache = self._reset(self.cache, jnp.int32(slot),
                                  jnp.asarray(vec))
+        if self.checksums is not None:
+            self.checksums.drop(freed)
 
     def reset(self, slot):
         """Evict ``slot`` (zero rows + length); other slots untouched.
@@ -694,7 +719,68 @@ class KernelEngine:
         prefill) and :meth:`adopt_prefix` (cross-cache handoff)."""
         pid = next(self._prefix_counter)
         self._prefix_registry[pid] = (pages, n)
+        self._checksum_record(pages)
         return pid
+
+    # -- page integrity (host-side, transfer boundaries only) -----------
+    def _checksum_record(self, pages):
+        if self.checksums is not None:
+            t0 = time.perf_counter()
+            self.checksums.record(self.cache, pages)
+            self.verify_seconds += time.perf_counter() - t0
+
+    def verify_pages(self, pages=None):
+        """Re-digest ``pages`` (default: every tracked page — the
+        scrub) against the recorded checksums. Returns the sorted
+        dirty-page list without raising; [] when clean or when
+        checksums are disabled. Host work only."""
+        if self.checksums is None:
+            return []
+        t0 = time.perf_counter()
+        bad = self.checksums.verify(self.cache, pages)
+        self.verify_seconds += time.perf_counter() - t0
+        return bad
+
+    def verify_prefix(self, prefix_id):
+        """Scrub one registered prefix's pages (dirty list, no raise)."""
+        pages, _ = self._prefix_registry[prefix_id]
+        return self.verify_pages(pages)
+
+    def check_pages(self, pages, site):
+        """Raise :class:`PageCorruptionError` naming ``site`` if any of
+        ``pages`` fails verification (untracked pages are skipped)."""
+        bad = self.verify_pages(pages)
+        if bad:
+            raise PageCorruptionError(bad, site)
+
+    def quarantine_pages(self, pages):
+        """Withdraw dirty pages from circulation (they never return to
+        the free list) and forget their digests so scrubs stop
+        re-flagging them. Returns the pages newly quarantined."""
+        if self.checksums is not None:
+            self.checksums.drop(pages)
+        return self.pool.quarantine(pages)
+
+    def slots_sharing(self, pages):
+        """Slots whose page tables name any of ``pages`` — the live
+        victims of a corruption verdict."""
+        if self.pool is None:
+            return []
+        bad = {int(p) for p in pages}
+        hit = []
+        for slot in range(self.slots):
+            n = int(self.pool.counts[slot])
+            if any(int(self.pool.table[slot, i]) in bad
+                   for i in range(n)):
+                hit.append(slot)
+        return hit
+
+    def prefixes_on(self, pages):
+        """Registered prefix ids built on any of ``pages`` — the
+        entries a corruption verdict must invalidate."""
+        bad = {int(p) for p in pages}
+        return [pid for pid, (pgs, _) in self._prefix_registry.items()
+                if bad.intersection(int(p) for p in pgs)]
 
     def _transfer_program(self, src_shape):
         prog = self._transfers.get(src_shape)
@@ -708,7 +794,8 @@ class KernelEngine:
                 donate_argnums=(0,))
         return prog
 
-    def adopt_prefix(self, src_cache, src_pages, length):
+    def adopt_prefix(self, src_cache, src_pages, length,
+                     src_checksums=None):
         """The prefill→decode KV handoff (disaggregated serving): copy
         ``length`` rows living in ``src_pages`` of ANOTHER paged cache
         (a prefill pool's — same page size and head geometry, its own
@@ -718,7 +805,18 @@ class KernelEngine:
         :meth:`register_prefix`'s product is, so sequences started
         with :meth:`start_with_prefix` cannot tell a handed-off prefix
         from a locally prefilled one. Raises on pool exhaustion (the
-        router checks headroom first) and on geometry mismatch."""
+        router checks headroom first) and on geometry mismatch.
+
+        ``src_checksums`` (the source pool's :class:`PageChecksums`)
+        makes the handoff end-to-end verifiable: the source pages are
+        verified BEFORE the transfer (dirty source →
+        :class:`PageCorruptionError` at site 'handoff_src') and the
+        landed copies' KV digests are compared to the source's AFTER
+        (a corrupted transfer → site 'handoff_copy', with the adopted
+        prefix unregistered — never handed to a caller). Only
+        ``kv_crc`` crosses caches: the destination int8 mirror is
+        re-quantized from the adopted K with eps-scale tail rows, so
+        mirror bytes legitimately differ between pools."""
         if self.cache_mode != 'paged':
             raise ValueError("prefix adoption needs cache_mode='paged'")
         if src_cache.page_size != self.page_size:
@@ -741,6 +839,12 @@ class KernelEngine:
         if len(src_pages) != needed:
             raise ValueError(f'{len(src_pages)} source pages for '
                              f'{length} rows (need {needed})')
+        if src_checksums is not None:
+            t0 = time.perf_counter()
+            bad = src_checksums.verify(src_cache, src_pages)
+            self.verify_seconds += time.perf_counter() - t0
+            if bad:
+                raise PageCorruptionError(bad, 'handoff_src')
         pages = self.pool.alloc_block(needed)
         if pages is None:
             raise RuntimeError(
@@ -757,7 +861,22 @@ class KernelEngine:
         self.cache = self._transfer_program(key)(
             self.cache, src_cache.k_pool, src_cache.v_pool,
             jnp.asarray(vec_src), jnp.asarray(vec_dst))
-        return self._register_pages(pages, length)
+        pid = self._register_pages(pages, length)
+        if self.checksums is not None and src_checksums is not None:
+            # Landed-copy verification: the transfer moves whole pages
+            # (unfilled tail rows are zero on both sides), so the KV
+            # digest must survive the copy bit-exactly.
+            bad = []
+            for sp, dp in zip(src_pages, pages):
+                want = src_checksums.get(sp)
+                have = self.checksums.get(dp)
+                if want is not None and have is not None \
+                        and have[0] != want[0]:
+                    bad.append(dp)
+            if bad:
+                self.unregister_prefix(pid)
+                raise PageCorruptionError(bad, 'handoff_copy')
+        return pid
 
     def prefix_length(self, prefix_id):
         return self._prefix_registry[prefix_id][1]
@@ -774,8 +893,11 @@ class KernelEngine:
         """Point an EMPTY slot at a registered prefix: full pages
         shared (refcount++), partial tail page copied private, length
         set — the slot then prefills/decodes its own continuation.
-        False = pool exhausted (no tail page available)."""
+        False = pool exhausted (no tail page available). The prefix's
+        pages are verified first — attaching a sequence to a corrupted
+        prefix raises before any token can read it."""
         pages, plen = self._prefix_registry[prefix_id]
+        self.check_pages(pages, 'attach')
         ok, src, dst = self.pool.attach(slot, pages, plen)
         if not ok:
             return False
@@ -789,7 +911,13 @@ class KernelEngine:
         """Copy-on-write fork for parallel sampling: ``dst`` (an empty
         slot) shares ``src``'s full pages and gets a private copy of
         the partial tail page — O(1 page) device work however long the
-        context. False = pool exhausted."""
+        context. False = pool exhausted. The source's TRACKED pages
+        (shared prefix pages — private append pages are out of
+        coverage) are verified before the branch shares them."""
+        if self.checksums is not None:
+            shared = [int(self.pool.table[src, i])
+                      for i in range(int(self.pool.counts[src]))]
+            self.check_pages(shared, 'fork')
         ok, tail_src, tail_dst = self.pool.fork(src, dst)
         if not ok:
             return False
@@ -847,11 +975,13 @@ class KernelEngine:
         pool = self.pool
         if pool is None:
             return {'pages': 0, 'pages_used': 0, 'pages_free': 0,
-                    'shared_pages': 0, 'page_size': 0}
+                    'shared_pages': 0, 'page_size': 0,
+                    'pages_quarantined': 0}
         return {'pages': pool.pages, 'pages_used': pool.used_pages,
                 'pages_free': pool.free_pages,
                 'shared_pages': pool.shared_pages,
-                'page_size': pool.page_size}
+                'page_size': pool.page_size,
+                'pages_quarantined': len(pool.quarantined)}
 
 
 def graphlint_entrypoints():

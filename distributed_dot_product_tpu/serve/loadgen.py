@@ -398,7 +398,19 @@ class ChaosSchedule:
     log tears mid-record); the ROUTER is told nothing. Its liveness
     probes must detect the silence and declare the loss, exactly as
     with a real dead process. An inner ``on_tick`` (a controller's)
-    chains after the crash check."""
+    chains after the crash check.
+
+    The same discipline covers the other two seams: a planned
+    prefill-pool crash kills the pool member directly
+    (``PrefillPool.kill``), and a planned page corruption flips one
+    bit of the victim engine's KV pool HOST-SIDE (device buffer
+    round-trip, outside every compiled program) — the router learns of
+    either only through its own probes/checksums. The flip's page spec
+    indexes the victim's tracked (registry) pages in sorted order and
+    defers to the first tick that has any, so the same seeded trace
+    poisons the same prefix page every replay — including on a
+    checksums-off twin, which is what makes the silent-wrong-token
+    comparison measurable."""
 
     def __init__(self, injector, router, on_tick=None):
         self.injector = injector
@@ -406,6 +418,8 @@ class ChaosSchedule:
         self.on_tick = on_tick
         self.tick = 0
         self.killed = []
+        self.corrupted = []          # (replica, page, tick) flips landed
+        self._pending_corrupt = None
 
     def __call__(self):
         victim = self.injector.crash_due(self.tick)
@@ -415,9 +429,52 @@ class ChaosSchedule:
             if replica is not None and replica.alive:
                 replica.kill()
                 self.killed.append(victim)
+        if self.injector.prefill_crash_due(self.tick):
+            prefill = self.router.pool.prefill
+            if prefill is not None and prefill.alive:
+                prefill.kill()
+        due = self.injector.corrupt_due(self.tick)
+        if due is not None:
+            self._pending_corrupt = due
+        if self._pending_corrupt is not None:
+            self._pending_corrupt = self._flip(self._pending_corrupt)
         self.tick += 1
         if self.on_tick is not None:
             self.on_tick()
+
+    def _flip(self, pending):
+        """Land (or defer) a planned bit flip. Returns the pending spec
+        when the victim has no tracked page yet, None once landed (or
+        when the victim left the pool)."""
+        import jax.numpy as jnp
+
+        name, index = pending
+        replica = next((r for r in self.router.pool.replicas
+                        if r.name == name), None)
+        if replica is None:
+            return None
+        eng = replica.engine
+        tracked = sorted({int(p)
+                          for pages, _ in eng._prefix_registry.values()
+                          for p in pages})
+        if not tracked:
+            return pending
+        page = tracked[index % len(tracked)]
+        k_pool = np.array(eng.cache.k_pool)
+        # Flip an EXPONENT bit of the page's first K value (byte 3 of
+        # a little-endian float32): the corruption is semantically
+        # loud — an undetected flip changes delivered tokens, which is
+        # exactly what the no-integrity twin must demonstrate. The
+        # checksum does not care which bit flipped; the comparison
+        # row does.
+        k_pool[page].reshape(-1).view(np.uint8)[3] ^= 0x40
+        # jnp.array (NOT asarray): the device buffer must OWN its
+        # bytes. On CPU asarray can alias the numpy host copy, and the
+        # next decode step donates the cache buffer — XLA would free
+        # memory Python owns.
+        eng.cache = eng.cache._replace(k_pool=jnp.array(k_pool))
+        self.corrupted.append((name, page, self.tick))
+        return None
 
 
 def run_load(cfg: LoadGenConfig, *, engine, serve_config=None,

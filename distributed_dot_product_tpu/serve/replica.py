@@ -83,6 +83,11 @@ class TopologyConfig:
     seed: int = 0
     decode_impl: Optional[str] = 'xla'
     prefill_chunk: int = 8
+    # Host-side per-page checksum tables on every member engine
+    # (transfer-boundary integrity — serve/engine.py). False builds
+    # the no-integrity twin the corruption benchmark rows compare
+    # against.
+    kv_checksums: bool = True
 
     def validate(self):
         if self.decode_replicas < 1:
@@ -141,9 +146,10 @@ class PrefillPool:
     def __init__(self, *, t_max, page_size, pages=None, vocab=64,
                  heads=2, head_dim=8, seed=0, dtype=jnp.float32,
                  prefill_chunk=8, mesh=None, name='prefill',
-                 event_log=None):
+                 event_log=None, kv_checksums=True):
         self.name = name
         self.event_log = event_log
+        self.alive = True
         self.mesh = mesh if mesh is not None else seq_mesh()
         self.n_shards = int(self.mesh.devices.size)
         # Sized for prefixes in flight, not a decode batch: a built
@@ -154,7 +160,8 @@ class PrefillPool:
             dtype=dtype, decode_impl='xla', cache_mode='paged',
             page_size=page_size,
             pages=(pages if pages is not None
-                   else 2 * (t_max // page_size)))
+                   else 2 * (t_max // page_size)),
+            kv_checksums=kv_checksums)
         self._kv_programs = {}
         self._fill_programs = {}
 
@@ -205,6 +212,11 @@ class PrefillPool:
         decode replica; :meth:`release` it afterwards (the prefill
         pool is a staging area, not a cache — the CLUSTER cache is the
         decode replicas' registries plus the router's prefix map)."""
+        if not self.alive:
+            # A dead pool builds nothing — the router's probe/fallback
+            # path must keep every prompt off this seam, so reaching it
+            # is a routing bug, not a capacity condition.
+            raise RuntimeError(f'prefill pool {self.name!r} is dead')
         eng = self.engine
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = len(tokens)
@@ -236,6 +248,21 @@ class PrefillPool:
         """Return a built prefix's pages to the pool (freed pages
         zeroed — the allocator invariant)."""
         self.engine.unregister_prefix(handle.prefix_id)
+
+    def kill(self):
+        """The prefill pool's crash seam — the same discipline as
+        :meth:`DecodeReplica.kill`: every staged prefix is lost, the
+        pool emits nothing more, and its event log is TORN with a
+        half-written record. The router's probes must notice the
+        silence; routing falls back to flat prefill on the decode
+        replicas. Idempotent."""
+        if not self.alive:
+            return
+        self.alive = False
+        if self.event_log is not None:
+            self.event_log.close()
+            with open(self.event_log.path, 'a', encoding='utf-8') as fh:
+                fh.write('{"schema":2,"seq":')
 
 
 class _DeadLog:
@@ -338,14 +365,14 @@ class ReplicaPool:
         self.log_dir = log_dir
         self._logs = []            # (name, EventLog) — closed with us
         self.serve_config = serve_config or ServeConfig(watchdog=False)
+        self._mesh = mesh
         self.prefill = None
+        self.prefill_lost = []  # crashed pools: torn logs stay readable
+        self._prefill_seq = 0   # rebuild names never reuse: prefill,
+        #   prefill1, prefill2, ... (reopening the old name would
+        #   truncate the torn-tail crash evidence)
         if topo.prefill_pools:
-            self.prefill = PrefillPool(
-                t_max=topo.t_max, page_size=topo.page_size,
-                pages=topo.prefill_pages, vocab=topo.vocab,
-                heads=topo.heads, head_dim=topo.head_dim,
-                seed=topo.seed, prefill_chunk=topo.prefill_chunk,
-                mesh=mesh, event_log=self.open_log('prefill'))
+            self.prefill = self._build_prefill()
         self._fault_injector = fault_injector
         self.replicas = []
         self.retired = []       # drained-and-removed members (results
@@ -358,6 +385,48 @@ class ReplicaPool:
         for _ in range(topo.decode_replicas):
             self.add_replica()
         self._closed = False
+
+    def _build_prefill(self) -> PrefillPool:
+        topo = self.topology
+        name = 'prefill' if self._prefill_seq == 0 \
+            else f'prefill{self._prefill_seq}'
+        self._prefill_seq += 1
+        return PrefillPool(
+            t_max=topo.t_max, page_size=topo.page_size,
+            pages=topo.prefill_pages, vocab=topo.vocab,
+            heads=topo.heads, head_dim=topo.head_dim,
+            seed=topo.seed, prefill_chunk=topo.prefill_chunk,
+            mesh=self._mesh, name=name, event_log=self.open_log(name),
+            kv_checksums=topo.kv_checksums)
+
+    def mark_prefill_lost(self) -> Optional[PrefillPool]:
+        """Declare the prefill pool crashed and detach it: routing
+        falls back to flat prefill on the decode replicas (`_handoff`
+        returns None with no pool). The corpse's torn log stays in
+        :meth:`logs` under :attr:`prefill_lost`. Idempotent-safe: a
+        pool-less topology returns None."""
+        pool = self.prefill
+        if pool is None:
+            return None
+        pool.kill()
+        self.prefill = None
+        self.prefill_lost.append(pool)
+        return pool
+
+    def rebuild_prefill(self) -> PrefillPool:
+        """Restore prefill offload after a pool loss: a FRESH pool
+        (empty cache, fresh log) under the next never-reused name —
+        the disaggregated analog of :meth:`add_replica` for the other
+        failure domain. Refuses while a live pool exists, and in
+        topologies configured without one."""
+        if self.prefill is not None:
+            raise ValueError('the prefill pool is alive — kill or '
+                             'mark it lost before rebuilding')
+        if not self.topology.prefill_pools:
+            raise ValueError('this topology runs without a prefill '
+                             'pool; nothing to rebuild')
+        self.prefill = self._build_prefill()
+        return self.prefill
 
     def add_replica(self) -> DecodeReplica:
         """Grow the decode pool by one member (elastic scale-up —
@@ -374,7 +443,8 @@ class ReplicaPool:
             heads=topo.heads, head_dim=topo.head_dim,
             prefill_chunk=topo.prefill_chunk, seed=topo.seed,
             decode_impl=topo.decode_impl, cache_mode='paged',
-            page_size=topo.page_size, pages=topo.pages)
+            page_size=topo.page_size, pages=topo.pages,
+            kv_checksums=topo.kv_checksums)
         replica = DecodeReplica(
             name, engine, self.serve_config, clock=self.clock,
             event_log=self.open_log(name),
@@ -435,9 +505,15 @@ class ReplicaPool:
         """``[(name, path), ...]`` — the labeled multi-source set
         ``obs.reconstruct`` / ``obs slo report`` merge. Router first:
         equal-timestamp ties then resolve route-before-admit."""
-        order = {'router': 0, 'prefill': 1}
+        def order(name):
+            if name == 'router':
+                return 0
+            # Any pool generation: 'prefill', 'prefill1', ... (rebuilt
+            # pools keep their crashed predecessor's torn log in the
+            # merged set).
+            return 1 if name.startswith('prefill') else 2
         return sorted(((name, log.path) for name, log in self._logs),
-                      key=lambda nl: (order.get(nl[0], 2), nl[0]))
+                      key=lambda nl: (order(nl[0]), nl[0]))
 
     def step_all(self):
         """One tick of every replica scheduler; True while any is

@@ -48,6 +48,27 @@ prompt + seed, continues bit-identically. Recovery is bounded
 terminal) and fully narrated: ``replica.probe`` / ``replica.lost`` /
 ``request.recovered`` / ``replica.rejoin`` in the router's log close
 every arc across the dead member's torn log.
+
+**Data integrity.** Every KV page transfer is end-to-end verifiable:
+engines keep host-side per-page checksums (transfer boundaries only —
+never inside a compiled step), and the router verifies at every
+adoption/handoff/attach site. A mismatch emits the closed-vocabulary
+``kv.corrupt`` event, quarantines the dirty page(s) (they never return
+to the free list), cluster-wide-invalidates any registered prefix
+built on them, and heals the victim streams through the SAME recovery
+ledger — replay-prefill on a clean replica with the original
+submit/TTFT/deadline anchors, bounded by ``max_recoveries``, then the
+typed ``KV_CORRUPT`` terminal. The dirty replica stays in the pool:
+corruption is a page-level fault, not a process death. An optional
+background scrub (``integrity_interval``) re-verifies every tracked
+digest on the router clock.
+
+**The prefill pool is a failure domain too.** The router probes it
+exactly like a decode replica; a timeout declares ``prefill.lost`` and
+detaches it — every later long prompt falls back to the replicas' own
+flat prefill (no stream ever blocks on a dead pool), and
+:meth:`Router.rebuild_pool` restores offload under a fresh name (never
+reused — the ghost's torn log keeps its own).
 """
 
 import collections
@@ -63,6 +84,7 @@ from distributed_dot_product_tpu.obs import flight as obs_flight
 from distributed_dot_product_tpu.serve.admission import (
     RejectedError, RejectReason, Request, RequestResult,
 )
+from distributed_dot_product_tpu.serve.engine import PageCorruptionError
 from distributed_dot_product_tpu.serve.replica import (
     ReplicaPool, TopologyConfig,
 )
@@ -109,6 +131,12 @@ class RouterConfig:
     probe_misses: int = 3
     probe_backoff: float = 2.0
     probe_backoff_max: float = 0.2
+    # Background integrity scrub period (router clock): every tracked
+    # page digest re-verifies at most this often. None = no scrub —
+    # transfer/attach-site verification stays on regardless (it is the
+    # correctness surface; the scrub only shortens detection latency
+    # for pages nothing is touching). 0.0 = every tick (chaos runs).
+    integrity_interval: Optional[float] = None
 
 
 class Router:
@@ -161,10 +189,13 @@ class Router:
         self._c_unregistered = reg.counter('router.prefix_unregistered')
         self._c_lost = reg.counter('router.replicas_lost')
         self._c_recovered = reg.counter('router.recovered')
+        self._c_corrupt = reg.counter('router.kv_corrupt')
+        self._c_prefill_lost = reg.counter('router.prefill_lost')
         reg.gauge('router.replicas').set(len(pool.replicas))
         self._routed_series = {}
         self._noreplica_series = {}
-        self._lostreject_series = {}
+        self._reject_series = {}
+        self._integrity_next = None
 
     # -- observability ---------------------------------------------------
     def _emit(self, event, _log=None, **fields):
@@ -226,8 +257,17 @@ class Router:
         name, pid, rows = hit
         if not loads[name]['accepting']:
             return None
+        replica = self._by_name[name]
+        bad = replica.engine.verify_prefix(pid)
+        if bad:
+            # The hit's pages fail their checksums: contain the
+            # corruption (quarantine + cluster-wide invalidation +
+            # ledger healing) and treat this placement as a MISS — the
+            # rider must never attach poisoned pages.
+            self._handle_corruption(replica, bad, 'attach')
+            return None
         self._prefix_map.move_to_end(key)
-        return self._by_name[name], pid, rows
+        return replica, pid, rows
 
     def _handoff(self, rid, replica, key, tenant):
         """Build ``key``'s KV in the prefill pool and adopt its pages
@@ -252,7 +292,29 @@ class Router:
             return None
         try:
             pid = replica.engine.adopt_prefix(
-                prefill.engine.cache, handle.pages, handle.length)
+                prefill.engine.cache, handle.pages, handle.length,
+                src_checksums=prefill.engine.checksums)
+        except PageCorruptionError as exc:
+            if exc.site == 'handoff_src':
+                # The flip landed in the PREFILL pool's staging pages
+                # — caught BEFORE the transfer, so the replica is
+                # clean. Quarantine at the source; the staged prefix
+                # frees in the finally below and the prompt serves the
+                # flat way (the offload never turns a detected
+                # corruption into a wrong token).
+                prefill.engine.quarantine_pages(exc.pages)
+                self._c_corrupt.inc()
+                self._emit('kv.corrupt', target=prefill.name,
+                           pages=exc.pages, site=exc.site)
+                self._flight_dump(
+                    'kv_corrupt',
+                    f'prefill pool {prefill.name}: page(s) {exc.pages} '
+                    f'failed checksum at {exc.site}')
+            else:
+                # The landed copy mismatches the source digest: the
+                # dirty pages are on the REPLICA. Full containment.
+                self._handle_corruption(replica, exc.pages, exc.site)
+            return None
         finally:
             prefill.release(handle)
         if self.chaos is not None \
@@ -381,16 +443,24 @@ class Router:
     # -- driving surface -------------------------------------------------
     def step(self) -> bool:
         self._probe_tick()
+        self._integrity_tick()
         busy = self.pool.step_all()
         # A pending detection keeps the topology "busy": a dead member
         # contributes no work, but until the probe timeout declares it
         # lost its in-flight streams are neither running nor recovered
         # — an idle-looking tick here must not end the run with those
-        # streams unaccounted.
+        # streams unaccounted. The prefill pool counts the same way:
+        # its death strands no streams, but the run must not end
+        # before the probes have narrated the prefill.lost arc.
+        prefill = self.pool.prefill
         return busy or any(
             not r.alive or self._probe_state.get(r.name, {}).get(
                 'misses', 0) > 0
-            for r in self.pool.replicas)
+            for r in self.pool.replicas) or (
+            prefill is not None and (
+                not prefill.alive
+                or self._probe_state.get(prefill.name, {}).get(
+                    'misses', 0) > 0))
 
     @property
     def results(self):
@@ -440,31 +510,41 @@ class Router:
         """Per-tick liveness sweep on the router's (virtual) clock.
         Misses re-probe with bounded exponential backoff and
         ``probe_misses`` consecutive misses declare the member lost —
-        a timeout, not a first-miss hair trigger."""
+        a timeout, not a first-miss hair trigger. The prefill pool is
+        probed exactly like a decode replica (same backoff, same
+        chaos-blackhole seam); its timeout declares ``prefill.lost``
+        instead of a replica loss."""
         now = self.clock()
         cfg = self.cfg
-        for replica in list(self.pool.replicas):
-            st = self._probe_state.get(replica.name)
+        prefill = self.pool.prefill
+        members = list(self.pool.replicas)
+        if prefill is not None:
+            members.append(prefill)
+        for member in members:
+            st = self._probe_state.get(member.name)
             if st is None:
-                st = self._probe_state[replica.name] = {
+                st = self._probe_state[member.name] = {
                     'next': now + cfg.probe_interval, 'misses': 0}
                 continue
             if now < st['next']:
                 continue
-            if self._probe_ok(replica):
+            if self._probe_ok(member):
                 if st['misses']:
                     # Only transitions are narrated: a healthy pool's
                     # probe stream stays out of the log.
-                    self._emit('replica.probe', target=replica.name,
+                    self._emit('replica.probe', target=member.name,
                                state='ok')
                 st['misses'] = 0
                 st['next'] = now + cfg.probe_interval
                 continue
             st['misses'] += 1
-            self._emit('replica.probe', target=replica.name,
+            self._emit('replica.probe', target=member.name,
                        state='missed', misses=st['misses'])
             if st['misses'] >= cfg.probe_misses:
-                self.mark_lost(replica.name, reason='probe_timeout')
+                if member is prefill:
+                    self._mark_prefill_lost(reason='probe_timeout')
+                else:
+                    self.mark_lost(member.name, reason='probe_timeout')
                 continue
             st['next'] = now + min(
                 cfg.probe_interval * cfg.probe_backoff ** st['misses'],
@@ -513,61 +593,203 @@ class Router:
         loads = {r.name: r.load() for r in survivors}
         recovered = 0
         for rid in reversed(inflight):
-            entry = self._ledger[rid]
-            entry['recoveries'] += 1
-            if not survivors \
-                    or entry['recoveries'] > self.cfg.max_recoveries:
-                self._emit('request.recovered', request_id=rid,
-                           from_replica=name, requeued=False,
-                           recoveries=entry['recoveries'])
-                key = (entry['tenant'],)
-                c = self._lostreject_series.get(key)
-                if c is None:
-                    c = self._lostreject_series[key] = \
-                        self.registry.counter(
-                            'router.rejected.replica_lost',
-                            labels={'tenant': entry['tenant']})
-                c.inc()
-                self._emit('serve.reject', request_id=rid,
-                           reason=RejectReason.REPLICA_LOST.value,
-                           queued=True, tenant=entry['tenant'])
-                self._lost_results[rid] = RequestResult(
-                    id=rid, status='rejected', tokens=[],
-                    prompt_len=len(entry['prompt']),
-                    reason=RejectReason.REPLICA_LOST,
-                    finished_at=self.clock(), tenant=entry['tenant'])
-                continue
-            # Replay-prefill re-dispatch: rebuild the request from the
-            # ledger alone (the scheduler-side object died with the
-            # process). Greedy streams are prompt + seed pure, so the
-            # survivor regenerates the SAME tokens from scratch; the
-            # original submit anchor keeps TTFT/deadline honest across
-            # the crash.
-            target = min(survivors,
-                         key=lambda r: (loads[r.name]['queued']
-                                        + loads[r.name]['busy'],
-                                        r.name))
-            loads[target.name]['queued'] += 1
-            req = Request(prompt=entry['prompt'],
-                          max_new_tokens=entry['max_new_tokens'],
-                          deadline=entry['deadline'], id=rid,
-                          tenant=entry['tenant'])
-            req.submitted_at = entry['submitted_at']
-            target.scheduler.admission.push_front(req)
-            entry['replica'] = target.name
-            if entry['session'] is not None:
-                self._sessions[entry['session']] = target.name
-            recovered += 1
-            self._c_recovered.inc()
-            self._count_routed(target.name, entry['tenant'])
-            self._emit('request.recovered', request_id=rid,
-                       from_replica=name, requeued=True,
-                       target=target.name,
-                       recoveries=entry['recoveries'])
-            self._emit('router.route', request_id=rid,
-                       target=target.name, policy='recovery',
-                       tenant=entry['tenant'])
+            if self._resolve_stream(
+                    rid, from_replica=name, survivors=survivors,
+                    loads=loads, reason=None,
+                    reject_reason=RejectReason.REPLICA_LOST):
+                recovered += 1
         return recovered
+
+    def _count_reject(self, reason, tenant):
+        """One router-owned typed-reject counter series per reason
+        (``router.rejected.<reason>``), labeled by tenant."""
+        key = (reason.value, tenant)
+        c = self._reject_series.get(key)
+        if c is None:
+            c = self._reject_series[key] = self.registry.counter(
+                f'router.rejected.{reason.value}',
+                labels={'tenant': tenant})
+        c.inc()
+
+    def _resolve_stream(self, rid, *, from_replica, survivors, loads,
+                        reason, reject_reason):
+        """Resolve ONE displaced in-flight stream through the recovery
+        ledger: requeue on the least-loaded survivor (True) or — past
+        ``max_recoveries``, or with no survivor left — finalize with
+        the typed ``reject_reason`` terminal the router itself owns
+        (False). Shared by the replica-loss and the page-corruption
+        arcs; ``reason`` (when set) tags the request.recovered events
+        with WHY the stream was displaced."""
+        entry = self._ledger[rid]
+        entry['recoveries'] += 1
+        extra = {} if reason is None else {'reason': reason}
+        if not survivors \
+                or entry['recoveries'] > self.cfg.max_recoveries:
+            self._emit('request.recovered', request_id=rid,
+                       from_replica=from_replica, requeued=False,
+                       recoveries=entry['recoveries'], **extra)
+            self._count_reject(reject_reason, entry['tenant'])
+            self._emit('serve.reject', request_id=rid,
+                       reason=reject_reason.value,
+                       queued=True, tenant=entry['tenant'])
+            self._lost_results[rid] = RequestResult(
+                id=rid, status='rejected', tokens=[],
+                prompt_len=len(entry['prompt']),
+                reason=reject_reason,
+                finished_at=self.clock(), tenant=entry['tenant'])
+            return False
+        # Replay-prefill re-dispatch: rebuild the request from the
+        # ledger alone (the scheduler-side object died with the
+        # process). Greedy streams are prompt + seed pure, so the
+        # survivor regenerates the SAME tokens from scratch; the
+        # original submit anchor keeps TTFT/deadline honest across
+        # the crash.
+        target = min(survivors,
+                     key=lambda r: (loads[r.name]['queued']
+                                    + loads[r.name]['busy'],
+                                    r.name))
+        loads[target.name]['queued'] += 1
+        req = Request(prompt=entry['prompt'],
+                      max_new_tokens=entry['max_new_tokens'],
+                      deadline=entry['deadline'], id=rid,
+                      tenant=entry['tenant'])
+        req.submitted_at = entry['submitted_at']
+        target.scheduler.admission.push_front(req)
+        entry['replica'] = target.name
+        if entry['session'] is not None:
+            self._sessions[entry['session']] = target.name
+        self._c_recovered.inc()
+        self._count_routed(target.name, entry['tenant'])
+        self._emit('request.recovered', request_id=rid,
+                   from_replica=from_replica, requeued=True,
+                   target=target.name,
+                   recoveries=entry['recoveries'], **extra)
+        self._emit('router.route', request_id=rid,
+                   target=target.name, policy='recovery',
+                   tenant=entry['tenant'])
+        return True
+
+    # -- KV page integrity (the kv.corrupt arc) --------------------------
+    def _integrity_tick(self):
+        """Background scrub on the router clock: re-verify every
+        tracked page digest at most every ``integrity_interval``
+        seconds. Purely additive detection — the transfer/attach sites
+        verify regardless — and entirely host-side (zero ops added to
+        any compiled program)."""
+        iv = self.cfg.integrity_interval
+        if iv is None:
+            return
+        now = self.clock()
+        if self._integrity_next is not None \
+                and now < self._integrity_next:
+            return
+        self._integrity_next = now + iv
+        for replica in list(self.pool.replicas):
+            if not replica.alive:
+                continue
+            bad = replica.engine.verify_pages()
+            if bad:
+                self._handle_corruption(replica, bad, 'scrub')
+        prefill = self.pool.prefill
+        if prefill is not None and prefill.alive \
+                and prefill.engine.checksums is not None:
+            bad = prefill.engine.verify_pages()
+            if bad:
+                # Staged prefixes are transient within one submit —
+                # nothing downstream holds them yet, so quarantine +
+                # narration is the whole containment (no streams to
+                # heal; the next handoff allocates clean pages).
+                prefill.engine.quarantine_pages(bad)
+                self._c_corrupt.inc()
+                self._emit('kv.corrupt', target=prefill.name,
+                           pages=sorted(int(p) for p in bad),
+                           site='scrub')
+
+    def _handle_corruption(self, replica, pages, site):
+        """Contain and heal one corruption verdict on a decode
+        replica: quarantine the dirty pages (never back to the free
+        list), expel every stream decoding on or queued against them,
+        invalidate every registered prefix built on them cluster-wide
+        (map + registry), then heal the victims through the recovery
+        ledger on CLEAN replicas — the dirty one stays in the pool
+        (page fault, not process death) but never re-hosts a victim.
+        Returns the number of streams healed (requeued)."""
+        eng = replica.engine
+        pages = sorted(int(p) for p in pages)
+        dirty_pids = eng.prefixes_on(pages)
+        victims = replica.scheduler.requests_on_slots(
+            eng.slots_sharing(pages))
+        victims += [rid for rid
+                    in replica.scheduler.queued_with_prefix(dirty_pids)
+                    if rid not in victims]
+        # Quarantine FIRST: expelling a victim releases its page
+        # references, and a not-yet-quarantined dirty page would
+        # re-enter the free list on the way down.
+        eng.quarantine_pages(pages)
+        self._c_corrupt.inc()
+        self._emit('kv.corrupt', target=replica.name, pages=pages,
+                   site=site)
+        self._flight_dump(
+            'kv_corrupt',
+            f'replica {replica.name}: page(s) {pages} failed checksum '
+            f'at {site}, {len(victims)} victim stream(s)')
+        expelled = []
+        for rid in victims:
+            if replica.scheduler.expel(rid) is not None:
+                expelled.append(rid)
+        # Invalidate the poisoned prefixes AFTER the expulsions (the
+        # victims' releases must see the registry references) — map
+        # entries first, so no new rider can route at them.
+        for pid in dirty_pids:
+            key = self._pid_tokens.pop((replica.name, pid), None)
+            if key is not None:
+                self._prefix_map.pop(key, None)
+            eng.unregister_prefix(pid)
+            self._c_unregistered.inc()
+        survivors = [r for r in self.pool.replicas
+                     if r.name != replica.name]
+        loads = {r.name: r.load() for r in survivors}
+        healed = 0
+        for rid in expelled:
+            if rid not in self._ledger:
+                continue
+            if self._resolve_stream(
+                    rid, from_replica=replica.name,
+                    survivors=survivors, loads=loads,
+                    reason='kv_corrupt',
+                    reject_reason=RejectReason.KV_CORRUPT):
+                healed += 1
+        return healed
+
+    # -- the prefill failure domain --------------------------------------
+    def _mark_prefill_lost(self, *, reason='crash'):
+        """Declare the shared prefill pool dead (probe timeout — the
+        same observational discipline as :meth:`mark_lost`). Routing
+        falls back to the replicas' own flat prefill from the next
+        submit on; no stream was in flight THERE (built prefixes hand
+        off within one submit), so there is nothing to heal."""
+        pool = self.pool.prefill
+        if pool is None:
+            return None
+        self._probe_state.pop(pool.name, None)
+        self.pool.mark_prefill_lost()
+        self._c_prefill_lost.inc()
+        self._emit('prefill.lost', target=pool.name, reason=reason)
+        self._flight_dump(
+            'prefill_lost',
+            f'prefill pool {pool.name} lost ({reason}): long prompts '
+            f'fall back to flat prefill')
+        return pool
+
+    def rebuild_pool(self):
+        """Restore prefill offload after a pool loss: a fresh pool
+        under a fresh name (never reused — the ghost's torn log keeps
+        its own) enters the probe set on the next tick. Mirrors
+        :meth:`rejoin_replica` for the prefill domain."""
+        pool = self.pool.rebuild_prefill()
+        self._emit('replica.rejoin', target=pool.name,
+                   replicas=len(self.pool.replicas))
+        return pool
 
     def rejoin_replica(self):
         """A restarted replica rejoins through the existing
@@ -585,16 +807,24 @@ class Router:
         membership, the probe ledger and the recovery ledger's shape —
         what a post-incident doctor needs to see next to the dead
         member's torn log."""
+        prefill = self.pool.prefill
         return {
             'replicas': [r.name for r in self.pool.replicas],
             'lost': [r.name for r in self.pool.lost],
             'retired': [r.name for r in self.pool.retired],
+            'prefill': prefill.name if prefill is not None else None,
+            'prefill_lost': [p.name for p in self.pool.prefill_lost],
             'probes': {n: dict(st)
                        for n, st in self._probe_state.items()},
             'ledger_size': len(self._ledger),
             'lost_terminals': len(self._lost_results),
             'sessions': len(self._sessions),
             'prefix_entries': len(self._prefix_map),
+            'quarantined': {
+                r.name: sorted(r.engine.pool.quarantined)
+                for r in self.pool.replicas
+                if r.engine.pool is not None
+                and r.engine.pool.quarantined},
         }
 
     def _flight_dump(self, trigger, reason=''):

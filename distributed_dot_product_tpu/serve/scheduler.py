@@ -68,6 +68,7 @@ from distributed_dot_product_tpu.serve.admission import (
     AdmissionController, RejectedError, RejectReason, Request,
     RequestResult,
 )
+from distributed_dot_product_tpu.serve.engine import PageCorruptionError
 from distributed_dot_product_tpu.serve.health import (
     HealthMonitor, Liveness, Readiness,
 )
@@ -818,6 +819,20 @@ class Scheduler:
                 self._finalize_request(
                     req, 'rejected', RejectReason.PREFIX_UNREGISTERED)
                 return 'rejected'
+            except PageCorruptionError as exc:
+                # Standalone-engine safety net (a topology's router
+                # verifies at routing time and heals through its
+                # ledger, pre-empting this): quarantine the dirty
+                # pages, drop the poisoned prefix, typed terminal —
+                # never a token decoded off a page that fails its
+                # checksum.
+                eng.quarantine_pages(exc.pages)
+                eng.unregister_prefix(req.prefix_id)
+                self.admission.count_reject(
+                    RejectReason.KV_CORRUPT, tenant=req.tenant)
+                self._finalize_request(req, 'rejected',
+                                       RejectReason.KV_CORRUPT)
+                return 'rejected'
             if not attached:
                 return 'wait'
         if not eng.reserve_rows(slot.index, len(req.prompt)):
@@ -1157,6 +1172,60 @@ class Scheduler:
         self._g_active.set(0)
         self._update_readiness()
         return drained
+
+    # -- corruption containment (serve/router.py) ----------------------
+    def requests_on_slots(self, slot_indices):
+        """Request ids currently decoding on the given slots — the
+        victims of a page-level fault (the router maps dirty pages to
+        slots via the engine's reverse table, then to streams here)."""
+        wanted = {int(i) for i in slot_indices}
+        return [slot.request.id for slot in self._slots
+                if slot.state is not _SlotState.FREE
+                and slot.index in wanted]
+
+    def queued_with_prefix(self, prefix_ids):
+        """Queued request ids pinned to one of the given prefixes —
+        riders that would attach poisoned pages the moment a slot
+        frees. They never held the pages, but their placement plan is
+        dirty, so corruption containment expels them too."""
+        wanted = set(prefix_ids)
+        return [req.id for req in self.admission._queue
+                if req.prefix_id in wanted]
+
+    def expel(self, request_id):
+        """Forcibly remove one request — slot or queue — WITHOUT a
+        terminal: the caller (the router's corruption handler) owns
+        the request's fate (ledger replay on a clean replica, or a
+        typed reject past budget). A slot expulsion follows the drain
+        arc (``serve.preempt`` with ``expel=True``, slot cleared,
+        tokens reset for a deterministic regeneration); a queue
+        expulsion just unlinks. Returns the Request, or None when the
+        id is not live here (already retired — nothing to heal)."""
+        for slot in self._slots:
+            if slot.state is _SlotState.FREE \
+                    or slot.request.id != request_id:
+                continue
+            req = slot.request
+            self._emit('serve.preempt', request_id=req.id,
+                       slot=slot.index, requeued=True, expel=True)
+            self._clear_slot(slot)
+            req.tokens = []
+            req.first_token_at = None
+            self._g_active.set(sum(s.state is not _SlotState.FREE
+                                   for s in self._slots))
+            self._update_readiness()
+            return req
+        for i, req in enumerate(self.admission._queue):
+            if req.id == request_id:
+                # del by index, not remove(req): deque.remove falls
+                # back to Request's field-wise __eq__ past the
+                # identity check, and comparing numpy prompt arrays
+                # raises on any request queued AHEAD of the victim.
+                del self.admission._queue[i]
+                self.admission._update_depth()
+                self._update_readiness()
+                return req
+        return None
 
     # -- the loop -------------------------------------------------------
     def step(self) -> bool:

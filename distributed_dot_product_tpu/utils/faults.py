@@ -50,7 +50,8 @@ from distributed_dot_product_tpu.utils import checkpoint as _ckpt
 __all__ = ['FaultPlan', 'FaultInjector', 'SimulatedCrash', 'plan_from_env',
            'poison_batch', 'ServeFaultPlan', 'ServeFaultInjector',
            'serve_plan_from_env', 'burst_prompts',
-           'ChaosPlan', 'ChaosInjector', 'chaos_plan_from_env']
+           'ChaosPlan', 'ChaosInjector', 'chaos_plan_from_env',
+           'ChaosSpecError']
 
 
 class SimulatedCrash(BaseException):
@@ -426,42 +427,98 @@ class ChaosPlan:
     # This replica stops answering router liveness probes (process
     # alive, network dead): loss must come from the probe timeout path.
     probe_blackhole: Optional[str] = None
+    # Flip one bit in a live KV page of this replica at this tick
+    # (name, page, tick). `page` indexes the replica's TRACKED
+    # (registry) pages — sorted order, modulo the tracked count — so a
+    # seeded trace corrupts the same prefix page whatever the pool's
+    # allocation history; the flip defers to the first tick at/after
+    # `tick` with any tracked page.
+    page_corrupt: Optional[Tuple[str, int, int]] = None
+    # Kill the shared prefill pool at this tick: routing must fall
+    # back to flat prefill on the decode replicas, never block.
+    prefill_crash: Optional[int] = None
     fire_once: bool = True
 
     def any(self):
         return (self.replica_crash is not None
                 or self.crash_in_handoff is not None
-                or self.probe_blackhole is not None)
+                or self.probe_blackhole is not None
+                or self.page_corrupt is not None
+                or self.prefill_crash is not None)
+
+
+class ChaosSpecError(ValueError):
+    """A ``DDP_TPU_FAULT_*`` chaos knob holds a malformed spec. The
+    message names the knob and its grammar — a typo'd chaos run must
+    die loudly, not silently run fault-free."""
+
+
+def _spec_name(spec):
+    return spec
+
+
+def _spec_tick(spec):
+    return int(spec)
+
+
+def _spec_name_tick(spec):
+    name, _, tick = spec.rpartition(':')
+    if not name:
+        raise ValueError(spec)
+    return (name, int(tick))
+
+
+def _spec_name_page_tick(spec):
+    parts = spec.split(':')
+    if len(parts) != 3 or not parts[0]:
+        raise ValueError(spec)
+    return (parts[0], int(parts[1]), int(parts[2]))
+
+
+# The one knob table: env key -> (plan field, spec parser, grammar).
+# Adding a chaos knob is one row; the parser below gives every row the
+# same typed-error discipline.
+_CHAOS_KNOBS = (
+    ('DDP_TPU_FAULT_REPLICA_CRASH', 'replica_crash',
+     _spec_name_tick, '<replica>:<tick>'),
+    ('DDP_TPU_FAULT_HANDOFF_CRASH', 'crash_in_handoff',
+     _spec_name, '<replica>'),
+    ('DDP_TPU_FAULT_PROBE_BLACKHOLE', 'probe_blackhole',
+     _spec_name, '<replica>'),
+    ('DDP_TPU_FAULT_PAGE_CORRUPT', 'page_corrupt',
+     _spec_name_page_tick, '<replica>:<page>:<tick>'),
+    ('DDP_TPU_FAULT_PREFILL_CRASH', 'prefill_crash',
+     _spec_tick, '<tick>'),
+)
 
 
 def chaos_plan_from_env(environ=None) -> ChaosPlan:
-    """Build a :class:`ChaosPlan` from ``DDP_TPU_FAULT_*`` env knobs
-    (an empty plan when none are set):
+    """Build a :class:`ChaosPlan` from the ``DDP_TPU_FAULT_*`` env
+    knobs (an empty plan when none are set), table-driven over
+    ``_CHAOS_KNOBS``:
 
     - ``DDP_TPU_FAULT_REPLICA_CRASH=r1:40``   kill replica r1 at tick 40
     - ``DDP_TPU_FAULT_HANDOFF_CRASH=r1``      kill r1 mid-KV-handoff
     - ``DDP_TPU_FAULT_PROBE_BLACKHOLE=r1``    r1 stops answering probes
-    """
+    - ``DDP_TPU_FAULT_PAGE_CORRUPT=r1:0:40``  flip a bit in r1's
+      tracked page #0 at tick 40
+    - ``DDP_TPU_FAULT_PREFILL_CRASH=40``      kill the prefill pool at
+      tick 40
+
+    Malformed specs raise :class:`ChaosSpecError` naming the knob and
+    its grammar."""
     env = os.environ if environ is None else environ
-
-    def _name(key):
-        v = env.get(key, '').strip()
-        return v or None
-
-    crash = None
-    spec = env.get('DDP_TPU_FAULT_REPLICA_CRASH', '').strip()
-    if spec:
-        name, _, tick = spec.rpartition(':')
-        if not name:
-            raise ValueError(
-                f'DDP_TPU_FAULT_REPLICA_CRASH={spec!r}: expected '
-                f'<replica>:<tick>')
-        crash = (name, int(tick))
-    return ChaosPlan(
-        replica_crash=crash,
-        crash_in_handoff=_name('DDP_TPU_FAULT_HANDOFF_CRASH'),
-        probe_blackhole=_name('DDP_TPU_FAULT_PROBE_BLACKHOLE'),
-    )
+    fields = {}
+    for key, field, parse, grammar in _CHAOS_KNOBS:
+        spec = env.get(key, '').strip()
+        if not spec:
+            continue
+        try:
+            fields[field] = parse(spec)
+        except ValueError as exc:
+            raise ChaosSpecError(
+                f'{key}={spec!r}: expected {grammar}') from exc
+    return ChaosPlan(**fields)
 
 
 class ChaosInjector:
@@ -483,6 +540,8 @@ class ChaosInjector:
         self._crash_fired = False
         self._handoff_fired = False
         self._blackhole_announced = False
+        self._corrupt_fired = False
+        self._prefill_fired = False
         # Observability sink: the driver points this at the ROUTER's
         # log — injections land next to the loss/recovery arc they
         # cause; None falls back to the active log.
@@ -508,6 +567,35 @@ class ChaosInjector:
         self._handoff_fired = True
         obs_events.emit('fault.inject', _log=self.event_log,
                         kind='handoff_crash', target=target)
+        return True
+
+    def corrupt_due(self, tick):
+        """The loadgen's per-tick corruption hook: at/after the planned
+        tick, return ``(replica, page_index)`` once — the ChaosSchedule
+        resolves the index over the replica's tracked pages and flips
+        one bit host-side. None otherwise."""
+        p = self.plan
+        if p.page_corrupt is None:
+            return None
+        name, page, at_tick = p.page_corrupt
+        if tick < at_tick or (p.fire_once and self._corrupt_fired):
+            return None
+        self._corrupt_fired = True
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='page_corrupt', target=name, page=page,
+                        tick=tick)
+        return (name, page)
+
+    def prefill_crash_due(self, tick):
+        """True exactly once when the planned prefill-pool crash tick
+        arrives — the ChaosSchedule kills the pool there."""
+        p = self.plan
+        if p.prefill_crash is None or tick != p.prefill_crash \
+                or (p.fire_once and self._prefill_fired):
+            return False
+        self._prefill_fired = True
+        obs_events.emit('fault.inject', _log=self.event_log,
+                        kind='prefill_crash', tick=tick)
         return True
 
     def blackholed(self, name):

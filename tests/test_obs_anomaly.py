@@ -250,7 +250,7 @@ def test_default_watches_catalog():
     watches = default_watches(queue_limit=8, paged=True)
     names = {w.name for w in watches}
     assert names == {'ttft_p99', 'tokens_per_s', 'queue_depth',
-                     'reject_rate', 'pages_free'}
+                     'reject_rate', 'pages_free', 'kv_corrupt'}
     by_name = {w.name: w for w in watches}
     assert by_name['ttft_p99'].actions == ('profile', 'dump')
     assert isinstance(by_name['queue_depth'].detector, StaticThreshold)

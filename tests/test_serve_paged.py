@@ -409,7 +409,7 @@ def test_cache_stats_on_slab_engine_reports_zeros():
     eng = _engine('slab', 2)
     assert eng.cache_stats() == {'pages': 0, 'pages_used': 0,
                                  'pages_free': 0, 'shared_pages': 0,
-                                 'page_size': 0}
+                                 'pages_quarantined': 0, 'page_size': 0}
 
 
 def test_never_placeable_prefix_rider_rejects_instead_of_stalling():
