@@ -143,6 +143,14 @@ def parse_args():
                         help='decode-serve --cache-mode paged: pool '
                              'page granularity in rows (= the fused '
                              "kernel's K split; must divide --seq-len)")
+    parser.add_argument('--kv-shards', type=int, default=None,
+                        help='decode / decode-serve: shard each paged '
+                             "KV pool across the mesh's seq axis (N "
+                             'members, each owning a contiguous page '
+                             'range and a fixed per-shard pool) — '
+                             'rows record capacity_tokens per shard '
+                             'count, the linear-scaling acceptance '
+                             'column')
     parser.add_argument('--spec', choices=['off', 'ngram', 'draft'],
                         default='off',
                         help='decode mode: speculative (draft-verify) '
@@ -1074,14 +1082,35 @@ def run_decode_serve(args):
     # hold at this run's per-sequence fill, so the recorded
     # max_concurrent is an honest same-budget number.
     budget_rows = slots_slab * t_max
+    kv_shards = args.kv_shards or 1
+    if kv_shards > 1 and not paged:
+        raise SystemExit('--kv-shards needs --cache-mode paged (the '
+                         'sharded unit is the page pool)')
     if paged:
         page_size = args.page_size
         if t_max % page_size:
             raise SystemExit(f'--page-size {page_size} must divide '
                              f'the cache length {t_max}')
+        # Under --kv-shards the slab-budget pool is PER SHARD (the
+        # fixed-per-shard-pool framing): replica capacity is
+        # kv_shards x the slab budget, and the row records
+        # capacity_tokens so shard-count sweeps trace the line.
         pages = budget_rows // page_size
         pages_per_seq = -(-steps_per_seq // page_size)
-        slots = max(1, min(4 * slots_slab, pages // pages_per_seq))
+        if kv_shards > 1:
+            # Contiguous ordinal ownership concentrates every stream's
+            # EARLY pages on the low shards — short sequences gain no
+            # concurrency from extra shards (the feature buys context
+            # length, not batch). Size slots by the tightest shard.
+            pps_total = t_max // page_size
+            ops = -(-pps_total // kv_shards)
+            by_shard = [0] * kv_shards
+            for o in range(pages_per_seq):
+                by_shard[min(o // ops, kv_shards - 1)] += 1
+            per_shard_cap = min(pages // c for c in by_shard if c)
+            slots = max(1, min(4 * slots_slab, per_shard_cap))
+        else:
+            slots = max(1, min(4 * slots_slab, pages // pages_per_seq))
     else:
         page_size = pages = None
         slots = slots_slab
@@ -1097,7 +1126,8 @@ def run_decode_serve(args):
 
     def make_engine():
         extra = (dict(cache_mode='paged', pages=pages,
-                      page_size=page_size) if paged else {})
+                      page_size=page_size, kv_shards=kv_shards)
+                 if paged else {})
         return KernelEngine(slots=slots, t_max=t_max, vocab=256, heads=h,
                             head_dim=d, prefill_chunk=8, seed=0,
                             decode_impl=(None if args.decode_impl == 'auto'
@@ -1254,13 +1284,18 @@ def run_decode_serve(args):
     if paged:
         record.update({
             'page_size': page_size, 'pages': pages,
+            'kv_shards': kv_shards,
+            'capacity_tokens': eng.capacity_tokens,
             'pages_used_peak': peak['pages_used'],
-            'page_utilization_peak': peak['pages_used'] / pages,
+            'page_utilization_peak': peak['pages_used']
+                                     / (kv_shards * pages),
         })
     paged_note = ('' if not paged else
-                  f" pages={peak['pages_used']}/{pages} "
+                  f" pages={peak['pages_used']}/{kv_shards * pages} "
                   f"({100.0 * record['page_utilization_peak']:.0f}% "
-                  f"peak)")
+                  f"peak"
+                  + (f', kv_shards={kv_shards}' if kv_shards > 1
+                     else '') + ')')
     print(f"decode-serve[{impl_resolved}/{args.cache_mode}] "
           f"slots={slots} t_max={t_max} "
           f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
@@ -1269,6 +1304,80 @@ def run_decode_serve(args):
           f"TTFT {record['ttft_ms']:.1f} ms, "
           f"peak {peak['busy']} concurrent at "
           f"{kv_budget_bytes / 2**20:.1f} MiB KV{paged_note})")
+    _append_record(args.file, record)
+    return record
+
+
+def run_decode_kv_sharded(args):
+    """``--mode decode --kv-shards N``: the cluster-scale long-context
+    row. One stream decodes against a paged pool sharded across the
+    mesh's ``seq`` axis with a FIXED per-shard pool (a quarter of
+    ``t_max``'s pages per shard), so ``capacity_tokens`` — the longest
+    stream this engine can hold — is the linear-scaling acceptance
+    column: ~N/4 × ``t_max``, clamped at ``t_max``. The timed unit is
+    the steady-state sharded decode step (psum/pmax flash merge over
+    per-shard page ranges) at a near-capacity fill."""
+    import time as _time
+
+    import numpy as np
+
+    from distributed_dot_product_tpu.serve import KernelEngine
+
+    t_max = args.seq_len or 4096
+    page_size = args.page_size
+    if t_max % page_size:
+        raise SystemExit(f'--page-size {page_size} must divide the '
+                         f'cache length {t_max}')
+    n = args.kv_shards
+    # The fixed per-shard pool: one shard covers a quarter of t_max,
+    # four shards cover it exactly — the sweep over --kv-shards 1..4
+    # traces the capacity line without moving any other knob.
+    pages_per_shard = max(1, t_max // page_size // 4)
+    eng = KernelEngine(
+        slots=1, t_max=t_max, vocab=256, heads=args.heads,
+        head_dim=args.head_dim, prefill_chunk=8, seed=0,
+        decode_impl=(None if args.decode_impl == 'auto'
+                     else args.decode_impl),
+        cache_mode='paged', page_size=page_size,
+        pages=pages_per_shard, kv_shards=n)
+    capacity = eng.capacity_tokens
+    pool_tokens = eng.pool.pages * page_size
+    # Fill to near capacity, leaving headroom for the timed steps —
+    # decode cost is what the row is about, measured against a stream
+    # as long as this shard count can hold.
+    timed_steps = 48
+    fill = max(8, capacity - timed_steps - 8)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 256, size=fill).astype(np.int32)
+    with span('benchmark.prefill', mode='decode-kv-sharded'):
+        for i in range(0, fill, eng.prefill_chunk):
+            eng.prefill(0, prompt[i:i + eng.prefill_chunk])
+    tokens = np.asarray([int(prompt[-1])], np.int32)
+    active = np.ones(1, bool)
+    with span('benchmark.compile', mode='decode-kv-sharded'):
+        tokens, _ = eng.step(tokens, active)      # compile + warm
+    with span('benchmark.measure', mode='decode-kv-sharded'):
+        t0 = _time.perf_counter()
+        for _ in range(timed_steps):
+            tokens, _ = eng.step(tokens, active)
+        np.asarray(tokens)                        # flush the last step
+        elapsed = _time.perf_counter() - t0
+    ms_per_token = elapsed / timed_steps * 1e3
+    record = {
+        'mode': 'decode', 'kv_shards': n, 't_max': t_max,
+        'heads': args.heads, 'head_dim': args.head_dim,
+        'page_size': page_size, 'pages_per_shard': pages_per_shard,
+        'capacity_tokens': capacity, 'pool_tokens': pool_tokens,
+        'fill': fill, 'decode_impl': eng.decode_impl,
+        'ms_per_token': ms_per_token,
+        'tokens_per_s': 1e3 / ms_per_token,
+        'platform': jax.devices()[0].platform,
+        'device_kind': jax.devices()[0].device_kind,
+    }
+    print(f'decode[kv_shards={n}] t_max={t_max} '
+          f'capacity={capacity} tokens '
+          f'({pages_per_shard} pages/shard x {page_size} rows x {n}): '
+          f'{ms_per_token:.3f} ms/token at fill={fill}')
     _append_record(args.file, record)
     return record
 
@@ -2114,6 +2223,10 @@ def run(args):
         return run_train(args)
     if args.mode == 'decode' and args.spec != 'off':
         return run_decode_spec(args)
+    if args.mode == 'decode' and args.kv_shards:
+        # Explicit --kv-shards (1 included — the sweep's baseline row)
+        # selects the sharded-pool capacity row.
+        return run_decode_kv_sharded(args)
     if args.mode == 'decode':
         return run_decode(args)
     if args.mode == 'decode-serve':
@@ -2236,6 +2349,18 @@ def _write_metrics_out(args, record):
 
 def main():
     args = parse_args()
+    if args.kv_shards and args.kv_shards > 1 \
+            and (os.environ.get('JAX_PLATFORMS', '') or 'cpu') \
+            .startswith('cpu'):
+        # The sharded-KV rows need a seq mesh of kv_shards members; on
+        # the CPU backend that width is a config knob that must land
+        # BEFORE the backend initializes (parse_args touches no
+        # device, so this is early enough). Real accelerators bring
+        # their own device count and skip this.
+        from distributed_dot_product_tpu._compat import (
+            ensure_cpu_devices,
+        )
+        ensure_cpu_devices(max(8, args.kv_shards), force_cpu=False)
     if args.multihost:
         from distributed_dot_product_tpu.utils import comm
         comm.init(coordinator_address=args.coordinator,

@@ -828,7 +828,15 @@ def decode_seq_parallel(module, params, mesh, keys, queries, values,
     traces once. A module with an unhashable field (e.g. array ALiBi
     slopes) cannot be cached: that silently rebuilds AND re-traces the
     whole step EVERY token, so it warns once — pass hashable slopes
-    (a tuple) or hold the step from :func:`make_decode_step` yourself."""
+    (a tuple) or hold the step from :func:`make_decode_step` yourself.
+
+    This wrapper shards a contiguous SLAB cache; the paged serving twin
+    is ``KernelEngine(cache_mode='paged', kv_shards=N)``, which shards
+    the page *table* over the same ``seq`` axis (contiguous page-
+    ordinal ownership per member, per-shard flash partials psum/pmax-
+    merged — see ``models.decode.ShardedPageTable``) and keeps paging's
+    admission/eviction/prefix-sharing semantics at pooled-HBM context
+    lengths."""
     global _WARNED_UNHASHABLE
     key = (module, mesh, mesh_axis)
     try:

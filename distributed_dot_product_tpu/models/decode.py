@@ -61,6 +61,7 @@ __all__ = ['DecodeCache', 'init_cache', 'append_kv', 'append_kv_sharded',
            'reset_slot', 'slots_all_finite', 'decode_step',
            'decode_kernel_eligible', 'rollback_slots',
            'PagedDecodeCache', 'PagePool', 'PageChecksums',
+           'ShardedPageTable', 'init_sharded_paged_cache',
            'init_paged_cache', 'paged_gather', 'paged_gather_mirror',
            'paged_append_kv_slots',
            'paged_append_rows', 'paged_reset_slot',
@@ -1222,6 +1223,15 @@ class PageChecksums:
         for page in pages:
             self._crc[int(page)] = self.digest(cache, page)
 
+    def record_at(self, cache, page, row=None):
+        """Record ``page``'s digest computed from pool row ``row``
+        (default: the page itself). The sequence-sharded engines key
+        their per-shard tables by SHARD-LOCAL page id while the page's
+        bytes live at its stacked pool row — this is the one seam
+        where the two id spaces meet."""
+        self._crc[int(page)] = self.digest(
+            cache, page if row is None else row)
+
     def get(self, page):
         return self._crc.get(int(page))
 
@@ -1245,6 +1255,332 @@ class PageChecksums:
             if want is not None and self.digest(cache, page) != want:
                 bad.append(page)
         return sorted(bad)
+
+
+class ShardedPageTable:
+    """Host-side allocator for a SEQUENCE-SHARDED paged cache: one
+    stream's page table split across the mesh's ``seq`` axis so its KV
+    capacity sums over ``n_shards`` pools instead of capping at one
+    chip's HBM (ROADMAP "cluster-scale long context"). Each mesh member
+    owns a CONTIGUOUS run of the logical page ordinals —
+    ``ordinals_per_shard = ceil(pages_per_slot / n_shards)``, shard
+    ``s`` owning ``[s·ops, min((s+1)·ops, pages_per_slot))`` — matching
+    the contiguously sequence-sharded prefill pool, so a long prompt's
+    handoff is shard-local by construction.
+
+    Composition, not reimplementation: ``n_shards`` ordinary
+    :class:`PagePool` instances (one per mesh member, each sized
+    ``pages_per_shard``) SHARING one canonical ``lengths`` vector (the
+    fill is a global property; every shard advances it identically).
+    Each sub-pool's ``table`` keeps the FULL logical width with ``−1``
+    at every ordinal another shard owns — exactly the local view
+    :func:`decode_step`'s paged ring-decode step wants (position math
+    stays global; non-owned appends drop through the ``−1``; non-owned
+    columns are masked/run-gated and the flash ``(num, m, l)`` merge
+    reassembles exact full attention). A sub-pool's ``counts[slot]`` is
+    the global high-watermark ordinal + 1 as seen by that shard — safe
+    for :meth:`PagePool.prepare_append`'s routing because fill advances
+    ordinal-sequentially and every mapped ordinal below a shard's
+    watermark inside its owned range holds a real page.
+
+    Methods that touch more than one sub-pool (:meth:`reserve_rows`
+    with its cross-shard rollback, :meth:`release`, :meth:`truncate`,
+    :meth:`attach`) are implemented here; single-ordinal operations
+    route to the owning sub-pool. Returned page ids are LOCAL to their
+    shard — every (page, shard) crossing is explicit in the signatures,
+    so the engine cannot confuse a shard-local id for a global one."""
+
+    def __init__(self, n_shards, pages_per_shard, page_size, slots,
+                 pages_per_slot):
+        if n_shards < 2:
+            raise ValueError(f'need n_shards >= 2 (a single shard is a '
+                             f'plain PagePool), got {n_shards}')
+        self.n_shards = n_shards
+        self.pages_per_shard = pages_per_shard
+        self.page_size = page_size
+        self.slots = slots
+        self.pages_per_slot = pages_per_slot
+        self.ordinals_per_shard = -(-pages_per_slot // n_shards)
+        self.shards = [PagePool(pages_per_shard, page_size, slots,
+                                pages_per_slot)
+                       for _ in range(n_shards)]
+        # ONE canonical fill vector: rebind every sub-pool's lengths to
+        # the same array object so `pool.lengths[slot] += 1` through
+        # any alias (including the engine's) advances all shards.
+        self.lengths = self.shards[0].lengths
+        for p in self.shards[1:]:
+            p.lengths = self.lengths
+
+    # -- geometry -------------------------------------------------------
+    def owner(self, ordinal):
+        """Mesh member owning logical page ``ordinal``."""
+        return min(ordinal // self.ordinals_per_shard,
+                   self.n_shards - 1)
+
+    def owned_range(self, shard):
+        """``(lo, hi)``: the contiguous ordinal run shard ``shard``
+        owns (the last shard absorbs the ceil-split remainder)."""
+        lo = min(self.pages_per_slot, shard * self.ordinals_per_shard)
+        hi = (self.pages_per_slot if shard == self.n_shards - 1
+              else min(self.pages_per_slot,
+                       (shard + 1) * self.ordinals_per_shard))
+        return lo, hi
+
+    def owner_vector(self):
+        """``(pages_per_slot,) int32``: ordinal → owning shard."""
+        return np.asarray([self.owner(o)
+                           for o in range(self.pages_per_slot)],
+                          np.int32)
+
+    # -- aggregate introspection ---------------------------------------
+    @property
+    def pages(self):
+        """Allocatable pages summed across shards — the capacity the
+        tentpole scales linearly with mesh size."""
+        return self.n_shards * self.pages_per_shard
+
+    @property
+    def free_pages(self):
+        return sum(p.free_pages for p in self.shards)
+
+    @property
+    def free_pages_by_shard(self):
+        return [p.free_pages for p in self.shards]
+
+    @property
+    def used_pages(self):
+        return sum(p.used_pages for p in self.shards)
+
+    @property
+    def shared_pages(self):
+        return sum(p.shared_pages for p in self.shards)
+
+    @property
+    def quarantined(self):
+        """Withdrawn pages as ``(shard, local_page)`` pairs — local ids
+        only mean something next to their shard."""
+        return {(s, page) for s, p in enumerate(self.shards)
+                for page in p.quarantined}
+
+    @property
+    def dirty(self):
+        return any(p.dirty for p in self.shards)
+
+    @dirty.setter
+    def dirty(self, value):
+        for p in self.shards:
+            p.dirty = bool(value)
+
+    def pages_for_rows(self, rows):
+        return -(-rows // self.page_size)
+
+    def slot_pages(self, slot):
+        """Pages actually mapped for ``slot`` across all shards."""
+        return sum(int(np.sum(p.table[slot] >= 0)) for p in self.shards)
+
+    def covered_rows(self, slot):
+        """Longest ``[0, r)`` row prefix of ``slot`` whose pages are
+        all mapped (chunked prefill's no-fail-mid-prompt check)."""
+        o = 0
+        while (o < self.pages_per_slot
+               and int(self.shards[self.owner(o)].table[slot, o]) >= 0):
+            o += 1
+        return o * self.page_size
+
+    def local_tables(self):
+        """``(n_shards, slots, pages_per_slot) int32`` stacked local
+        views — the device mirror the sharded decode program reads
+        (axis 0 sharded over the ``seq`` mesh axis)."""
+        return np.stack([p.table for p in self.shards]).astype(np.int32)
+
+    # -- allocation -----------------------------------------------------
+    def prepare_append(self, slot):
+        """:meth:`PagePool.prepare_append` routed to the shard owning
+        the slot's next append ordinal. Returns ``(status, shard, src,
+        dst)`` — ``shard`` names the pool the status is about (−1 for
+        'full'), so exhaustion reports can say WHICH shard's range is
+        out of pages while the others still have headroom."""
+        pos = int(self.lengths[slot])
+        pi = pos // self.page_size
+        if pi >= self.pages_per_slot:
+            return ('full', -1, -1, -1)
+        s = self.owner(pi)
+        st, src, dst = self.shards[s].prepare_append(slot)
+        return (st, s, src, dst)
+
+    def reserve_rows(self, slot, rows):
+        """Cross-shard :meth:`PagePool.reserve_rows`: reserve every
+        page covering rows ``[length, length + rows)`` wherever they
+        are owned. Returns ``(ok, copies)`` with ``copies`` a list of
+        ``(shard, src, dst)`` device copies owed. On ANY shard's
+        exhaustion nothing is changed anywhere — the rollback spans
+        shards (a shed admission must not leak pages into pool A
+        because pool B was full)."""
+        start = int(self.lengths[slot])
+        end = start + rows
+        if end > self.pages_per_slot * self.page_size:
+            return False, []
+        counts0 = [int(p.counts[slot]) for p in self.shards]
+        undo = []                     # (shard, pi, prev, was_cow)
+        copies = []
+        for pi in range(start // self.page_size,
+                        -(-end // self.page_size)):
+            s = self.owner(pi)
+            pool = self.shards[s]
+            if pi >= int(pool.counts[slot]) \
+                    or int(pool.table[slot, pi]) < 0:
+                page = pool.alloc()
+                if page is None:
+                    self._undo_reserve(slot, undo, counts0)
+                    return False, []
+                undo.append((s, pi, -1, False))
+                pool.table[slot, pi] = page
+                pool.counts[slot] = max(int(pool.counts[slot]), pi + 1)
+                pool.dirty = True
+            else:
+                page = int(pool.table[slot, pi])
+                if pool.refcount[page] > 1:
+                    dup = pool.alloc()
+                    if dup is None:
+                        self._undo_reserve(slot, undo, counts0)
+                        return False, []
+                    undo.append((s, pi, page, True))
+                    pool.refcount[page] -= 1
+                    pool.table[slot, pi] = dup
+                    copies.append((s, page, dup))
+                    pool.dirty = True
+        return True, copies
+
+    def _undo_reserve(self, slot, undo, counts0):
+        for s, pi, prev, was_cow in reversed(undo):
+            pool = self.shards[s]
+            page = int(pool.table[slot, pi])
+            pool.refcount[page] = 0
+            pool._free.append(page)
+            pool.table[slot, pi] = prev
+            if was_cow:
+                pool.refcount[prev] += 1
+        for s, c in enumerate(counts0):
+            self.shards[s].counts[slot] = c
+
+    def release(self, slot):
+        """Evict ``slot`` everywhere. Returns ``{shard: [pages]}`` of
+        LOCAL pages that hit refcount 0 — the caller zeroes each
+        shard's list in that shard's pool (the alloc invariant, per
+        shard)."""
+        freed = {}
+        for s, pool in enumerate(self.shards):
+            for pi in range(int(pool.counts[slot])):
+                page = int(pool.table[slot, pi])
+                if page >= 0 and pool._unref(page):
+                    freed.setdefault(s, []).append(page)
+            pool.table[slot, :] = -1
+            pool.counts[slot] = 0
+            pool.dirty = True
+        self.lengths[slot] = 0
+        return freed
+
+    def truncate(self, slot, new_length):
+        """Cross-shard :meth:`PagePool.truncate` — NOT a per-shard
+        delegation: the shared ``lengths`` vector would make the first
+        sub-pool's early-out hide every other shard's tail pages.
+        Returns ``{shard: [freed local pages]}``."""
+        if new_length >= int(self.lengths[slot]):
+            return {}
+        keep = self.pages_for_rows(int(new_length))
+        freed = {}
+        for s, pool in enumerate(self.shards):
+            for pi in range(keep, int(pool.counts[slot])):
+                page = int(pool.table[slot, pi])
+                if page >= 0:
+                    if pool._unref(page):
+                        freed.setdefault(s, []).append(page)
+                    pool.table[slot, pi] = -1
+                    pool.dirty = True
+            pool.counts[slot] = min(int(pool.counts[slot]), keep)
+        self.lengths[slot] = new_length
+        return freed
+
+    # -- sharing --------------------------------------------------------
+    def attach(self, slot, ordinal_pages, length):
+        """Point an EMPTY slot at registry pages laid out by ordinal:
+        ``ordinal_pages (pages_per_slot,) int`` holds, at each ordinal
+        the prefix covers, the LOCAL page id in the OWNING shard's pool
+        (−1 elsewhere). Full pages are shared read-only (refcount++ on
+        their shard); a partial tail page gets a private copy on the
+        tail ordinal's owner. Returns ``(ok, tail_shard, tail_src,
+        tail_dst)`` — −1s when the prefix ends on a page boundary; on
+        tail-page exhaustion nothing is changed."""
+        if self.lengths[slot] or any(int(p.counts[slot])
+                                     for p in self.shards):
+            raise ValueError(f'attach needs an empty slot, slot {slot} '
+                             f'is in use')
+        full = length // self.page_size
+        rem = length % self.page_size
+        tail_shard = tail_src = tail_dst = -1
+        if rem:
+            tail_shard = self.owner(full)
+            tail_dst = self.shards[tail_shard].alloc()
+            if tail_dst is None:
+                return False, -1, -1, -1
+            tail_src = int(ordinal_pages[full])
+        for o in range(full):
+            s = self.owner(o)
+            pool = self.shards[s]
+            pg = int(ordinal_pages[o])
+            pool.table[slot, o] = pg
+            pool.refcount[pg] += 1
+            pool.counts[slot] = o + 1
+            pool.dirty = True
+        if rem:
+            pool = self.shards[tail_shard]
+            pool.table[slot, full] = tail_dst
+            pool.counts[slot] = full + 1
+            pool.dirty = True
+        self.lengths[slot] = length
+        return True, tail_shard, tail_src, tail_dst
+
+    def release_pages_on(self, shard, pages):
+        """Per-shard :meth:`PagePool.release_pages` (registry release);
+        returns the LOCAL pages owed a zero in that shard's pool."""
+        return self.shards[shard].release_pages(pages)
+
+    def quarantine(self, shard, pages):
+        """Withdraw LOCAL ``pages`` of ``shard`` from circulation;
+        returns the pages newly quarantined on that shard."""
+        return self.shards[shard].quarantine(pages)
+
+
+def init_sharded_paged_cache(n_shards, slots, kv_heads, t_max, head_dim,
+                             *, pages_per_shard, page_size,
+                             v_head_dim=None, dtype=jnp.bfloat16):
+    """Zero STACKED sharded paged cache — the device twin of
+    :class:`ShardedPageTable`. Pools stack the per-shard
+    ``(pages_per_shard + 1, H_kv, page_size, d·)`` local pools (each
+    with its OWN sink row) along axis 0, page tables stack the local
+    views along a leading ``(n_shards,)`` axis, and the fill vector is
+    replicated. Shard everything but ``length`` over the ``seq`` mesh
+    axis (``P(SEQ_AXIS)`` on axis 0) and each ``shard_map`` member sees
+    a perfectly ordinary local :class:`PagedDecodeCache` — the whole
+    point of the layout: the local decode step, append drop semantics
+    and sink-redirect contracts apply verbatim per shard. Shard ``s``'s
+    local page ``p`` lives at stacked row ``s·(pages_per_shard+1)+p``
+    (the engine's host-side transfer/zero bookkeeping uses this)."""
+    v_head_dim = v_head_dim or head_dim
+    if page_size < 1 or t_max % page_size:
+        raise ValueError(f'page_size {page_size} must divide t_max '
+                         f'{t_max}')
+    if n_shards < 2 or pages_per_shard < 1:
+        raise ValueError(f'need n_shards >= 2 and pages_per_shard >= 1, '
+                         f'got {n_shards}/{pages_per_shard}')
+    rows = n_shards * (pages_per_shard + 1)
+    return PagedDecodeCache(
+        k_pool=jnp.zeros((rows, kv_heads, page_size, head_dim), dtype),
+        v_pool=jnp.zeros((rows, kv_heads, page_size, v_head_dim),
+                         dtype),
+        page_table=jnp.full((n_shards, slots, t_max // page_size), -1,
+                            jnp.int32),
+        length=jnp.zeros((slots,), jnp.int32))
 
 
 def _paged_mirror_fixup(cache: PagedDecodeCache, k_new, ap, nvec):
@@ -1277,7 +1613,7 @@ def _paged_mirror_fixup(cache: PagedDecodeCache, k_new, ap, nvec):
 
 
 def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None,
-                           explain=False):
+                           explain=False, n_shards=1, shard=None):
     """Can :func:`decode_step` take the fused Pallas kernel for this
     call? The kernel covers the serving hot path — ``1 <= n <= K split``
     new rows per slot per step (n = 1 classic decode; n > 1 the fused
@@ -1298,16 +1634,60 @@ def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None,
     ``None`` when eligible, else a string naming the exact gap (the
     string ``impl='kernel'``'s ValueError and ``impl='auto'``'s
     fallback decision rest on), so a silent XLA fallback is one probe
-    away from an explanation."""
+    away from an explanation.
+
+    MESH GEOMETRY: ``n_shards > 1`` describes a sequence-sharded step
+    (``cache`` is then ONE shard's local view — a shard of the sharded
+    page table, or one slab of the slab-sharded cache) and ``shard``
+    optionally names which mesh member is being probed. With
+    ``explain=True`` every verdict then carries the geometry — shard
+    count and the member's owned page-ordinal/column range — so an
+    eligible sharded probe returns ``(True, '<geometry>')`` rather
+    than ``(True, None)``, and an ineligible one explains the gap PER
+    SHARD (``'<geometry> — <reason>'``). Kernel-specific sharded
+    restriction: the flash-decoding merge carries one query row per
+    shard, so ``n != 1`` is ineligible under sharding."""
     from distributed_dot_product_tpu.ops.pallas_decode import (
         _BLOCK_K_CAP,
         decode_block_k,
     )
 
+    geom = None
+    if n_shards > 1:
+        if isinstance(cache, PagedDecodeCache):
+            pps = cache.pages_per_slot
+            local = -(-pps // n_shards)
+            if shard is None:
+                own = (f'each of the {n_shards} shards owns a '
+                       f'contiguous run of {local} of the {pps} '
+                       f'logical page ordinals')
+            else:
+                lo = shard * local
+                hi = min(pps, lo + local)
+                own = (f'shard {shard}/{n_shards} owns logical page '
+                       f'ordinals [{lo}, {hi}) of {pps}')
+            geom = f'sequence-sharded page table: {own}'
+        else:
+            t_loc = cache.t_max
+            if shard is None:
+                own = (f'each of the {n_shards} shards owns a '
+                       f'{t_loc}-column slab')
+            else:
+                own = (f'shard {shard}/{n_shards} owns columns '
+                       f'[{shard * t_loc}, {(shard + 1) * t_loc})')
+            geom = f'sequence-sharded slab: {own}'
+
     def verdict(reason):
         ok = reason is None
+        if geom is not None:
+            reason = geom if ok else f'{geom} — {reason}'
         return (ok, reason) if explain else ok
 
+    if n_shards > 1 and n != 1:
+        return verdict(f'the sharded kernel step is single-token (its '
+                       f'flash-decoding merge carries one query row '
+                       f'per shard), got n={n} — the XLA formulation '
+                       f'covers sharded verify-k')
     if n < 1:
         return verdict(f'needs at least one query row (n={n})')
     if segment_ids is not None:
@@ -1412,8 +1792,15 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     ``>= c`` produce don't-care outputs the caller discards — they
     attend at their nominal positions over never-written (zero)
     columns). ``axis_name`` runs the sequence-sharded step (inside a
-    ``shard_map``, slab-sharded cache — the kernel path merges shards
-    by the flash-decoding pmax/psum rule; n == 1 only). Overflow
+    ``shard_map``): a SLAB cache is sharded on its ``t_max`` axis
+    (scalar global length), while a PAGED cache runs the paged
+    ring-decode step — each shard holds a local pool plus the LOCAL
+    view of the sequence-sharded page table (logical width intact,
+    −1 at every ordinal another shard owns; see
+    :class:`ShardedPageTable`), scores only its own pages, drops
+    non-owned appends through the table's −1, and the shards merge by
+    the flash-decoding pmax/psum rule on both impls (kernel partials
+    or masked XLA partials; n == 1 only on the kernel). Overflow
     follows the append contracts: concrete lengths raise eagerly,
     traced lengths write nothing while the length still advances.
     Returns ``(cache, out (B, H, n, d_v))``.
@@ -1423,15 +1810,12 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
                                 axis_name=axis_name)
     paged = isinstance(cache, PagedDecodeCache)
     per_slot = cache.length.ndim == 1
-    if paged and axis_name is not None:
-        raise ValueError(
-            'paged caches are a local serving construct; sequence-'
-            'sharded decode uses the scalar-length slab cache')
-    if per_slot and axis_name is not None:
+    if per_slot and axis_name is not None and not paged:
         raise ValueError(
             'per-slot lengths (init_slot_cache) are a local serving '
             'construct; sequence-sharded decode uses the scalar global '
-            'length')
+            'length (or the sequence-sharded PAGE TABLE — a paged '
+            'cache whose table holds only this shard\'s ordinals)')
     if slot_mask is not None and not per_slot:
         raise ValueError('slot_mask needs a per-slot cache '
                          '(init_slot_cache); scalar-length caches share '
@@ -1448,15 +1832,21 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
 
     if impl == 'xla':
         before = cache.length
-        if axis_name is not None:
+        if axis_name is not None and not paged:
             cache = append_kv_sharded(cache, k_new, v_new,
                                       axis_name=axis_name)
         elif per_slot:
+            # Sharded page table included: the LOCAL table holds −1 at
+            # every ordinal another shard owns, so the drop-mode
+            # scatter discards non-owned appends for free — only the
+            # owning shard's pool takes the row, all shards advance
+            # the (replicated) lengths identically.
             cache = append_kv_slots(cache, k_new, v_new,
                                     slot_mask=slot_mask, counts=counts)
         else:
             cache = append_kv(cache, k_new, v_new)
         attend = cache
+        col_valid = col_offset = None
         if paged:
             # Reference formulation: attend against the gathered slab
             # view — the IDENTICAL masked math as the slab path, so the
@@ -1472,6 +1862,18 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
                 gkq, gks = paged_gather_mirror(cache)
             attend = DecodeCache(k=gk, v=gv, length=cache.length,
                                  k_q=gkq, k_scale=gks)
+            if axis_name is not None:
+                # Sequence-sharded page table: the gathered local view
+                # keeps the table's LOGICAL width, so its columns sit
+                # at GLOBAL positions already (no column offset) — but
+                # ordinals owned by OTHER shards gathered the sink
+                # page and lie BELOW the causal fill, where the
+                # position mask alone would admit them; mask them out
+                # explicitly and let the flash-decoding pmax/psum
+                # merge reassemble exact full attention.
+                col_offset = 0
+                col_valid = jnp.repeat(cache.page_table >= 0,
+                                       cache.page_size, axis=1)
         if per_slot and counts is not None:
             # Verify-k masking base: query row j of slot i sits at
             # position before[i] + j whatever the slot's REAL count —
@@ -1486,7 +1888,8 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
         out = decode_attention(
             q, attend, scale=scale, window=window,
             alibi_slopes=alibi_slopes, segment_ids=segment_ids,
-            seg_q=seg_q, qk_quant=qk_quant, axis_name=axis_name)
+            seg_q=seg_q, qk_quant=qk_quant, axis_name=axis_name,
+            col_valid=col_valid, col_offset=col_offset)
         return cache, out
 
     from distributed_dot_product_tpu.ops.pallas_decode import (
@@ -1495,12 +1898,12 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
     b = q.shape[0]
     t_max = cache.t_max
     nn = None
-    if axis_name is not None:
-        if n != 1:
-            raise ValueError(
-                'the sharded kernel step is single-token (its '
-                'flash-decoding merge carries one row per shard) — '
-                "use impl='xla' for sharded verify-k")
+    if axis_name is not None and n != 1:
+        raise ValueError(
+            'the sharded kernel step is single-token (its '
+            'flash-decoding merge carries one row per shard) — '
+            "use impl='xla' for sharded verify-k")
+    if axis_name is not None and not paged:
         # Sharded slab: the append lands on the owning shard only; the
         # masking bound is the query's GLOBAL position localized to
         # this slab (negative = slab wholly in the future).
@@ -1513,6 +1916,14 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
         ap = jnp.broadcast_to(jnp.where(owner, p - col_off, -1), (b,))
         new_length = cache.length + 1
     else:
+        # Local per-slot/scalar step — REUSED VERBATIM by the sharded
+        # PAGE TABLE: positions are logical-global on every shard (the
+        # local table keeps the logical width), so vt/ap need no
+        # localization. A non-owning shard's ap still names the append
+        # position, but its local table holds −1 at that ordinal, so
+        # the kernel's run-gate skips scoring the append block and the
+        # write-back parks on the sink — only the owner's pool takes
+        # the row, and the flash merge below reassembles the rest.
         lengths = (cache.length if per_slot
                    else jnp.broadcast_to(cache.length, (b,)))
         active = (jnp.ones((b,), bool) if slot_mask is None
@@ -1563,19 +1974,27 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
             k_scale=cache.k_scale_pool if quant_kernel else None,
             qk_quant=qk_quant, scale=scale,
             window=window, alibi_slopes=alibi_slopes,
-            interpret=interpret)
+            interpret=interpret, partials=axis_name is not None)
         if cache.k_q_pool is not None and new_kq is None:
             # Non-int8 step on a mirror-carrying pool: keep the mirror
             # exact by quantizing the appended rows the append-op way
-            # (rare path — mirrors exist for int8 decoding).
+            # (rare path — mirrors exist for int8 decoding). Sharded,
+            # the non-owner's scatter drops through the local table's
+            # −1 exactly like the data append.
             new_kq, new_ks = _paged_mirror_fixup(cache, k_new, ap, nn)
         elif cache.k_q_pool is None:
             new_kq = new_ks = None
-        return PagedDecodeCache(k_pool=new_k, v_pool=new_v,
-                                page_table=cache.page_table,
-                                length=new_length,
-                                k_q_pool=new_kq,
-                                k_scale_pool=new_ks), out
+        cache = PagedDecodeCache(k_pool=new_k, v_pool=new_v,
+                                 page_table=cache.page_table,
+                                 length=new_length,
+                                 k_q_pool=new_kq,
+                                 k_scale_pool=new_ks)
+        if axis_name is not None:
+            # Paged ring-decode merge: each shard scored only the
+            # pages it owns; the (num, m, l) partials combine by the
+            # flash-decoding rule into exact full attention.
+            out = _flash_merge(out, axis_name, cache.v_pool.dtype)
+        return cache, out
 
     res = flash_decode(
         q, k_new, v_new, cache.k, cache.v, vt, ap, n_new=nn,
@@ -1615,15 +2034,21 @@ def decode_step(q, cache: DecodeCache, k_new, v_new, *, slot_mask=None,
                         k_q=new_kq, k_scale=new_ks)
     if axis_name is None:
         return cache, out
-    # Flash-decoding cross-shard merge: shift every shard's partials by
-    # the global base-2 max, then numerator/denominator are plain psums.
-    num, m, l = out
+    return cache, _flash_merge(out, axis_name, cache.v.dtype)
+
+
+def _flash_merge(partials, axis_name, out_dtype):
+    """Flash-decoding cross-shard merge of the kernel's un-normalized
+    ``(num, m, l)`` triple (base-2 running max/denominator): shift
+    every shard's partials by the global ``pmax`` row max, then
+    numerator/denominator are plain ``psum``s — the slab-sharded and
+    page-table-sharded decode steps share this one definition."""
+    num, m, l = partials
     m_g = lax.pmax(m, axis_name)
     corr = jnp.exp2(m - m_g)
     num = lax.psum(num * corr, axis_name)
     den = lax.psum(l * corr, axis_name)
-    out = (num / jnp.where(den == 0.0, 1.0, den)).astype(cache.v.dtype)
-    return cache, out
+    return (num / jnp.where(den == 0.0, 1.0, den)).astype(out_dtype)
 
 
 def graphlint_entrypoints():
@@ -1752,6 +2177,70 @@ def graphlint_entrypoints():
                                  o[0].k_q_pool, o[0].k_scale_pool],
             expect_donation=True, donate_argnums=(1,), min_donated=4)
 
+    def _sharded_paged_args():
+        # Two shards over a pps=4 table (each owns 2 ordinals); a
+        # mid-serve fill: slot 0 holds 10 rows (ordinals 0-1, both
+        # shard 0's), slot 1 holds 3 (ordinal 0 → shard 0's page 2).
+        b, h, d = 2, 2, 8
+        cache = init_sharded_paged_cache(2, b, h, 32, d,
+                                         pages_per_shard=3, page_size=8,
+                                         dtype=jnp.bfloat16)
+        pt = np.full((2, b, 4), -1, np.int32)
+        pt[0, 0, 0] = 0
+        pt[0, 0, 1] = 1
+        pt[0, 1, 0] = 2
+        cache = cache._replace(page_table=jnp.asarray(pt),
+                               length=jnp.array([10, 3], jnp.int32))
+        new = jnp.zeros((b, h, 1, d), jnp.bfloat16)
+        return cache, new
+
+    def _sharded_paged_spec(impl):
+        from jax.sharding import PartitionSpec as P
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+        mesh = seq_mesh(2)
+        cache, new = _sharded_paged_args()
+        cache_spec = PagedDecodeCache(
+            k_pool=P(SEQ_AXIS), v_pool=P(SEQ_AXIS),
+            page_table=P(SEQ_AXIS), length=P(),
+            k_q_pool=None, k_scale_pool=None)
+
+        def body(qq, cc, kk, vv):
+            # Each member squeezes its (1, slots, pps) table block into
+            # the local view and runs the paged ring-decode step; the
+            # merged output is replicated by the psum/pmax rule.
+            local = cc._replace(page_table=cc.page_table[0])
+            out_cache, out = decode_step(
+                qq, local, kk, vv, impl=impl, axis_name=SEQ_AXIS,
+                **({'interpret': True} if impl == 'kernel' else {}))
+            return (out_cache._replace(
+                page_table=out_cache.page_table[None]), out)
+
+        step = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), cache_spec, P(), P()),
+            out_specs=(cache_spec, P()), check_vma=False)
+        suffix = '_kernel' if impl == 'kernel' else ''
+        return TraceSpec(
+            name=f'decode.step_paged_sharded{suffix}', fn=step,
+            args=(new, cache, new, new), mesh_axes=(SEQ_AXIS,),
+            cache_in=lambda a: [a[1].k_pool, a[1].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, donate_argnums=(1,), min_donated=2)
+
+    def step_paged_sharded():
+        # The paged ring-decode step (XLA formulation): the stacked
+        # sharded cache through shard_map — collective-axis and
+        # cache-alias rules must hold across the flash merge.
+        return _sharded_paged_spec('xla')
+
+    def step_paged_sharded_kernel():
+        # Same program on the fused kernel path: per-shard Pallas
+        # partials + the cross-shard pmax/psum merge, cache aliased in
+        # place per shard.
+        return _sharded_paged_spec('kernel')
+
     def step_verify_slab():
         from distributed_dot_product_tpu.analysis.registry import (
             TraceSpec,
@@ -1794,6 +2283,8 @@ def graphlint_entrypoints():
         'decode.step_paged_xla': step_paged_xla,
         'decode.step_paged_kernel': step_paged_kernel,
         'decode.step_paged_kernel_int8': step_paged_kernel_int8,
+        'decode.step_paged_sharded': step_paged_sharded,
+        'decode.step_paged_sharded_kernel': step_paged_sharded_kernel,
         'decode.step_verify_slab': step_verify_slab,
         'decode.step_verify_paged': step_verify_paged,
     }
@@ -1801,7 +2292,8 @@ def graphlint_entrypoints():
 
 def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
                      alibi_slopes=None, segment_ids=None, seg_q=None,
-                     qk_quant=None, axis_name=None):
+                     qk_quant=None, axis_name=None, col_valid=None,
+                     col_offset=None):
     """One masked-softmax attention step of ``q (B, H, n, d)`` against the
     cache prefix; returns ``(B, H, n, d_v)``.
 
@@ -1829,6 +2321,17 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     combine, so the merged result equals the unsharded one). ``q`` is
     replicated; ``segment_ids`` (when used) is the slab's local shard;
     ``cache.length`` is global.
+
+    ``col_offset``: explicit global position of this buffer's column 0
+    (default: ``axis_index · t_max`` when sharded, else 0). The
+    sequence-SHARDED PAGED view passes 0 — a shard's gathered slab
+    keeps the table's LOGICAL width, so its columns already sit at
+    global positions — together with ``col_valid (B, t_local) bool``:
+    ordinals owned by OTHER shards gathered the sink page and lie
+    BELOW the causal fill, where the position mask alone would admit
+    them, so they are masked out explicitly. ``col_offset`` also lifts
+    the per-slot × sharded restriction (the sharded page table is
+    per-slot by construction; slab sharding stays scalar-length).
     """
     b, h, n, d = q.shape
     h_kv = cache.k.shape[1]
@@ -1901,13 +2404,16 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
     # different ages into one compiled step. Sharded, this slab's
     # columns sit at global offset shard·t_local.
     per_slot = cache.length.ndim == 1
-    if per_slot and axis_name is not None:
+    if per_slot and axis_name is not None and col_offset is None:
         raise ValueError(
             'per-slot lengths (init_slot_cache) are a local serving '
             'construct; sequence-sharded decode uses the scalar global '
-            'length')
-    col_off = (0 if axis_name is None
-               else lax.axis_index(axis_name) * t_max)
+            'length — the sharded PAGED view passes col_offset=0')
+    if col_offset is not None:
+        col_off = col_offset
+    else:
+        col_off = (0 if axis_name is None
+                   else lax.axis_index(axis_name) * t_max)
     lengths = cache.length[:, None] if per_slot else cache.length
     pos_q = lengths - n + jnp.arange(n)       # (B, n) per-slot else (n,)
     pos_k = col_off + jnp.arange(t_max)                     # (t_local,)
@@ -1917,6 +2423,11 @@ def decode_attention(q, cache: DecodeCache, *, scale=None, window=None,
         allowed = jnp.logical_and(allowed, -rel < window)
     if not per_slot:
         allowed, rel = allowed[None], rel[None]   # (1, n, t_max)
+    if col_valid is not None:
+        # Columns this buffer does not actually hold (a sharded page
+        # table's other-shard ordinals): masked regardless of position.
+        allowed = jnp.logical_and(
+            allowed, jnp.asarray(col_valid, bool)[:, None, :])
     if segment_ids is not None:
         if seg_q is None:
             raise ValueError('segment_ids needs seg_q (the query rows\' '

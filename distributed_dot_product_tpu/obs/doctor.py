@@ -143,9 +143,15 @@ def diagnose(bundle) -> Incident:
     if corrupt:
         pages = sorted({int(p) for r in corrupt
                         for p in (r.get('pages') or [])})
+        # Sequence-sharded replicas attach the owning kv shard(s) to
+        # the verdict — fold them in so the diagnosis localizes the
+        # flip within the mesh, not just within the pool.
+        shards = sorted({int(s) for r in corrupt
+                         for s in (r.get('shards') or [])})
+        where = f' on kv shard(s) {shards}' if shards else ''
         vote('kv_corruption', 6.0 * len(corrupt),
              f'kv.corrupt verdict(s) on {", ".join(sorted(set(dirty)))}'
-             f' — page(s) {pages} quarantined')
+             f' — page(s) {pages}{where} quarantined')
     inj_corrupt = _count(events, 'fault.inject', kind='page_corrupt')
     if inj_corrupt:
         vote('kv_corruption', 4.0 * inj_corrupt,

@@ -123,14 +123,22 @@ def _make_decode_kernel(bk, ns, n, group, g_pad, h_kv, window,
     or written back, and query rows past a slot's real count only ever
     produce don't-care outputs the caller discards.
 
-    The PAGED variant is the same body verbatim: grid step ``ki`` is the
-    LOGICAL page ordinal, so every mask/score/append computation below
-    already speaks logical positions — only the BlockSpec index maps
-    (which translate logical ordinal → pool page) differ, and those
-    live in ``flash_decode``. The page-table prefetch ref is consumed
-    by the index maps alone."""
+    The PAGED variant is the same body plus ONE extra predicate: grid
+    step ``ki`` is the LOGICAL page ordinal, so every mask/score/append
+    computation below already speaks logical positions — the BlockSpec
+    index maps (which translate logical ordinal → pool page, clamping
+    unallocated/−1 entries to the sink) live in ``flash_decode``, and
+    the body additionally gates its scoring block on
+    ``pt_ref[slot·ns + ki] >= 0``: a −1 table entry means the slot does
+    not hold that ordinal's page in THIS pool — beyond the fill on a
+    single-pool cache, or owned by ANOTHER mesh shard on a sequence-
+    sharded page table — and its sink-redirected bytes must not enter
+    the softmax (their garbage scores would land below the causal fill
+    and pollute the denominator). For a single pool the predicate is
+    redundant with the fill check; for the sharded table it is the
+    whole shard-local page-range view."""
 
-    def kernel_body(vt_ref, ap_ref, nn_ref, *refs):
+    def kernel_body(vt_ref, ap_ref, nn_ref, *refs, pt_ref=None):
         b = pl.program_id(0)
         ki = pl.program_id(1)
         br = b // h_kv                          # cache batch row
@@ -182,6 +190,15 @@ def _make_decode_kernel(bk, ns, n, group, g_pad, h_kv, window,
         run = ki * bk <= vt + (n - 1)
         if window is not None:
             run = jnp.logical_and(run, ki * bk + bk - 1 > vt - window)
+        if pt_ref is not None:
+            # Paged: only score pages this table actually holds — a −1
+            # ordinal streams the sink (see flash_decode's index-map
+            # clamp) and must stay out of the online softmax. On a
+            # sequence-sharded page table this is the shard-local
+            # page-range restriction; the cross-shard pmax/psum merge
+            # of the (num, m, l) partials reassembles exact full
+            # attention.
+            run = jnp.logical_and(run, pt_ref[br * ns + ki] >= 0)
 
         @pl.when(run)
         def _():
@@ -291,8 +308,7 @@ def _make_decode_kernel(bk, ns, n, group, g_pad, h_kv, window,
         return kernel_body
 
     def kernel_paged(vt_ref, ap_ref, nn_ref, pt_ref, *refs):
-        del pt_ref                      # index maps' operand, not ours
-        kernel_body(vt_ref, ap_ref, nn_ref, *refs)
+        kernel_body(vt_ref, ap_ref, nn_ref, *refs, pt_ref=pt_ref)
 
     return kernel_paged
 
@@ -345,10 +361,15 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
     ``cache_k``/``cache_v`` are global ``(pages + 1, H_kv, page_size,
     d·)`` pools whose LAST row is the reserved write-sink page
     (``init_paged_cache`` reserves it) and each slot's K split streams
-    the pool pages its table row names (−1 = unallocated → the sink,
-    fully masked; a slot appending nothing also writes its mandatory
-    block flush to the sink, so no grid row ever writes a live page it
-    doesn't own). The K split IS
+    the pool pages its table row names (−1 = ordinal not held by this
+    pool → the sink, and the kernel's run-gate skips scoring it; a
+    slot appending nothing also writes its mandatory block flush to
+    the sink, so no grid row ever writes a live page it doesn't own).
+    A −1 below the causal fill is how a SEQUENCE-SHARDED page table
+    expresses "another mesh shard owns this ordinal": each shard calls
+    this kernel on its local pool + local table (``partials=True``)
+    and the ``(num, m, l)`` triples pmax/psum-merge into exact full
+    attention — the paged ring-decode step. The K split IS
     the page size, the grid and kernel body are unchanged — paging
     costs one prefetched index lookup per block, not a new kernel —
     and aliasing still writes only the single append page. With
@@ -449,16 +470,19 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         # row index addresses (page, head) exactly like (slot, head).
         kf = cache_k.reshape(n_pages * h_kv, bk, d)
         vf = cache_v.reshape(n_pages * h_kv, bk, dv)
-        # −1 (unallocated) redirects to the pool's reserved SINK row
-        # (last page, never allocated — init_paged_cache): an empty
-        # slot streams sink garbage (fully masked) and, crucially,
-        # never WRITES a page another slot owns — Pallas flushes every
+        # The table rides the prefetch RAW (−1s intact): the kernel
+        # body's run-gate reads the sign to skip ordinals this pool
+        # does not hold — beyond-fill on a single pool, another shard's
+        # range on a sequence-sharded table — while the index maps
+        # below clamp −1 to the pool's reserved SINK row (last page,
+        # never allocated — init_paged_cache): a skipped ordinal
+        # streams sink garbage (never scored) and, crucially, never
+        # WRITES a page another slot owns — Pallas flushes every
         # output block, and grid rows have no cross-row write ordering
         # on real TPU, so parking idle write-backs on a live page
         # would race an in-flight append.
         sink = n_pages - 1
-        ptf = jnp.where(page_table >= 0, page_table,
-                        sink).astype(jnp.int32).reshape(-1)
+        ptf = jnp.asarray(page_table, jnp.int32).reshape(-1)
     else:
         kf = cache_k.reshape(nb, t_max, d)
         vf = cache_v.reshape(nb, t_max, dv)
@@ -500,18 +524,21 @@ def flash_decode(q, k_new, v_new, cache_k, cache_v, valid_to, append_at,
         # paging nearly free (same DMA skip, same aliasing).
         def stream_idx(bi, ki, vt, ap, nn, pt):
             blk = _stream_blk(bi, ki, vt)
-            return (pt[(bi // h_kv) * ns + blk] * h_kv + bi % h_kv,
+            pg = pt[(bi // h_kv) * ns + blk]
+            # −1 (ordinal not held by this pool) → the sink page; the
+            # kernel's run-gate skips scoring it.
+            return (jnp.where(pg >= 0, pg, sink) * h_kv + bi % h_kv,
                     0, 0)
 
         def write_idx(bi, ki, vt, ap, nn, pt):
             # Appending nothing → write-back lands on the sink page,
-            # never on a page some other slot is appending into. (The
-            # prefetched table is pre-clamped: unallocated entries
-            # already point at the sink.)
+            # never on a page some other slot is appending into; same
+            # for a −1 table entry (the table rides RAW — clamp here).
             br = bi // h_kv
             a = ap[br]
             blk = _write_blk(bi, ki, ap, nn)
-            page = jnp.where(a >= 0, pt[br * ns + blk], sink)
+            pg = pt[br * ns + blk]
+            page = jnp.where(jnp.logical_and(a >= 0, pg >= 0), pg, sink)
             return (page * h_kv + bi % h_kv, 0, 0)
 
         # Mirror-scale flat rows are (pages·H_kv, 1, page_size): one

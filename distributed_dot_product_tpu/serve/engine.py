@@ -50,10 +50,11 @@ import jax
 import jax.numpy as jnp
 
 from distributed_dot_product_tpu.models.decode import (
-    PageChecksums, PagePool, append_kv_slots, decode_step,
-    init_paged_cache, init_slot_cache, paged_append_rows,
-    paged_copy_attach, paged_reset_slot, paged_rollback_slots,
-    paged_transfer_pages, reset_slot, rollback_slots, slots_all_finite,
+    PageChecksums, PagedDecodeCache, PagePool, ShardedPageTable,
+    append_kv_slots, decode_step, init_paged_cache, init_slot_cache,
+    init_sharded_paged_cache, paged_append_rows, paged_copy_attach,
+    paged_reset_slot, paged_rollback_slots, paged_transfer_pages,
+    reset_slot, rollback_slots, slots_all_finite,
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
@@ -68,12 +69,19 @@ class PageCorruptionError(RuntimeError):
     'handoff_src', 'handoff_copy') — the router turns this into the
     `kv.corrupt` event + quarantine + heal arc."""
 
-    def __init__(self, pages, site):
+    def __init__(self, pages, site, shards=None):
         self.pages = sorted(int(p) for p in pages)
         self.site = site
-        super().__init__(
-            f'KV page corruption at {site}: page(s) {self.pages} fail '
-            f'checksum verification')
+        # kv_shards engines name the owning mesh member(s) of the dirty
+        # pages (page ids are then STACKED-row ids); None on unsharded
+        # engines — the router forwards this into `kv.corrupt`.
+        self.shards = (sorted({int(s) for s in shards})
+                       if shards else None)
+        msg = (f'KV page corruption at {site}: page(s) {self.pages} '
+               f'fail checksum verification')
+        if self.shards:
+            msg += f' (kv shard(s) {self.shards})'
+        super().__init__(msg)
 
 
 def _resolve_decode_impl(decode_impl):
@@ -155,6 +163,20 @@ class KernelEngine:
     prefix sharing, :meth:`fork_slot` copy-on-write forks. Token
     streams are bit-identical to the slab engine per impl.
 
+    ``kv_shards=N`` (paged engines only) shards every stream's page
+    table across an N-wide ``seq`` mesh — cluster-scale long context:
+    each mesh member owns a CONTIGUOUS run of the logical page
+    ordinals (:class:`~distributed_dot_product_tpu.models.decode
+    .ShardedPageTable`), runs the decode step over only its own pages,
+    and the per-shard flash partials pmax/psum-merge into the exact
+    full-attention result. ``pages`` then sizes each PER-SHARD pool,
+    so ``capacity_tokens`` scales linearly with N. The host surface
+    speaks GLOBAL page ids (= stacked pool rows); checksums are kept
+    per owning shard; prefixes arrive via the shard-local
+    :meth:`adopt_prefix` handoff (``register_prefix``, ``fork_slot``
+    and ``verify_step`` raise — run those on unsharded replicas).
+    Needs N devices (the 8-dev CPU mesh in tests/CI).
+
     ``weight_quant='int8'`` (or ``DDP_TPU_WEIGHT_QUANT=int8``) stores
     the four projection/head matrices int8 with per-output-channel
     scales (``models/dense.quantize_kernel``); every projection and
@@ -170,12 +192,20 @@ class KernelEngine:
     def __init__(self, slots, t_max, *, vocab=64, heads=2, head_dim=8,
                  prefill_chunk=8, seed=0, dtype=jnp.float32,
                  decode_impl=None, cache_mode=None, pages=None,
-                 page_size=None, weight_quant=None, kv_checksums=True):
+                 page_size=None, weight_quant=None, kv_checksums=True,
+                 kv_shards=1):
         if slots < 1 or t_max < 2:
             raise ValueError(f'need slots >= 1 and t_max >= 2, got '
                              f'{slots}/{t_max}')
         self.decode_impl = _resolve_decode_impl(decode_impl)
         self.cache_mode = _resolve_cache_mode(cache_mode)
+        self.kv_shards = int(kv_shards)
+        if self.kv_shards < 1:
+            raise ValueError(f'kv_shards must be >= 1, got {kv_shards}')
+        if self.kv_shards > 1 and self.cache_mode != 'paged':
+            raise ValueError("kv_shards > 1 needs cache_mode='paged' — "
+                             'the sequence-sharded KV is a sharded page '
+                             'table, there is no sharded slab')
         self.weight_quant = _resolve_weight_quant(weight_quant)
         self.slots = slots
         self.t_max = t_max
@@ -210,22 +240,74 @@ class KernelEngine:
                 raise ValueError(f'page_size {ps} must divide t_max '
                                  f'{t_max}')
             self.page_size = ps
-            # Default pool = the slab's bytes; the paged win comes from
-            # sizing `pages` to the MEMORY budget while raising `slots`
-            # past what a slab of the same bytes could hold.
-            n_pages = pages if pages is not None \
-                else slots * (t_max // ps)
-            self.pool = PagePool(n_pages, ps, slots, t_max // ps)
-            self.cache = init_paged_cache(slots, heads, t_max, head_dim,
-                                          pages=n_pages, page_size=ps,
-                                          dtype=dtype)
+            if self.kv_shards > 1:
+                # Cluster-scale long context: one ShardedPageTable over
+                # kv_shards PagePools (contiguous ordinal ownership),
+                # the STACKED device cache placed P(SEQ_AXIS) over a
+                # seq mesh. `pages` sizes each PER-SHARD pool, so
+                # capacity_tokens sums linearly across the mesh.
+                from distributed_dot_product_tpu.parallel.mesh import (
+                    seq_mesh,
+                )
+                from distributed_dot_product_tpu.utils.comm import (
+                    SEQ_AXIS,
+                )
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                pps = t_max // ps
+                if self.kv_shards > pps:
+                    raise ValueError(
+                        f'kv_shards {self.kv_shards} exceeds the '
+                        f'{pps} logical page ordinals of t_max='
+                        f'{t_max}/page_size={ps} — some shards would '
+                        f'own an empty range')
+                n_pages = pages if pages is not None \
+                    else -(-slots * pps // self.kv_shards)
+                self.pool = ShardedPageTable(self.kv_shards, n_pages,
+                                             ps, slots, pps)
+                self._mesh = seq_mesh(self.kv_shards)
+                self._seq_axis = SEQ_AXIS
+                self._pt_sharding = NamedSharding(self._mesh,
+                                                 P(SEQ_AXIS))
+                self._cache_sharding = PagedDecodeCache(
+                    k_pool=self._pt_sharding,
+                    v_pool=self._pt_sharding,
+                    page_table=self._pt_sharding,
+                    length=NamedSharding(self._mesh, P()),
+                    k_q_pool=None, k_scale_pool=None)
+                self.cache = jax.device_put(
+                    init_sharded_paged_cache(
+                        self.kv_shards, slots, heads, t_max, head_dim,
+                        pages_per_shard=n_pages, page_size=ps,
+                        dtype=dtype),
+                    self._cache_sharding)
+            else:
+                # Default pool = the slab's bytes; the paged win comes
+                # from sizing `pages` to the MEMORY budget while
+                # raising `slots` past what a slab of the same bytes
+                # could hold.
+                n_pages = pages if pages is not None \
+                    else slots * (t_max // ps)
+                self.pool = PagePool(n_pages, ps, slots, t_max // ps)
+                self.cache = init_paged_cache(slots, heads, t_max,
+                                              head_dim, pages=n_pages,
+                                              page_size=ps, dtype=dtype)
             self._prefix_registry = {}
             self._prefix_counter = itertools.count()
             # Per-page integrity table: registry/transfer pages only,
             # digested at transfer boundaries on the host — never
             # inside a compiled program ("verify at transfer, never
             # per step"). kv_checksums=False is the no-integrity twin.
-            self.checksums = PageChecksums() if kv_checksums else None
+            # kv_shards engines keep ONE table PER OWNING SHARD, keyed
+            # by shard-local page ids (satellite: checksums stay
+            # coherent under sharding).
+            if not kv_checksums:
+                self.checksums = None
+            elif self.kv_shards > 1:
+                self.checksums = [PageChecksums()
+                                  for _ in range(self.kv_shards)]
+            else:
+                self.checksums = PageChecksums()
         else:
             self.page_size = None
             self.pool = None
@@ -243,32 +325,78 @@ class KernelEngine:
         from distributed_dot_product_tpu.analysis.retrace import (
             watch_traces,
         )
-        self._decode = jax.jit(
-            watch_traces(self._decode_impl, 'engine.decode', budget=2),
-            donate_argnums=(0,))
-        self._prefill = jax.jit(
-            watch_traces(self._prefill_impl, 'engine.prefill', budget=2),
-            donate_argnums=(0,))
-        if self.cache_mode == 'paged':
-            self._reset = jax.jit(
-                watch_traces(paged_reset_slot, 'engine.reset', budget=2),
+        if self.cache_mode == 'paged' and self.kv_shards > 1:
+            # Every kv_shards program is the SAME paged body the
+            # unsharded engine runs, wrapped in ONE shard_map: each
+            # mesh member squeezes its (1, slots, pps) page-table
+            # block to the ordinary local view, runs the paged body
+            # over its own pool block (non-owned ordinals are −1, so
+            # their appends/copies drop on device), and re-expands.
+            # The decode body additionally passes the mesh axis so
+            # decode_step pmax/psum-merges the per-shard flash
+            # partials into the exact full-attention result — the
+            # paged ring/context-parallel decode step.
+            from jax.sharding import PartitionSpec as P
+            cspec = self._cache_pspec()
+            rep, shv = P(), P(self._seq_axis)
+            self._decode = jax.jit(
+                watch_traces(self._sharded_program(
+                    self._decode_body_sharded,
+                    (cspec, rep, rep, rep), (cspec, rep, rep)),
+                    'engine.decode', budget=2),
                 donate_argnums=(0,))
-            # The sharing primitives: CoW/fork/attach page copy (+
-            # length set) and registry prefix prefill — each one fixed
-            # compiled program, dispatched only on page crossings and
-            # prefix/fork events, never per token.
+            self._prefill = jax.jit(
+                watch_traces(self._sharded_program(
+                    self._prefill_body_sharded,
+                    (cspec, rep, rep, rep), cspec),
+                    'engine.prefill', budget=2),
+                donate_argnums=(0,))
+            self._reset = jax.jit(
+                watch_traces(self._sharded_program(
+                    self._reset_body_sharded,
+                    (cspec, rep, shv), cspec),
+                    'engine.reset', budget=2),
+                donate_argnums=(0,))
             self._copy_attach = jax.jit(
-                watch_traces(paged_copy_attach, 'engine.copy_attach',
+                watch_traces(self._sharded_program(
+                    self._copy_attach_body_sharded,
+                    (cspec, shv, shv, rep, rep), cspec),
+                    'engine.copy_attach', budget=2),
+                donate_argnums=(0,))
+            # register_prefix is rejected under kv_shards (shared
+            # prefixes arrive via the shard-local handoff), so no
+            # local prefix-fill program exists to mis-call.
+            self._prefix_fill = None
+        else:
+            self._decode = jax.jit(
+                watch_traces(self._decode_impl, 'engine.decode',
                              budget=2),
                 donate_argnums=(0,))
-            self._prefix_fill = jax.jit(
-                watch_traces(self._prefix_fill_impl,
-                             'engine.prefix_fill', budget=2),
+            self._prefill = jax.jit(
+                watch_traces(self._prefill_impl, 'engine.prefill',
+                             budget=2),
                 donate_argnums=(0,))
-        else:
-            self._reset = jax.jit(
-                watch_traces(reset_slot, 'engine.reset', budget=2),
-                donate_argnums=(0,))
+            if self.cache_mode == 'paged':
+                self._reset = jax.jit(
+                    watch_traces(paged_reset_slot, 'engine.reset',
+                                 budget=2),
+                    donate_argnums=(0,))
+                # The sharing primitives: CoW/fork/attach page copy (+
+                # length set) and registry prefix prefill — each one
+                # fixed compiled program, dispatched only on page
+                # crossings and prefix/fork events, never per token.
+                self._copy_attach = jax.jit(
+                    watch_traces(paged_copy_attach,
+                                 'engine.copy_attach', budget=2),
+                    donate_argnums=(0,))
+                self._prefix_fill = jax.jit(
+                    watch_traces(self._prefix_fill_impl,
+                                 'engine.prefix_fill', budget=2),
+                    donate_argnums=(0,))
+            else:
+                self._reset = jax.jit(
+                    watch_traces(reset_slot, 'engine.reset', budget=2),
+                    donate_argnums=(0,))
         # Speculative decoding programs, built LAZILY (a non-spec
         # engine never pays their traces): one verify program per
         # width W = k+1 and one rollback program per span, each a
@@ -307,13 +435,17 @@ class KernelEngine:
                 self._dot(x, self._wk).reshape(shape),
                 self._dot(x, self._wv).reshape(shape))
 
-    def _decode_impl(self, cache, tokens, active, poison):
+    def _decode_impl(self, cache, tokens, active, poison,
+                     axis_name=None):
         q, k, v = self._project(tokens)
         # Fused append+attend (one Pallas program on the kernel path —
         # the cache buffers are aliased in place and, with the jit
-        # donation above, never copied).
+        # donation above, never copied). With `axis_name` (a kv_shards
+        # engine's shard_map body) the step runs over this member's
+        # page range only and flash-merges partials across the mesh.
         cache, out = decode_step(q, cache, k, v, slot_mask=active,
-                                 impl=self.decode_impl)    # (S, H, 1, D)
+                                 impl=self.decode_impl,
+                                 axis_name=axis_name)      # (S, H, 1, D)
         logits = self._dot(out.reshape(self.slots, -1),
                            self._wo)                       # (S, vocab)
         logits = jnp.where(poison[:, None], jnp.nan, logits)
@@ -388,6 +520,74 @@ class KernelEngine:
         k, v = self._project_kv(tokens)
         return paged_append_rows(cache, k, v, page_row, start, count)
 
+    # -- kv_shards shard_map plumbing (cache_mode='paged', shards>1) ----
+    def _cache_pspec(self):
+        """PartitionSpec pytree of the stacked sharded cache: pools and
+        page-table blocks P(seq) on axis 0, the fill vector replicated
+        (it is a global property every member advances identically)."""
+        from jax.sharding import PartitionSpec as P
+        ax = self._seq_axis
+        return PagedDecodeCache(k_pool=P(ax), v_pool=P(ax),
+                                page_table=P(ax), length=P(),
+                                k_q_pool=None, k_scale_pool=None)
+
+    def _sharded_program(self, body, in_specs, out_specs):
+        return jax.shard_map(body, mesh=self._mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    def _decode_body_sharded(self, cache, tokens, active, poison):
+        local = cache._replace(page_table=cache.page_table[0])
+        local, tok, finite = self._decode_impl(
+            local, tokens, active, poison, axis_name=self._seq_axis)
+        return (local._replace(page_table=local.page_table[None]),
+                tok, finite)
+
+    def _prefill_body_sharded(self, cache, slot, tokens, count):
+        # The unsharded prefill body verbatim on the local view:
+        # rows whose page ordinal another shard owns scatter through a
+        # −1 table entry and drop — each member keeps exactly its own
+        # slice of the prompt, no cross-member traffic at all.
+        local = cache._replace(page_table=cache.page_table[0])
+        out = self._prefill_impl(local, slot, tokens, count)
+        return out._replace(page_table=out.page_table[None])
+
+    def _reset_body_sharded(self, cache, slot, freed):
+        # `freed` is (kv_shards, pages_per_slot) stacked per-shard
+        # freed-page vectors (−1-padded); each member zeroes its own.
+        local = cache._replace(page_table=cache.page_table[0])
+        out = paged_reset_slot(local, slot, freed[0])
+        return out._replace(page_table=out.page_table[None])
+
+    def _copy_attach_body_sharded(self, cache, src, dst, slot, length):
+        # `src`/`dst` are (kv_shards,) stacked per-shard scalars (−1 =
+        # no copy on that member) — one program serves CoW copies and
+        # attach tail copies wherever the page lives.
+        local = cache._replace(page_table=cache.page_table[0])
+        out = paged_copy_attach(local, src[0], dst[0], slot, length)
+        return out._replace(page_table=out.page_table[None])
+
+    def _gpage(self, shard, page):
+        """Shard-local page id → GLOBAL page id (= the page's stacked
+        pool row, ``shard·(pages_per_shard+1)+page`` — each member's
+        block ends with its own sink row). Global ids are what the
+        kv_shards engine's host surface speaks (registry, checksums
+        verdicts, quarantine), so the router/scheduler page arithmetic
+        works unchanged."""
+        return shard * (self.pool.pages_per_shard + 1) + page
+
+    def _gsplit(self, gpage):
+        """GLOBAL page id → ``(shard, local page)``."""
+        stride = self.pool.pages_per_shard + 1
+        return int(gpage) // stride, int(gpage) % stride
+
+    def page_shard(self, page):
+        """Mesh member owning GLOBAL page id ``page`` on a kv_shards
+        engine; None on unsharded engines (the router's kv.corrupt
+        shard naming probes any engine through this)."""
+        if self.kv_shards <= 1:
+            return None
+        return int(page) // (self.pool.pages_per_shard + 1)
+
     # -- host surface (numpy in, numpy out) -----------------------------
     def step(self, tokens, active, poison=None, request_ids=None):
         """One decode step for all slots. ``tokens (S,) int`` — each
@@ -414,12 +614,18 @@ class KernelEngine:
                 ok = self.prepare_step(act)
                 if not ok.all():
                     bad = np.nonzero(~ok)[0]
+                    by_shard = (
+                        f', free by shard '
+                        f'{self.pool.free_pages_by_shard} — one '
+                        f"shard's contiguous range is out of pages "
+                        f'even though others have headroom'
+                        if self.kv_shards > 1 else '')
                     raise RuntimeError(
                         f'page pool exhausted for slot(s) '
                         f'{bad.tolist()} ({self.pool.free_pages} pages '
-                        f'free) — retire or evict sequences (the '
-                        f'Scheduler ladder does), or size the pool '
-                        f'larger')
+                        f'free{by_shard}) — retire or evict sequences '
+                        f'(the Scheduler ladder does), or size the '
+                        f'pool larger')
             self._sync_page_table()
         # Span attrs are built ONLY when spans are on: this is the
         # per-token hot path, and the disabled default must not pay a
@@ -466,6 +672,11 @@ class KernelEngine:
         The cache appends ``counts[i]`` rows per active slot (paged
         engines auto-reserve the pages, raising on exhaustion — the
         Scheduler reserves through its evict/preempt ladder instead)."""
+        if self.kv_shards > 1:
+            raise ValueError(
+                'verify_step (speculative decoding) is not supported '
+                'with kv_shards > 1 — the sharded ring-decode step is '
+                'single-token; run spec decode on unsharded replicas')
         tokens = np.asarray(tokens, np.int32)
         s, w = tokens.shape
         if s != self.slots:
@@ -502,7 +713,21 @@ class KernelEngine:
             from distributed_dot_product_tpu.analysis.retrace import (
                 watch_traces,
             )
-            if self.cache_mode == 'paged':
+            if self.cache_mode == 'paged' and self.kv_shards > 1:
+                from jax.sharding import PartitionSpec as P
+
+                def _body(cache, lengths):
+                    local = cache._replace(
+                        page_table=cache.page_table[0])
+                    out = paged_rollback_slots(local, lengths,
+                                               span_rows)
+                    return out._replace(
+                        page_table=out.page_table[None])
+
+                body = self._sharded_program(
+                    _body, (self._cache_pspec(), P()),
+                    self._cache_pspec())
+            elif self.cache_mode == 'paged':
                 def body(cache, lengths):
                     return paged_rollback_slots(cache, lengths,
                                                 span_rows)
@@ -540,11 +765,20 @@ class KernelEngine:
             self.cache = self._rollback_program(bucket)(
                 self.cache, jnp.asarray(new, jnp.int32))
         if self.cache_mode == 'paged':
-            freed = []
-            for i in np.nonzero(cur > new)[0]:
-                freed += self.pool.truncate(int(i), int(new[i]))
-            if freed:
-                self._zero_freed(freed)
+            if self.kv_shards > 1:
+                freed = {}
+                for i in np.nonzero(cur > new)[0]:
+                    for s, pgs in self.pool.truncate(
+                            int(i), int(new[i])).items():
+                        freed.setdefault(s, []).extend(pgs)
+                if freed:
+                    self._zero_freed_sharded(freed)
+            else:
+                freed = []
+                for i in np.nonzero(cur > new)[0]:
+                    freed += self.pool.truncate(int(i), int(new[i]))
+                if freed:
+                    self._zero_freed(freed)
             self._sync_page_table()
 
     def prefill(self, slot, tokens, request_id=None):
@@ -562,8 +796,10 @@ class KernelEngine:
             # Auto-reserve the chunk's pages (no-op when the scheduler
             # already reserved the whole prompt at admission).
             pos = int(self.pool.lengths[slot])
-            if (pos + n) > int(self.pool.counts[slot]) * self.page_size \
-                    and not self.reserve_rows(slot, n):
+            covered = (self.pool.covered_rows(slot)
+                       if self.kv_shards > 1
+                       else int(self.pool.counts[slot]) * self.page_size)
+            if (pos + n) > covered and not self.reserve_rows(slot, n):
                 raise RuntimeError(
                     f'page pool exhausted prefilling rows '
                     f'[{pos}, {pos + n}) of slot {slot} '
@@ -587,13 +823,31 @@ class KernelEngine:
         if self.checksums is not None:
             self.checksums.drop(freed)
 
+    def _zero_freed_sharded(self, freed, slot=-1):
+        """kv_shards twin of :meth:`_zero_freed`: ``freed`` is
+        ``{shard: [local pages]}``; the stacked per-shard vectors go
+        through the ONE sharded reset program (each member zeroes its
+        own list), and each shard's checksum table forgets its own."""
+        vec = np.full((self.kv_shards, self.pool.pages_per_slot), -1,
+                      np.int32)
+        for s, pages in freed.items():
+            vec[s, :len(pages)] = pages
+        self.cache = self._reset(self.cache, jnp.int32(slot),
+                                 jnp.asarray(vec))
+        if self.checksums is not None:
+            for s, pages in freed.items():
+                self.checksums[s].drop(pages)
+
     def reset(self, slot):
         """Evict ``slot`` (zero rows + length); other slots untouched.
         Paged: drops the slot's page references and zeroes exactly the
         pages that reached refcount 0 (still-shared prefix/fork pages
         keep their bits — they are someone else's context)."""
         if self.cache_mode == 'paged':
-            self._zero_freed(self.pool.release(slot), slot)
+            if self.kv_shards > 1:
+                self._zero_freed_sharded(self.pool.release(slot), slot)
+            else:
+                self._zero_freed(self.pool.release(slot), slot)
             self._sync_page_table()
         else:
             self.cache = self._reset(self.cache, jnp.int32(slot))
@@ -611,11 +865,30 @@ class KernelEngine:
     # -- paged-pool surface (cache_mode='paged') ------------------------
     def _sync_page_table(self):
         if self.pool.dirty:
-            self.cache = self.cache._replace(
-                page_table=jnp.asarray(self.pool.table))
+            if self.kv_shards > 1:
+                # Stacked local views, explicitly re-placed P(seq) so
+                # the donated device mirror never bounces through a
+                # single-device layout on its way into the programs.
+                pt = jax.device_put(
+                    jnp.asarray(self.pool.local_tables()),
+                    self._pt_sharding)
+            else:
+                pt = jnp.asarray(self.pool.table)
+            self.cache = self.cache._replace(page_table=pt)
             self.pool.dirty = False
 
     def _apply_copies(self, copies):
+        if self.kv_shards > 1:
+            # (shard, src, dst) triples → stacked per-shard scalar
+            # vectors (−1 = no copy on that member).
+            for s, src, dst in copies:
+                vs = np.full(self.kv_shards, -1, np.int32)
+                vd = np.full(self.kv_shards, -1, np.int32)
+                vs[s], vd[s] = src, dst
+                self.cache = self._copy_attach(
+                    self.cache, jnp.asarray(vs), jnp.asarray(vd),
+                    jnp.int32(-1), jnp.int32(0))
+            return
         for src, dst in copies:
             self.cache = self._copy_attach(
                 self.cache, jnp.int32(src), jnp.int32(dst),
@@ -635,6 +908,18 @@ class KernelEngine:
         if not idx.size:
             return ok
         pool = self.pool
+        if self.kv_shards > 1:
+            # Route each slot's append ordinal to its OWNING shard's
+            # table/refcount (slots are few; the owner lookup is the
+            # cost of the sharded layout's locality).
+            for i in idx:
+                pi = int(pool.lengths[i]) // self.page_size
+                if pi >= pool.pages_per_slot:
+                    continue                    # full: writable no-op
+                sp = pool.shards[pool.owner(pi)]
+                pg = int(sp.table[i, pi])
+                ok[i] = pg >= 0 and int(sp.refcount[pg]) == 1
+            return ok
         pi = pool.lengths[idx] // self.page_size
         full = pi >= pool.pages_per_slot
         pg = pool.table[idx, np.minimum(pi, pool.pages_per_slot - 1)]
@@ -660,6 +945,17 @@ class KernelEngine:
         # append page) — the same contract step()'s auto-prepare uses.
         todo = active & ~self._writable_mask(active)
         for i in np.nonzero(todo)[0]:
+            if self.kv_shards > 1:
+                # The sharded pool names WHICH shard's contiguous
+                # range answered (exhaustion there is typed back
+                # through the scheduler's evict/preempt ladder even
+                # while other shards have headroom — never a stall).
+                st, sh, src, dst = self.pool.prepare_append(int(i))
+                if st == 'exhausted':
+                    ok[i] = False
+                elif st == 'cow':
+                    self._apply_copies([(sh, src, dst)])
+                continue
             st, src, dst = self.pool.prepare_append(int(i))
             if st == 'exhausted':
                 ok[i] = False
@@ -686,6 +982,12 @@ class KernelEngine:
         cost its pages once plus one partial tail page each."""
         if self.cache_mode != 'paged':
             raise ValueError("prefix sharing needs cache_mode='paged'")
+        if self.kv_shards > 1:
+            raise ValueError(
+                'register_prefix (local prefix prefill) is not '
+                'supported with kv_shards > 1 — shared prefixes arrive '
+                'through the shard-local prefill→decode handoff '
+                '(adopt_prefix)')
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = len(tokens)
         if n < 1:
@@ -723,21 +1025,58 @@ class KernelEngine:
         return pid
 
     # -- page integrity (host-side, transfer boundaries only) -----------
+    def _by_shard(self, pages):
+        """Group GLOBAL page ids → ``{shard: [local pages]}`` (kv_shards
+        surface; the order within a shard follows the input)."""
+        per = {}
+        for g in pages:
+            s, p = self._gsplit(g)
+            per.setdefault(s, []).append(p)
+        return per
+
     def _checksum_record(self, pages):
-        if self.checksums is not None:
-            t0 = time.perf_counter()
+        if self.checksums is None:
+            return
+        t0 = time.perf_counter()
+        if self.kv_shards > 1:
+            # Per-owning-shard tables, keyed by LOCAL page ids; the
+            # digest reads the page's stacked pool row. A shard's
+            # table never holds another shard's pages — the coherence
+            # contract the sharded transfer boundaries maintain.
+            for s, locs in self._by_shard(pages).items():
+                tab = self.checksums[s]
+                for p in locs:
+                    tab.record_at(self.cache, p,
+                                  row=self._gpage(s, p))
+        else:
             self.checksums.record(self.cache, pages)
-            self.verify_seconds += time.perf_counter() - t0
+        self.verify_seconds += time.perf_counter() - t0
 
     def verify_pages(self, pages=None):
         """Re-digest ``pages`` (default: every tracked page — the
         scrub) against the recorded checksums. Returns the sorted
         dirty-page list without raising; [] when clean or when
-        checksums are disabled. Host work only."""
+        checksums are disabled. Host work only. kv_shards engines
+        speak GLOBAL page ids here (in and out)."""
         if self.checksums is None:
             return []
         t0 = time.perf_counter()
-        bad = self.checksums.verify(self.cache, pages)
+        if self.kv_shards > 1:
+            bad = []
+            per = (self._by_shard(pages) if pages is not None
+                   else {s: tab.pages()
+                         for s, tab in enumerate(self.checksums)})
+            for s, locs in per.items():
+                tab = self.checksums[s]
+                for p in locs:
+                    want = tab.get(p)
+                    if want is not None and PageChecksums.digest(
+                            self.cache,
+                            self._gpage(s, p)) != want:
+                        bad.append(self._gpage(s, p))
+            bad = sorted(bad)
+        else:
+            bad = self.checksums.verify(self.cache, pages)
         self.verify_seconds += time.perf_counter() - t0
         return bad
 
@@ -748,15 +1087,28 @@ class KernelEngine:
 
     def check_pages(self, pages, site):
         """Raise :class:`PageCorruptionError` naming ``site`` if any of
-        ``pages`` fails verification (untracked pages are skipped)."""
+        ``pages`` fails verification (untracked pages are skipped). On
+        kv_shards engines the error also names the dirty shard(s)."""
         bad = self.verify_pages(pages)
         if bad:
-            raise PageCorruptionError(bad, site)
+            shards = ([self.page_shard(p) for p in bad]
+                      if self.kv_shards > 1 else None)
+            raise PageCorruptionError(bad, site, shards=shards)
 
     def quarantine_pages(self, pages):
         """Withdraw dirty pages from circulation (they never return to
         the free list) and forget their digests so scrubs stop
-        re-flagging them. Returns the pages newly quarantined."""
+        re-flagging them. Returns the pages newly quarantined —
+        GLOBAL ids in and out on kv_shards engines, routed to each
+        page's owning shard."""
+        if self.kv_shards > 1:
+            newly = []
+            for s, locs in self._by_shard(pages).items():
+                if self.checksums is not None:
+                    self.checksums[s].drop(locs)
+                newly += [self._gpage(s, p)
+                          for p in self.pool.quarantine(s, locs)]
+            return sorted(newly)
         if self.checksums is not None:
             self.checksums.drop(pages)
         return self.pool.quarantine(pages)
@@ -766,6 +1118,18 @@ class KernelEngine:
         victims of a corruption verdict."""
         if self.pool is None:
             return []
+        if self.kv_shards > 1:
+            per = {s: set(locs)
+                   for s, locs in self._by_shard(pages).items()}
+            hit = []
+            for slot in range(self.slots):
+                for s, locs in per.items():
+                    sp = self.pool.shards[s]
+                    if any(int(sp.table[slot, i]) in locs
+                           for i in range(int(sp.counts[slot]))):
+                        hit.append(slot)
+                        break
+            return hit
         bad = {int(p) for p in pages}
         hit = []
         for slot in range(self.slots):
@@ -788,9 +1152,34 @@ class KernelEngine:
             from distributed_dot_product_tpu.analysis.retrace import (
                 watch_traces,
             )
+            if self.kv_shards > 1:
+                # Shard-local handoff: source pages arrive as a
+                # shard-STACKED slab (kv_shards, width, ...) laid out
+                # P(seq) — each mesh member holds, and copies from,
+                # ONLY the pages whose ordinals it owns. No member
+                # ever materializes the full sequence; the transfer
+                # unit stays the page.
+                from jax.sharding import PartitionSpec as P
+
+                def _body(cache, src_k, src_v, vsrc, vdst):
+                    local = cache._replace(
+                        page_table=cache.page_table[0])
+                    out = paged_transfer_pages(local, src_k[0],
+                                               src_v[0],
+                                               vsrc[0], vdst[0])
+                    return out._replace(
+                        page_table=out.page_table[None])
+
+                fn = self._sharded_program(
+                    _body,
+                    (self._cache_pspec(), P(self._seq_axis),
+                     P(self._seq_axis),
+                     P(self._seq_axis), P(self._seq_axis)),
+                    self._cache_pspec())
+            else:
+                fn = paged_transfer_pages
             prog = self._transfers[src_shape] = jax.jit(
-                watch_traces(paged_transfer_pages, 'engine.adopt',
-                             budget=2),
+                watch_traces(fn, 'engine.adopt', budget=2),
                 donate_argnums=(0,))
         return prog
 
@@ -845,6 +1234,9 @@ class KernelEngine:
             self.verify_seconds += time.perf_counter() - t0
             if bad:
                 raise PageCorruptionError(bad, 'handoff_src')
+        if self.kv_shards > 1:
+            return self._adopt_prefix_sharded(
+                src_cache, src_pages, length, src_checksums, needed)
         pages = self.pool.alloc_block(needed)
         if pages is None:
             raise RuntimeError(
@@ -878,6 +1270,87 @@ class KernelEngine:
                 raise PageCorruptionError(bad, 'handoff_copy')
         return pid
 
+    def _adopt_prefix_sharded(self, src_cache, src_pages, length,
+                              src_checksums, needed):
+        """kv_shards tail of :meth:`adopt_prefix` (validation and the
+        source verify already ran): allocate, per shard, exactly the
+        pages covering the ordinals that shard OWNS, then run ONE
+        stacked transfer program in which each mesh member copies only
+        its own ordinals' source pages into its own pool block — the
+        shard-local handoff, page-granular, with no full-sequence
+        gather anywhere. All-or-nothing allocation: any shard's
+        exhaustion rolls the other shards' fresh blocks back."""
+        alloc = {}                       # shard -> local pages, by ordinal
+        for s in range(self.kv_shards):
+            lo, hi = self.pool.owned_range(s)
+            k = max(0, min(hi, needed) - lo)
+            if k == 0:
+                continue
+            pgs = self.pool.shards[s].alloc_block(k)
+            if pgs is None:
+                for s2, got in alloc.items():
+                    self.pool.shards[s2].release_pages(got)
+                raise RuntimeError(
+                    f'page pool exhausted adopting a {length}-row '
+                    f'prefix: shard {s} has '
+                    f'{self.pool.shards[s].free_pages} of the {k} '
+                    f'pages its ordinal range [{lo}, {min(hi, needed)})'
+                    f' needs (free by shard '
+                    f'{self.pool.free_pages_by_shard})')
+            alloc[s] = pgs
+        width = self.pool.pages_per_slot
+        vec_src = np.full((self.kv_shards, width), -1, np.int32)
+        vec_dst = np.full((self.kv_shards, width), -1, np.int32)
+        sel = np.zeros((self.kv_shards, width), np.int64)
+        gpages = [0] * needed
+        for s, pgs in alloc.items():
+            lo, _ = self.pool.owned_range(s)
+            for j, p in enumerate(pgs):
+                sel[s, j] = src_pages[lo + j]
+                vec_src[s, j] = j          # row WITHIN the staged slab
+                vec_dst[s, j] = p
+                gpages[lo + j] = self._gpage(s, p)
+        # Stage only the referenced source pages, shard-stacked and
+        # laid out P(seq) on THIS engine's mesh: each member receives
+        # exactly the pages covering its own ordinal range (the
+        # single-controller analog of a per-shard point-to-point send
+        # — the source pool may live on a different mesh entirely).
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        slab_sh = NamedSharding(self._mesh, P(self._seq_axis))
+        flat = sel.reshape(-1)
+        src_k = jax.device_put(
+            jnp.asarray(np.asarray(src_cache.k_pool)[flat]).reshape(
+                self.kv_shards, width, *src_cache.k_pool.shape[1:]),
+            slab_sh)
+        src_v = jax.device_put(
+            jnp.asarray(np.asarray(src_cache.v_pool)[flat]).reshape(
+                self.kv_shards, width, *src_cache.v_pool.shape[1:]),
+            slab_sh)
+        key = (src_k.shape, src_v.shape, width)
+        self.cache = self._transfer_program(key)(
+            self.cache, src_k, src_v,
+            jnp.asarray(vec_src), jnp.asarray(vec_dst))
+        pid = self._register_pages(gpages, length)
+        if self.checksums is not None and src_checksums is not None:
+            # Landed-copy verification, per owning shard: each landed
+            # page's KV digest (recorded against its stacked row just
+            # above) must equal the source page's.
+            bad = []
+            for o, g in enumerate(gpages):
+                want = src_checksums.get(src_pages[o])
+                s, p = self._gsplit(g)
+                have = self.checksums[s].get(p)
+                if want is not None and have is not None \
+                        and have[0] != want[0]:
+                    bad.append(g)
+            if bad:
+                self.unregister_prefix(pid)
+                raise PageCorruptionError(
+                    bad, 'handoff_copy',
+                    shards=[self.page_shard(g) for g in bad])
+        return pid
+
     def prefix_length(self, prefix_id):
         return self._prefix_registry[prefix_id][1]
 
@@ -885,6 +1358,15 @@ class KernelEngine:
         """Release the registry's page references; pages still shared
         by live sequences survive until those retire."""
         pages, _ = self._prefix_registry.pop(prefix_id)
+        if self.kv_shards > 1:
+            freed = {}
+            for s, locs in self._by_shard(pages).items():
+                got = self.pool.release_pages_on(s, locs)
+                if got:
+                    freed[s] = got
+            if freed:
+                self._zero_freed_sharded(freed)
+            return
         freed = self.pool.release_pages(pages)
         if freed:
             self._zero_freed(freed)
@@ -895,9 +1377,28 @@ class KernelEngine:
         set — the slot then prefills/decodes its own continuation.
         False = pool exhausted (no tail page available). The prefix's
         pages are verified first — attaching a sequence to a corrupted
-        prefix raises before any token can read it."""
+        prefix raises before any token can read it. kv_shards engines
+        attach per owning shard (the tail copy lands on the tail
+        ordinal's owner)."""
         pages, plen = self._prefix_registry[prefix_id]
         self.check_pages(pages, 'attach')
+        if self.kv_shards > 1:
+            ord_pages = np.full(self.pool.pages_per_slot, -1, np.int32)
+            for o, g in enumerate(pages):
+                ord_pages[o] = self._gsplit(g)[1]
+            ok, tsh, tsrc, tdst = self.pool.attach(slot, ord_pages,
+                                                   plen)
+            if not ok:
+                return False
+            vs = np.full(self.kv_shards, -1, np.int32)
+            vd = np.full(self.kv_shards, -1, np.int32)
+            if tsh >= 0:
+                vs[tsh], vd[tsh] = tsrc, tdst
+            self.cache = self._copy_attach(
+                self.cache, jnp.asarray(vs), jnp.asarray(vd),
+                jnp.int32(slot), jnp.int32(plen))
+            self._sync_page_table()
+            return True
         ok, src, dst = self.pool.attach(slot, pages, plen)
         if not ok:
             return False
@@ -914,6 +1415,11 @@ class KernelEngine:
         context. False = pool exhausted. The source's TRACKED pages
         (shared prefix pages — private append pages are out of
         coverage) are verified before the branch shares them."""
+        if self.kv_shards > 1:
+            raise ValueError(
+                'fork_slot (copy-on-write forks) is not supported with '
+                'kv_shards > 1 — run parallel sampling on unsharded '
+                'replicas')
         if self.checksums is not None:
             shared = [int(self.pool.table[src, i])
                       for i in range(int(self.pool.counts[src]))]
@@ -977,11 +1483,50 @@ class KernelEngine:
             return {'pages': 0, 'pages_used': 0, 'pages_free': 0,
                     'shared_pages': 0, 'page_size': 0,
                     'pages_quarantined': 0}
-        return {'pages': pool.pages, 'pages_used': pool.used_pages,
-                'pages_free': pool.free_pages,
-                'shared_pages': pool.shared_pages,
-                'page_size': pool.page_size,
-                'pages_quarantined': len(pool.quarantined)}
+        out = {'pages': pool.pages, 'pages_used': pool.used_pages,
+               'pages_free': pool.free_pages,
+               'shared_pages': pool.shared_pages,
+               'page_size': pool.page_size,
+               'pages_quarantined': len(pool.quarantined)}
+        if self.kv_shards > 1:
+            # Shard-aware occupancy: the aggregate rows above already
+            # sum across shards; the per-shard free vector is what an
+            # operator needs to see a single shard's range running dry
+            # while the aggregate still looks healthy.
+            out['kv_shards'] = self.kv_shards
+            out['pages_free_by_shard'] = pool.free_pages_by_shard
+        return out
+
+    # -- chaos seam (utils/faults.py page_corrupt knob) -----------------
+    def tracked_pages(self):
+        """Registry-tracked pages, sorted (GLOBAL ids on kv_shards
+        engines) — the population the page_corrupt chaos knob indexes
+        so a seeded trace corrupts the same prefix page whatever the
+        pool's allocation history."""
+        return sorted({int(p)
+                       for pages, _ in self._prefix_registry.values()
+                       for p in pages})
+
+    def flip_page_bit(self, page):
+        """Flip an EXPONENT bit of ``page``'s first K value (byte 3 of
+        a little-endian float32) host-side — the chaos injector's
+        corruption primitive. The corruption is semantically loud: an
+        undetected flip changes delivered tokens, which is exactly
+        what the no-integrity twin must demonstrate; the checksum does
+        not care which bit flipped. On kv_shards engines ``page`` is
+        the GLOBAL id, which IS the stacked pool row, and the rebuilt
+        buffer is re-placed on the mesh so the donated decode step
+        keeps its layout."""
+        k_pool = np.array(self.cache.k_pool)
+        k_pool[int(page)].reshape(-1).view(np.uint8)[3] ^= 0x40
+        # jnp.array (NOT asarray): the device buffer must OWN its
+        # bytes. On CPU asarray can alias the numpy host copy, and the
+        # next decode step donates the cache buffer — XLA would free
+        # memory Python owns.
+        buf = jnp.array(k_pool)
+        if self.kv_shards > 1:
+            buf = jax.device_put(buf, self._pt_sharding)
+        self.cache = self.cache._replace(k_pool=buf)
 
 
 def graphlint_entrypoints():
@@ -1046,6 +1591,34 @@ def graphlint_entrypoints():
             cache_out=lambda o: [o[0].k, o[0].v],
             expect_donation=True, min_donated=2)
 
+    def engine_decode_kv_sharded():
+        # The cluster-scale long-context serving program: the SAME
+        # engine decode body shard_mapped over the seq mesh with the
+        # page table split 2 ways — cache aliasing must survive the
+        # shard_map boundary (donation of the stacked sharded pools)
+        # and the flash-partials merge must keep its collectives on
+        # the declared mesh axis.
+        from distributed_dot_product_tpu.analysis.registry import (
+            TraceSpec,
+        )
+        from distributed_dot_product_tpu.utils.comm import SEQ_AXIS
+        eng = KernelEngine(slots=2, t_max=32, decode_impl='xla',
+                           cache_mode='paged', page_size=8, pages=3,
+                           kv_shards=2)
+        assert eng.prepare_step(np.ones(2, bool)).all()
+        eng._sync_page_table()
+        tokens = jnp.zeros((2,), jnp.int32)
+        active = jnp.ones((2,), bool)
+        poison = jnp.zeros((2,), bool)
+        return TraceSpec(
+            name='serve.engine_decode_kv_sharded', fn=eng._decode,
+            args=(eng.cache, tokens, active, poison),
+            prejitted=True, mesh_axes=(SEQ_AXIS,),
+            cache_in=lambda a: [a[0].k_pool, a[0].v_pool],
+            cache_out=lambda o: [o[0].k_pool, o[0].v_pool],
+            expect_donation=True, min_donated=2)
+
     return {'serve.engine_decode': engine_decode,
             'serve.engine_decode_paged': engine_decode_paged,
-            'serve.engine_decode_wq8': engine_decode_wq8}
+            'serve.engine_decode_wq8': engine_decode_wq8,
+            'serve.engine_decode_kv_sharded': engine_decode_kv_sharded}

@@ -445,34 +445,28 @@ class ChaosSchedule:
     def _flip(self, pending):
         """Land (or defer) a planned bit flip. Returns the pending spec
         when the victim has no tracked page yet, None once landed (or
-        when the victim left the pool)."""
-        import jax.numpy as jnp
-
+        when the victim left the pool). Page ids come from the
+        engine's own tracked-page enumeration, so under ``kv_shards``
+        the index resolves over GLOBAL (stacked-row) ids and the flip
+        lands inside whichever shard owns that page — the detection
+        path then names that shard in ``kv.corrupt``."""
         name, index = pending
         replica = next((r for r in self.router.pool.replicas
                         if r.name == name), None)
         if replica is None:
             return None
         eng = replica.engine
-        tracked = sorted({int(p)
-                          for pages, _ in eng._prefix_registry.values()
-                          for p in pages})
+        tracked = eng.tracked_pages()
         if not tracked:
             return pending
         page = tracked[index % len(tracked)]
-        k_pool = np.array(eng.cache.k_pool)
-        # Flip an EXPONENT bit of the page's first K value (byte 3 of
+        # Flips an EXPONENT bit of the page's first K value (byte 3 of
         # a little-endian float32): the corruption is semantically
         # loud — an undetected flip changes delivered tokens, which is
         # exactly what the no-integrity twin must demonstrate. The
         # checksum does not care which bit flipped; the comparison
         # row does.
-        k_pool[page].reshape(-1).view(np.uint8)[3] ^= 0x40
-        # jnp.array (NOT asarray): the device buffer must OWN its
-        # bytes. On CPU asarray can alias the numpy host copy, and the
-        # next decode step donates the cache buffer — XLA would free
-        # memory Python owns.
-        eng.cache = eng.cache._replace(k_pool=jnp.array(k_pool))
+        eng.flip_page_bit(page)
         self.corrupted.append((name, page, self.tick))
         return None
 

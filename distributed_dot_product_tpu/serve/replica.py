@@ -83,6 +83,11 @@ class TopologyConfig:
     seed: int = 0
     decode_impl: Optional[str] = 'xla'
     prefill_chunk: int = 8
+    # KV shards per decode replica: > 1 runs every engine program
+    # under shard_map over a ``seq`` mesh where each member owns a
+    # contiguous page range (``pages`` then counts PER SHARD, so
+    # replica capacity is ``kv_shards * pages * page_size`` tokens).
+    kv_shards: int = 1
     # Host-side per-page checksum tables on every member engine
     # (transfer-boundary integrity — serve/engine.py). False builds
     # the no-integrity twin the corruption benchmark rows compare
@@ -99,6 +104,9 @@ class TopologyConfig:
         if self.page_size < 1 or self.t_max % self.page_size:
             raise ValueError(f'page_size {self.page_size} must divide '
                              f't_max {self.t_max}')
+        if self.kv_shards < 1:
+            raise ValueError(f'kv_shards must be >= 1, got '
+                             f'{self.kv_shards}')
 
 
 def parse_topology(text):
@@ -444,7 +452,8 @@ class ReplicaPool:
             prefill_chunk=topo.prefill_chunk, seed=topo.seed,
             decode_impl=topo.decode_impl, cache_mode='paged',
             page_size=topo.page_size, pages=topo.pages,
-            kv_checksums=topo.kv_checksums)
+            kv_checksums=topo.kv_checksums,
+            kv_shards=topo.kv_shards)
         replica = DecodeReplica(
             name, engine, self.serve_config, clock=self.clock,
             event_log=self.open_log(name),

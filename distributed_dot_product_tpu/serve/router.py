@@ -329,9 +329,11 @@ class Router:
         self._cache_prefix(key, replica, pid, rows)
         self._c_handoffs.inc()
         self._c_handoff_pages.inc(needed)
+        shard_extra = ({'kv_shards': replica.engine.kv_shards}
+                       if replica.engine.kv_shards > 1 else {})
         self._emit('prefill.handoff', _log=prefill.event_log,
                    request_id=rid, target=replica.name, pages=needed,
-                   rows=rows, tenant=tenant)
+                   rows=rows, tenant=tenant, **shard_extra)
         return pid
 
     def _shed_no_replica(self, rid, tenant):
@@ -716,6 +718,11 @@ class Router:
         Returns the number of streams healed (requeued)."""
         eng = replica.engine
         pages = sorted(int(p) for p in pages)
+        # Under kv_shards, name the owning shard(s): page ids are
+        # global stacked rows, so ownership is a pure host-side lookup
+        # — the event narrates WHERE in the mesh the flip landed.
+        shards = sorted({s for s in (eng.page_shard(p) for p in pages)
+                         if s is not None}) or None
         dirty_pids = eng.prefixes_on(pages)
         victims = replica.scheduler.requests_on_slots(
             eng.slots_sharing(pages))
@@ -727,12 +734,15 @@ class Router:
         # re-enter the free list on the way down.
         eng.quarantine_pages(pages)
         self._c_corrupt.inc()
+        extra = {'shards': shards} if shards is not None else {}
         self._emit('kv.corrupt', target=replica.name, pages=pages,
-                   site=site)
+                   site=site, **extra)
+        where = (f' (kv shard(s) {shards})'
+                 if shards is not None else '')
         self._flight_dump(
             'kv_corrupt',
             f'replica {replica.name}: page(s) {pages} failed checksum '
-            f'at {site}, {len(victims)} victim stream(s)')
+            f'at {site}{where}, {len(victims)} victim stream(s)')
         expelled = []
         for rid in victims:
             if replica.scheduler.expel(rid) is not None:

@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo '=== [1/12] ruff (generic hygiene) ==='
+echo '=== [1/13] ruff (generic hygiene) ==='
 if command -v ruff >/dev/null 2>&1; then
     ruff check . || rc=1
 elif python -c 'import ruff' >/dev/null 2>&1; then
@@ -27,7 +27,7 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/12] graphlint + servelint (jaxpr/domain/serving contracts) ==='
+echo '=== [2/13] graphlint + servelint (jaxpr/domain/serving contracts) ==='
 # Full pass: jaxpr rules over every registered entrypoint (incl. the
 # bf16 serving-dtype and int8-weight twins — the owned dense retired
 # the flax-Dense f32-accum waivers, so zero allowed records remain)
@@ -38,7 +38,7 @@ echo '=== [2/12] graphlint + servelint (jaxpr/domain/serving contracts) ==='
 #   python -m distributed_dot_product_tpu.analysis --changed-only origin/main
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 
-echo '=== [3/12] tier-1 tests ==='
+echo '=== [3/13] tier-1 tests ==='
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping pytest stage'
 else
@@ -46,7 +46,7 @@ else
         --continue-on-collection-errors -p no:cacheprovider || rc=1
 fi
 
-echo '=== [4/12] smoke serve + event-log schema validation ==='
+echo '=== [4/13] smoke serve + event-log schema validation ==='
 # Drives the real serving process through the fault cocktail and then
 # schema-validates + timeline-reconstructs its JSONL event log (the
 # obs validate CLI runs inside smoke_serve.sh over the run's log).
@@ -56,7 +56,7 @@ else
     scripts/smoke_serve.sh 12 4 || rc=1
 fi
 
-echo '=== [5/12] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
+echo '=== [5/13] spec-decode bit-identity smoke (DDP_TPU_SPEC=ngram) ==='
 # Speculative decoding's exactness guarantee, proven on a real burst
 # through the ENV knob a deployment would flip: the same traffic served
 # with the n-gram proposer (verify-k steps) and without (plain n=1
@@ -114,7 +114,7 @@ print(f'spec smoke OK: {len(base)} streams bit-identical, '
 PY
 fi
 
-echo '=== [6/12] serve-load smoke + SLO goodput gate ==='
+echo '=== [6/13] serve-load smoke + SLO goodput gate ==='
 # A seeded open-loop trace (virtual clock — minutes of simulated
 # traffic in seconds of wall time, CPU-deterministic) drives the
 # scheduler, then the goodput report computed FROM THE EVENT LOG ALONE
@@ -139,7 +139,7 @@ else
     rm -f "$slo_log" "$slo_row"
 fi
 
-echo '=== [7/12] disaggregated-serving smoke (router + 2 decode pools) ==='
+echo '=== [7/13] disaggregated-serving smoke (router + 2 decode pools) ==='
 # The 1-router/2-pool cocktail on the CPU mesh: the seeded trace through
 # the disaggregated topology AND its single-process twin, member logs
 # schema-validated (--require router.route / prefill.handoff), goodput
@@ -151,7 +151,7 @@ else
     scripts/smoke_router.sh || rc=1
 fi
 
-echo '=== [8/12] perf gate (compiled-program cost vs committed baseline) ==='
+echo '=== [8/13] perf gate (compiled-program cost vs committed baseline) ==='
 # Compiles every registered entrypoint hermetically (8-dev CPU mesh),
 # snapshots XLA cost/memory/compile-time/retrace accounting, and gates
 # it against the committed PERF_BASELINE.json (tolerances sized for
@@ -169,7 +169,7 @@ else
     rm -f "$perf_now"
 fi
 
-echo '=== [9/12] weight-quant decode smoke (kv+weight bytes below the bf16 twin) ==='
+echo '=== [9/13] weight-quant decode smoke (kv+weight bytes below the bf16 twin) ==='
 # The low-precision acceptance row: the SAME decode shape at bf16 and
 # at int8 weights + int8 K mirror — the quantized row must move fewer
 # kv+weight bytes per step AND be kernel-eligible on the paged pool
@@ -206,7 +206,7 @@ PY
     rm -f "$wq_rows"
 fi
 
-echo '=== [10/12] closed-loop control smoke (static vs controlled under a ramp) ==='
+echo '=== [10/13] closed-loop control smoke (static vs controlled under a ramp) ==='
 # The control-plane acceptance row: the SAME seeded ramp trace (rate
 # climbing to 10x across the trace — deterministic overload) through a
 # 1-decode-replica topology twice. STATIC must breach the committed
@@ -267,7 +267,7 @@ PY
     rm -rf "$ctl_rows" "$ctl_static" "$ctl_logs"
 fi
 
-echo '=== [11/12] replica-failure-domain smoke (seeded crash + recovery) ==='
+echo '=== [11/13] replica-failure-domain smoke (seeded crash + recovery) ==='
 # The robustness acceptance row: the seeded CI trace with decode
 # replica r1 killed at a fixed virtual tick. Probes declare the loss,
 # every in-flight stream re-dispatches to the survivor bit-identical
@@ -281,7 +281,7 @@ else
     scripts/smoke_chaos.sh || rc=1
 fi
 
-echo '=== [12/12] data-integrity smoke (seeded bit flip + detect/heal) ==='
+echo '=== [12/13] data-integrity smoke (seeded bit flip + detect/heal) ==='
 # The KV-page-integrity acceptance row: the seeded CI trace with one
 # exponent bit flipped in a live KV page of r0 at a fixed virtual
 # tick. The scrub detects the flip before any poisoned token is
@@ -293,6 +293,20 @@ if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo 'SKIP_TESTS=1 — skipping corrupt-smoke stage'
 else
     scripts/smoke_corrupt.sh || rc=1
+fi
+
+echo '=== [13/13] long-context smoke (128k stream on the sharded KV mesh) ==='
+# The cluster-scale long-context acceptance row: a 128k-token stream
+# prefilled into a kv_shards=8 paged engine (each mesh member owns a
+# contiguous page range, per-shard flash partials psum/pmax-merged)
+# decodes token-for-token identical to the single-pool reference on
+# the 8-dev CPU mesh — XLA path at full length, fused kernel path on a
+# shorter sharded stream — and capacity_tokens scales linearly in
+# kv_shards on a fixed per-shard pool (≥3.5x line).
+if [ "${SKIP_TESTS:-0}" = "1" ]; then
+    echo 'SKIP_TESTS=1 — skipping longctx-smoke stage'
+else
+    scripts/smoke_longctx.sh || rc=1
 fi
 
 exit $rc

@@ -246,6 +246,24 @@ SWEEPS = [
           ('', 32, []),
           ('_paged', 64, ['--cache-mode', 'paged',
                           '--page-size', '256']))],
+    # --- ISSUE-18: cluster-scale long context — mesh-sharded paged KV.
+    # The capacity sweep: a FIXED per-shard pool (a quarter of t_max's
+    # pages) at 1/2/4 shards, so capacity_tokens reads ~N/4 × t_max
+    # straight off the rows (the ≥3.5×-at-4-shards acceptance line),
+    # plus the ms/token cost of the psum/pmax ring merge, both decode
+    # paths. And the decode-serve twin at 4 shards: the sharded pool
+    # behind the full scheduler. ---
+    *[(f'decode_kv_shards_{n}_{impl}',
+       ['--mode', 'decode', '--kv-shards', str(n), '--seq-len', '131072',
+        '--heads', '8', '--head-dim', '96', '--page-size', '256',
+        '--decode-impl', impl])
+      for n in (1, 2, 4)
+      for impl in ('xla', 'kernel')],
+    ('decode_serve_kv_shards_4',
+     ['--mode', 'decode-serve', '--seq-len', '4096', '--batch', '8',
+      '--serve-requests', '64', '--decode-impl', 'xla',
+      '--cache-mode', 'paged', '--page-size', '256',
+      '--kv-shards', '4']),
     # --- round-8: speculative decoding B=1 twins — each row times a
     # non-spec scheduler burst AND the proposer-driven verify-k burst
     # on the same engine/prompts (baseline_tokens_per_s rides the

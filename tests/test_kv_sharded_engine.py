@@ -1,0 +1,378 @@
+# -*- coding: utf-8 -*-
+"""
+Cluster-scale long context (ISSUE-18) — the ``kv_shards`` engine mode
+and its serving integration. One stream's page table shards across the
+mesh's ``seq`` axis: each member owns a CONTIGUOUS page range, decodes
+over only its own pages, and the per-shard flash partials psum/pmax-
+merge into the exact full-attention result. The tests pin the three
+acceptance properties on the CPU mesh:
+
+- **Bit identity**: sharded streams (XLA and kernel paths) equal the
+  single-pool reference token for token — prefill, decode, rollback
+  and the shard-local prefill→decode handoff included.
+- **Linear capacity**: with a FIXED per-shard pool, ``capacity_tokens``
+  scales ~linearly in ``kv_shards`` (≥3.5× at 4 shards).
+- **Typed shard-exhaustion**: one shard's contiguous range running out
+  while others have headroom surfaces the typed ``CACHE_EXHAUSTED``
+  ladder (scheduler) or a shard-naming RuntimeError (engine) — never a
+  silent stall — and corruption verdicts name the owning shard in
+  ``kv.corrupt`` + doctor output.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu import obs
+from distributed_dot_product_tpu.obs import doctor as obs_doctor
+from distributed_dot_product_tpu.obs import flight as obs_flight
+from distributed_dot_product_tpu.obs.events import EventLog
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, PrefillPool, RejectReason, RouterConfig, Scheduler,
+    ServeConfig, TopologyConfig, VirtualClock, build_serving,
+)
+from distributed_dot_product_tpu.serve.engine import PageCorruptionError
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+VOCAB = 32
+
+
+def _engine(*, kv_shards=1, pages=None, slots=2, t_max=64,
+            page_size=16, decode_impl='xla', **kw):
+    return KernelEngine(slots=slots, t_max=t_max, vocab=VOCAB, heads=2,
+                        head_dim=8, prefill_chunk=8, seed=3,
+                        decode_impl=decode_impl, cache_mode='paged',
+                        page_size=page_size, pages=pages,
+                        kv_shards=kv_shards, **kw)
+
+
+def _prompt(length, salt=0):
+    return (((np.arange(length) * 5 + salt) % (VOCAB - 1)) + 1) \
+        .astype(np.int32)
+
+
+def _stream(eng, prompt, steps, slot=0):
+    """Prefill ``prompt`` into ``slot`` and greedy-decode ``steps``
+    tokens; returns the token list."""
+    for st in range(0, len(prompt), 8):
+        eng.prefill(slot, prompt[st:st + 8])
+    active = np.zeros(eng.slots, bool)
+    active[slot] = True
+    tok = np.full(eng.slots, int(prompt[-1]), np.int32)
+    out = []
+    for _ in range(steps):
+        tok, _ = eng.step(tok, active)
+        out.append(int(tok[slot]))
+    return out
+
+
+# -- bit identity vs the single-pool reference --------------------------
+
+@pytest.mark.parametrize('impl', ['xla', 'kernel'])
+def test_sharded_stream_bit_identical(impl, devices):
+    """The tentpole identity: a 4-shard engine (per-shard pool, stacked
+    device layout, shard_map programs) decodes the same stream as the
+    unsharded reference, across page boundaries, on both decode
+    implementations."""
+    ref = _engine(decode_impl=impl, pages=8)
+    sh = _engine(decode_impl=impl, pages=2, kv_shards=4)
+    a = _stream(ref, _prompt(37), 24)
+    b = _stream(sh, _prompt(37), 24)
+    assert a == b
+    assert sh.cache_stats()['kv_shards'] == 4
+
+
+def test_sharded_rollback_and_reset_bit_identical(devices):
+    """Rollback (page-granular truncate across shard boundaries) and
+    slot reset keep the sharded stream pinned to the reference."""
+    ref = _engine(pages=8)
+    sh = _engine(pages=2, kv_shards=4)
+    a = _stream(ref, _prompt(21), 20)
+    b = _stream(sh, _prompt(21), 20)
+    assert a == b
+    keep = int(sh.pool.lengths[0]) - 7
+    big = np.iinfo(np.int32).max
+    ref.rollback(np.array([keep, big]))
+    sh.rollback(np.array([keep, big]))
+    assert int(ref.pool.lengths[0]) == int(sh.pool.lengths[0]) == keep
+    active = np.array([True, False])
+    tr = ts = np.array([a[-8]] * 2, np.int32)
+    for _ in range(6):
+        nr, _ = ref.step(tr, active)
+        ns, _ = sh.step(ts, active)
+        assert int(nr[0]) == int(ns[0])
+        tr, ts = nr, ns
+    ref.reset(0)
+    sh.reset(0)
+    assert ref.pool.used_pages == sh.pool.used_pages == 0
+    assert _stream(ref, _prompt(19, salt=2), 8) \
+        == _stream(sh, _prompt(19, salt=2), 8)
+
+
+# -- linear capacity scaling --------------------------------------------
+
+def test_capacity_tokens_scales_linearly(devices):
+    """The acceptance bar: a FIXED per-shard pool (4 pages × 16 rows)
+    yields ≥3.5× the single-shard ``capacity_tokens`` at 4 shards —
+    per-shard PagePool accounting sums across the mesh."""
+    caps = {}
+    for n in (1, 2, 4):
+        eng = _engine(t_max=1024, pages=4, kv_shards=n)
+        caps[n] = eng.capacity_tokens
+        assert eng.pool.pages == 4 * n
+        stats = eng.cache_stats()
+        assert stats['pages_free'] == 4 * n
+        if n > 1:
+            assert stats['pages_free_by_shard'] == [4] * n
+    assert caps[4] >= 3.5 * caps[1]
+    assert caps[2] >= 1.75 * caps[1]
+
+
+# -- shard-local prefill→decode handoff ---------------------------------
+
+def test_sharded_handoff_lands_shard_local_and_bit_identical(tmp_path,
+                                                             devices):
+    """``adopt_prefix`` into a sharded replica: every adopted page
+    lands inside the shard that OWNS its ordinal's contiguous range
+    (no gather-then-scatter), and the post-handoff stream equals the
+    self-prefilled sharded twin's."""
+    pool = PrefillPool(t_max=64, page_size=16, vocab=VOCAB, seed=3,
+                       event_log=EventLog(tmp_path / 'p.jsonl'))
+    prompt = _prompt(37)
+    handle = pool.build(prompt)
+    dst = _engine(pages=3, kv_shards=4)
+    pid = dst.adopt_prefix(pool.engine.cache, handle.pages,
+                           handle.length,
+                           src_checksums=pool.engine.checksums)
+    pool.release(handle)
+    gpages, length = dst._prefix_registry[pid]
+    assert length == len(prompt)
+    for ordinal, g in enumerate(gpages):
+        shard, local = dst._gsplit(int(g))
+        lo, hi = dst.pool.owned_range(shard)
+        assert lo <= ordinal < hi, (ordinal, shard)
+        assert 0 <= local < dst.pool.pages_per_shard
+    assert dst.start_with_prefix(0, pid)
+
+    twin = _engine(pages=3, kv_shards=4)
+    expect = _stream(twin, prompt, 16)
+    active = np.array([True, False])
+    tok = np.array([int(prompt[-1])] * 2, np.int32)
+    got = []
+    for _ in range(16):
+        tok, _ = dst.step(tok, active)
+        got.append(int(tok[0]))
+    assert got == expect
+
+
+# -- typed edges ---------------------------------------------------------
+
+def test_kv_shards_typed_rejections(devices):
+    """Config and API edges are typed: slab mode, oversharding, and
+    the three single-pool-only surfaces all raise ValueError naming
+    kv_shards — never a shape error from inside a compiled program."""
+    with pytest.raises(ValueError, match='kv_shards'):
+        KernelEngine(slots=2, t_max=32, vocab=VOCAB, kv_shards=2)
+    with pytest.raises(ValueError, match='kv_shards'):
+        _engine(kv_shards=8, t_max=64, page_size=16)   # pps=4 < 8
+    with pytest.raises(ValueError, match='kv_shards'):
+        _engine(kv_shards=0)
+    eng = _engine(kv_shards=2, pages=4)
+    with pytest.raises(ValueError, match='kv_shards'):
+        eng.register_prefix(_prompt(20))
+    with pytest.raises(ValueError, match='kv_shards'):
+        eng.fork_slot(0, 1)
+    with pytest.raises(ValueError, match='kv_shards'):
+        eng.verify_step(np.zeros((2, 2), np.int32), np.ones(2, int),
+                        np.ones(2, bool))
+    with pytest.raises(ValueError, match='kv_shards'):
+        TopologyConfig(kv_shards=0).validate()
+
+
+def test_shard_exhaustion_is_typed_at_the_engine(devices):
+    """One shard's contiguous range out of pages while others have
+    headroom: ``prepare_step`` masks exactly the starved slot and a
+    forced step raises a RuntimeError naming the per-shard frees —
+    the silent-stall failure mode is structurally impossible."""
+    # pps=4, 4 shards → each shard owns ONE ordinal; 1 page per shard
+    # means two slots' ordinal-0 pages both contend for shard 0.
+    eng = _engine(kv_shards=4, pages=1, t_max=64, page_size=16)
+    ok, _ = eng.pool.reserve_rows(0, 16)
+    assert ok
+    assert eng.pool.free_pages_by_shard == [0, 1, 1, 1]
+    assert eng.pool.free_pages == 3           # headroom elsewhere
+    ok2, _ = eng.pool.reserve_rows(1, 16)
+    assert not ok2                            # shard 0 is the wall
+    mask = eng.prepare_step(np.array([True, True]))
+    assert list(mask) == [True, False]
+    with pytest.raises(RuntimeError, match='free by shard'):
+        eng.step(np.zeros(2, np.int32), np.array([True, True]))
+
+
+def test_shard_exhaustion_walks_ladder_under_faults(devices):
+    """The serving-level twin, under the existing fault cocktail: two
+    growing streams contend for ONE shard's range (the others stay
+    free), the scheduler walks the preempt ladder, the winner
+    completes and the loser terminates as the typed CACHE_EXHAUSTED
+    eviction — reconstructable, drained, never stalled."""
+    eng = KernelEngine(slots=2, t_max=16, vocab=VOCAB, heads=2,
+                       head_dim=4, prefill_chunk=4, seed=5,
+                       cache_mode='paged', page_size=2, pages=2,
+                       kv_shards=4, decode_impl='xla')
+    samples = []
+
+    def on_tick(s):
+        samples.append((s.engine.pool.free_pages_by_shard[0],
+                        s.engine.pool.free_pages))
+
+    sched = Scheduler(
+        eng,
+        ServeConfig(queue_limit=4, max_new_tokens=10, watchdog=False,
+                    evict_before_reject=False, max_requeues=0),
+        registry=MetricsRegistry(),
+        fault_injector=ServeFaultInjector(ServeFaultPlan(
+            stuck_at_step=3, stuck_seconds=0.01)),
+        on_tick=on_tick)
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    results = sched.run_until_idle()
+    counters = sched.registry.snapshot()['counters']
+    assert counters['serve.cache_preempted'] >= 1
+    statuses = sorted(r.status for r in results.values())
+    assert 'completed' in statuses
+    loser = [r for r in results.values() if r.status != 'completed']
+    assert loser and loser[0].status == 'evicted'
+    assert loser[0].reason is RejectReason.CACHE_EXHAUSTED
+    # The edge this pins: shard 0's range was the wall (0 free) while
+    # the pool as a whole still had headroom — and the ladder turned
+    # that into the typed eviction above, not a stall.
+    assert any(s0 == 0 and total > 0 for s0, total in samples), samples
+    assert eng.pool.used_pages == 0
+    sched.close()
+
+
+# -- checksums, chaos and the shard-naming corruption arc ---------------
+
+def test_flip_detected_named_and_quarantined_per_shard(devices):
+    """The chaos seam under sharding: ``tracked_pages`` enumerates
+    GLOBAL ids, ``flip_page_bit`` lands inside the owning shard's
+    slice, verification names the page, ``check_pages`` names the
+    shard, and quarantine pins the (shard, local) pair."""
+    src = PrefillPool(t_max=64, page_size=16, vocab=VOCAB, seed=3)
+    handle = src.build(_prompt(37))
+    eng = _engine(pages=3, kv_shards=4)
+    eng.adopt_prefix(src.engine.cache, handle.pages, handle.length,
+                     src_checksums=src.engine.checksums)
+    src.release(handle)
+    tracked = eng.tracked_pages()
+    assert len(tracked) == 3
+    victim = tracked[-1]                 # ordinal 2 → shard 2's range
+    shard = eng.page_shard(victim)
+    assert shard == 2
+    eng.flip_page_bit(victim)
+    assert eng.verify_pages() == [victim]
+    with pytest.raises(PageCorruptionError) as ei:
+        eng.check_pages(tracked, 'attach')
+    assert ei.value.pages == [victim]
+    assert ei.value.shards == [shard]
+    assert f'kv shard(s) [{shard}]' in str(ei.value)
+    assert eng.quarantine_pages([victim]) == [victim]
+    _, local = eng._gsplit(victim)
+    assert (shard, local) in eng.pool.quarantined
+    assert eng.verify_pages() == []      # digest dropped with the page
+
+
+def test_serving_corruption_names_shard_and_heals(tmp_path, devices):
+    """End to end on a sharded topology: a flip in a live handed-off
+    page is scrubbed, the ``kv.corrupt`` event carries the owning
+    ``shards``, the flight dump narrates it, the victim heals
+    bit-identically on the clean replica, and the doctor's
+    kv_corruption evidence names the dirty shard."""
+    prompt = list(_prompt(18))
+    topo_kw = dict(kv_shards=2, pages=4)
+
+    clock_twin = VirtualClock()
+    twin = build_serving(
+        TopologyConfig(decode_replicas=1, slots=2, t_max=64,
+                       page_size=16, vocab=VOCAB, seed=3, **topo_kw),
+        serve_config=ServeConfig(watchdog=False, queue_limit=8,
+                                 max_new_tokens=8),
+        router_config=RouterConfig(prefill_threshold=4,
+                                   probe_interval=0.02,
+                                   probe_backoff_max=0.04,
+                                   integrity_interval=0.0),
+        clock=clock_twin, log_dir=tmp_path / 'twin')
+    try:
+        twin.submit(prompt, request_id='v')
+        ticks = 0
+        while twin.step():
+            clock_twin.advance(0.01)
+            ticks += 1
+            assert ticks < 5000
+        base = twin.results
+    finally:
+        twin.close()
+    assert base['v'].status == 'completed'
+
+    with obs_flight.recording(base_dir=tmp_path / 'flight',
+                              registry=MetricsRegistry()) as rec:
+        clock = VirtualClock()
+        router = build_serving(
+            TopologyConfig(decode_replicas=2, slots=2, t_max=64,
+                           page_size=16, vocab=VOCAB, seed=3,
+                           **topo_kw),
+            serve_config=ServeConfig(watchdog=False, queue_limit=8,
+                                     max_new_tokens=8),
+            router_config=RouterConfig(prefill_threshold=4,
+                                       probe_interval=0.02,
+                                       probe_backoff_max=0.04,
+                                       integrity_interval=0.0),
+            clock=clock, log_dir=tmp_path / 'logs')
+        try:
+            router.submit(prompt, request_id='v')
+            router.step()
+            clock.advance(0.01)
+            target = router._ledger['v']['replica']
+            eng = next(r for r in router.pool.replicas
+                       if r.name == target).engine
+            tracked = eng.tracked_pages()
+            assert tracked, 'handoff registered no pages'
+            victim = tracked[0]
+            eng.flip_page_bit(victim)
+            ticks = 0
+            while router.step():
+                clock.advance(0.01)
+                ticks += 1
+                assert ticks < 5000
+            results = router.results
+        finally:
+            router.close()
+        dumps = [d for d in rec.dumps if d['trigger'] == 'kv_corrupt']
+
+    assert results['v'].status == 'completed'
+    assert results['v'].tokens == base['v'].tokens
+
+    revs = list(obs.read_events(dict(router.pool.logs())['router']))
+    corrupt = [r for r in revs if r['event'] == 'kv.corrupt']
+    assert len(corrupt) == 1
+    assert corrupt[0]['target'] == target
+    assert victim in corrupt[0]['pages']
+    assert corrupt[0]['shards'] == [eng.page_shard(victim)]
+    handoffs = [r for r in
+                obs.read_events(dict(router.pool.logs())['prefill'])
+                if r['event'] == 'prefill.handoff']
+    assert handoffs and all(r['kv_shards'] == 2 for r in handoffs)
+    tls = reconstruct(router.pool.logs())
+    assert tls['v'].complete, tls['v'].errors
+    assert tls['v'].corruptions == 1 and tls['v'].recoveries == 1
+
+    assert len(dumps) == 1
+    incident = obs_doctor.diagnose(obs_flight.load_bundle(
+        dumps[0]['path']))
+    assert incident.primary == 'kv_corruption'
+    joined = ' '.join(incident.classes['kv_corruption']['evidence'])
+    assert 'kv shard(s)' in joined
+    assert str(eng.page_shard(victim)) in joined

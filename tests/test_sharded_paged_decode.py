@@ -1,0 +1,299 @@
+# -*- coding: utf-8 -*-
+"""
+Cluster-scale long context: the sequence-sharded page table
+(models/decode.py ShardedPageTable + init_sharded_paged_cache) and the
+paged ring-decode step it feeds.
+
+The contract under test: sharding a stream's page table across the
+mesh's seq axis is a MEMORY-placement change, not a numerics change.
+Each shard owns a contiguous page-ordinal range, appends drop through
+the local table's −1 on non-owners (pool rows land bit-identically to
+the single-pool reference), and the per-shard flash partials
+pmax/psum-merge into the single-pool attention result to float
+tolerance — on the XLA formulation and the fused kernel alike. On the
+host side: cross-shard allocation with rollback, per-shard exhaustion
+that names the full shard, and capacity that SUMS over shards.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributed_dot_product_tpu.models.decode import (
+    PagedDecodeCache, PagePool, ShardedPageTable, append_kv_slots,
+    decode_kernel_eligible, decode_step, init_paged_cache,
+    init_sharded_paged_cache, paged_gather,
+)
+from distributed_dot_product_tpu.parallel.mesh import seq_mesh
+
+WORLD, B, H, D, PS = 4, 2, 2, 8, 8
+T = 64                       # pps = 8 ordinals; 2 owned per shard
+PAGES_SHARD = 3              # per-shard pool: 3 pages + its sink
+PAGES_REF = WORLD * PAGES_SHARD
+
+
+@pytest.fixture(scope='module')
+def mesh():
+    return seq_mesh(WORLD)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _spt():
+    return ShardedPageTable(WORLD, PAGES_SHARD, PS, B, T // PS)
+
+
+def _spec():
+    return PagedDecodeCache(k_pool=P('seq'), v_pool=P('seq'),
+                            page_table=P('seq'), length=P(),
+                            k_q_pool=None, k_scale_pool=None)
+
+
+def _sh_call(mesh, fn, cache, *args, pair=False):
+    """Run ``fn(local_cache, *args)`` under shard_map: the stacked
+    cache splits per shard (its (1, slots, pps) table block squeezed
+    to the local view), everything else replicated. ``pair=True`` for
+    a ``(cache, out)``-returning ``fn`` (decode_step)."""
+    spec = _spec()
+
+    def body(c, *rest):
+        local = c._replace(page_table=c.page_table[0])
+        out = fn(local, *rest)
+        if pair:
+            c2, extra = out
+            return (c2._replace(page_table=c2.page_table[None]), extra)
+        return out._replace(page_table=out.page_table[None])
+
+    out_specs = (spec, P()) if pair else spec
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec,) + (P(),) * len(args),
+        out_specs=out_specs, check_vma=False)(cache, *args)
+
+
+def _mk_pair(fills=(14, 3), seed=0):
+    """Single-pool reference and sharded twin holding identical rows,
+    plus both host allocators."""
+    rng = _rng(seed)
+    ref = init_paged_cache(B, H, T, D, pages=PAGES_REF, page_size=PS,
+                           dtype=jnp.float32)
+    rpool = PagePool(PAGES_REF, PS, B, T // PS)
+    sh = init_sharded_paged_cache(WORLD, B, H, T, D,
+                                  pages_per_shard=PAGES_SHARD,
+                                  page_size=PS, dtype=jnp.float32)
+    spt = _spt()
+    mesh = seq_mesh(WORLD)
+    for slot, n in enumerate(fills):
+        if not n:
+            continue
+        k = jnp.asarray(rng.normal(size=(B, H, n, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, n, D)), jnp.float32)
+        sel = np.arange(B) == slot
+        counts = np.where(sel, n, 0).astype(np.int32)
+        ok, copies = rpool.reserve_rows(slot, n)
+        assert ok and not copies
+        ok, copies = spt.reserve_rows(slot, n)
+        assert ok and not copies
+        ref = ref._replace(page_table=jnp.asarray(rpool.table))
+        sh = sh._replace(page_table=jnp.asarray(spt.local_tables()))
+        ref = append_kv_slots(ref, k, v, slot_mask=sel, counts=counts)
+        sh = _sh_call(
+            mesh, lambda c, kk, vv: append_kv_slots(
+                c, kk, vv, slot_mask=sel, counts=counts), sh, k, v)
+        rpool.lengths[slot] += n
+        spt.lengths[slot] += n
+    return ref, rpool, sh, spt
+
+
+def _sharded_row(sh, spt, slot, pos):
+    """K row of logical position ``pos`` out of the stacked pools."""
+    o, r = divmod(pos, PS)
+    s = spt.owner(o)
+    pg = int(spt.shards[s].table[slot, o])
+    assert pg >= 0, f'position {pos} of slot {slot} is unmapped'
+    return np.asarray(sh.k_pool)[s * (PAGES_SHARD + 1) + pg, :, r]
+
+
+# -- host allocator -----------------------------------------------------
+
+def test_contiguous_ownership():
+    spt = _spt()
+    assert [spt.owner(o) for o in range(8)] == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert spt.owned_range(0) == (0, 2)
+    assert spt.owned_range(3) == (6, 8)
+    assert np.array_equal(spt.owner_vector(),
+                          [0, 0, 1, 1, 2, 2, 3, 3])
+    # Ceil split: 7 ordinals over 4 shards → 2/2/2/1.
+    odd = ShardedPageTable(4, 3, PS, B, 7)
+    assert odd.owned_range(3) == (6, 7)
+    assert odd.owner(6) == 3
+
+
+def test_capacity_sums_across_shards():
+    spt = _spt()
+    assert spt.pages == PAGES_REF
+    assert spt.free_pages == PAGES_REF
+    assert spt.free_pages_by_shard == [PAGES_SHARD] * WORLD
+
+
+def test_prepare_append_routes_to_owner():
+    spt = _spt()
+    # Fill slot 0 to one row short of shard 0's range (2 pages = 16).
+    ok, _ = spt.reserve_rows(0, 16)
+    assert ok
+    spt.lengths[0] = 16
+    st, s, src, dst = spt.prepare_append(0)
+    assert (st, s) == ('alloc', 1)          # ordinal 2 → shard 1
+    assert spt.shards[1].table[0, 2] == dst
+    assert spt.shards[0].free_pages == PAGES_SHARD - 2
+    assert spt.shards[1].free_pages == PAGES_SHARD - 1
+
+
+def test_reserve_rollback_spans_shards():
+    spt = _spt()
+    # Drain shard 1 completely with slot 1 (ordinals 2,3 + quarantine
+    # the third page so nothing is left).
+    ok, _ = spt.reserve_rows(1, 32)          # ordinals 0..3
+    assert ok
+    spt.lengths[1] = 32
+    spt.quarantine(1, [int(p) for p in spt.shards[1]._free])
+    assert spt.shards[1].free_pages == 0
+    free0 = spt.free_pages_by_shard
+    # Slot 0 asks for rows spanning shards 0 AND 1: shard 1 is dry, so
+    # the reservation must fail and leave shard 0's pages untouched.
+    ok, copies = spt.reserve_rows(0, 24)     # ordinals 0,1 (s0), 2 (s1)
+    assert not ok and not copies
+    assert spt.free_pages_by_shard == free0
+    assert int(spt.shards[0].counts[0]) == 0
+    assert (spt.shards[0].table[0] == -1).all()
+
+
+def test_one_shard_exhausted_while_others_have_headroom():
+    spt = _spt()
+    # Three sequences park one page each in shard 0's range.
+    pool3 = ShardedPageTable(WORLD, PAGES_SHARD, PS, 4, T // PS)
+    for slot in range(3):
+        ok, _ = pool3.reserve_rows(slot, 1)
+        assert ok
+        pool3.lengths[slot] = 1
+    st, s, _, _ = pool3.prepare_append(3)
+    assert (st, s) == ('exhausted', 0)
+    assert pool3.free_pages_by_shard[0] == 0
+    assert all(f == PAGES_SHARD for f in pool3.free_pages_by_shard[1:])
+    assert pool3.free_pages > 0              # aggregate lies; shard 0 full
+
+
+def test_release_and_truncate_cross_shards():
+    spt = _spt()
+    ok, _ = spt.reserve_rows(0, 20)          # ordinals 0,1 (s0), 2 (s1)
+    assert ok
+    spt.lengths[0] = 20
+    freed = spt.truncate(0, 10)              # keep 2 pages → drop s1's
+    assert list(freed) == [1] and len(freed[1]) == 1
+    assert spt.shards[1].free_pages == PAGES_SHARD
+    assert int(spt.lengths[0]) == 10
+    freed = spt.release(0)
+    assert list(freed) == [0] and len(freed[0]) == 2
+    assert spt.free_pages == PAGES_REF
+    assert int(spt.lengths[0]) == 0
+
+
+def test_shared_lengths_vector():
+    spt = _spt()
+    spt.lengths[0] = 5
+    assert all(int(p.lengths[0]) == 5 for p in spt.shards)
+    spt.shards[2].lengths[0] += 1            # engine-style alias bump
+    assert int(spt.lengths[0]) == 6
+
+
+# -- decode parity ------------------------------------------------------
+
+@pytest.mark.parametrize('impl', ['xla', 'kernel'])
+def test_sharded_step_matches_single_pool(mesh, impl):
+    ref, rpool, sh, spt = _mk_pair()
+    rng = _rng(7)
+    for step in range(4):                    # slot 0 crosses 16 → s1
+        q, kn, vn = (jnp.asarray(rng.normal(size=(B, H, 1, D)),
+                                 jnp.float32) for _ in range(3))
+        for slot in range(B):
+            st, _, _ = rpool.prepare_append(slot)
+            assert st in ('ok', 'alloc')
+            st, s, _, _ = spt.prepare_append(slot)
+            assert st in ('ok', 'alloc')
+        ref = ref._replace(page_table=jnp.asarray(rpool.table))
+        sh = sh._replace(page_table=jnp.asarray(spt.local_tables()))
+        ref, out_r = decode_step(q, ref, kn, vn, impl='xla')
+        sh, out_s = _sh_call(
+            mesh, lambda c, qq, kk, vv: decode_step(
+                qq, c, kk, vv, impl=impl, axis_name='seq'),
+            sh, q, kn, vn, pair=True)
+        rpool.lengths += 1
+        spt.lengths += 1
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r),
+                                   atol=2e-5, rtol=1e-5)
+    # Slot 0's fill (18) now spans shard 0 (rows 0..15) and shard 1
+    # (16..17); every row is bit-identical to the single-pool pool row.
+    gk, _ = paged_gather(ref)
+    for slot in range(B):
+        ln = int(spt.lengths[slot])
+        for pos in range(ln):
+            np.testing.assert_array_equal(
+                _sharded_row(sh, spt, slot, pos),
+                np.asarray(gk)[slot, :, pos])
+
+
+def test_sharded_append_drops_on_non_owners(mesh):
+    """A non-owning shard's pool takes NOTHING from an append."""
+    _, _, sh, spt = _mk_pair(fills=(4, 0))
+    # Fill 4 lives in ordinal 0 → shard 0; shards 1..3 own no pages.
+    pools = np.asarray(sh.k_pool).reshape(WORLD, PAGES_SHARD + 1, H,
+                                          PS, D)
+    assert np.all(pools[1:] == 0)
+    assert (np.asarray(sh.page_table)[1:] == -1).all()
+
+
+def test_sharded_verify_k_is_xla_only(mesh):
+    _, _, sh, spt = _mk_pair(fills=(4, 3))
+    q = jnp.zeros((B, H, 2, D), jnp.float32)
+    with pytest.raises(ValueError, match='single-token'):
+        _sh_call(
+            mesh, lambda c, qq: decode_step(
+                qq, c, qq, qq, impl='kernel', axis_name='seq'),
+            sh, q, pair=True)
+
+
+# -- mesh-aware eligibility explanations (satellite) --------------------
+
+def test_eligible_explanations_name_shard_geometry():
+    cache = init_paged_cache(B, H, T, D, pages=PAGES_SHARD + 1,
+                             page_size=PS, dtype=jnp.float32)
+    ok, why = decode_kernel_eligible(cache, explain=True, n_shards=4)
+    assert ok
+    assert 'sequence-sharded page table' in why
+    assert '4 shards' in why and 'contiguous run of 2' in why
+
+    ok, why = decode_kernel_eligible(cache, explain=True, n_shards=4,
+                                     shard=2)
+    assert ok
+    assert 'shard 2/4' in why and '[4, 6)' in why
+
+    # Per-shard ineligibility keeps the geometry prefix.
+    ok, why = decode_kernel_eligible(cache, n=2, explain=True,
+                                     n_shards=4, shard=1)
+    assert not ok
+    assert 'shard 1/4' in why and 'single-token' in why
+
+    # Slab sharding names column ranges instead.
+    from distributed_dot_product_tpu.models.decode import init_cache
+    slab = init_cache(B, H, 16, D, dtype=jnp.float32)
+    ok, why = decode_kernel_eligible(slab, explain=True, n_shards=2,
+                                     shard=1)
+    assert ok and 'columns [16, 32)' in why
+
+    # Unsharded probes are unchanged: eligible means reason is None.
+    ok, why = decode_kernel_eligible(cache, explain=True)
+    assert ok and why is None
