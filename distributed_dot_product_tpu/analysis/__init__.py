@@ -3,7 +3,7 @@
 ``graphlint`` — static analysis that mechanically enforces the repo's
 performance and correctness contracts.
 
-Four engines (see README "Static analysis"):
+Five engines (see README "Static analysis"):
 
 - **Jaxpr linter** (:mod:`.jaxpr_rules` over :mod:`.registry`): traces
   every registered entrypoint at example abstract shapes and walks the
@@ -22,6 +22,12 @@ Four engines (see README "Static analysis"):
   discipline, and real-time/random/environ reads inside declared
   virtual-clock tick paths (``GRAPHLINT_TICK_ROOTS`` closures, with the
   intentional real-time modules in determlint's REAL_TIME_CONTRACT).
+- **flowlint** (:mod:`.flowlint`): interprocedural typed-failure flow
+  — per-function may-raise sets over the intra-package call graph
+  judged against the typed contract at the declared serving roots
+  (typed-escape, with ``file:line → file:line`` propagation chains),
+  handler totality on typed serving errors, RejectReason taxonomy
+  liveness, and ShardedPageTable stride-ownership.
 
 CLI: ``python -m distributed_dot_product_tpu.analysis`` (exit 0 = no
 violations). The tier-1 gate test (tests/test_graphlint.py) asserts a
@@ -59,7 +65,7 @@ def run_analysis(paths=None, rules=None, repo_root=None,
     violations = []
     if ast_rules:
         from distributed_dot_product_tpu.analysis import (
-            astlint, conclint, determlint, protolint,
+            astlint, conclint, determlint, flowlint, protolint,
         )
         if paths is None:
             pkg = os.path.dirname(os.path.dirname(
@@ -82,7 +88,8 @@ def run_analysis(paths=None, rules=None, repo_root=None,
         # servelint families ride the same AST pass and path set.
         for mod, fam in ((protolint, protolint.PROTO_RULES),
                          (conclint, conclint.CONC_RULES),
-                         (determlint, determlint.DETERM_RULES)):
+                         (determlint, determlint.DETERM_RULES),
+                         (flowlint, flowlint.FLOW_RULES)):
             fam_rules = None if rules is None else \
                 [r for r in rules if r in fam]
             if fam_rules is None or fam_rules:

@@ -42,8 +42,11 @@ def main(argv=None):
     parser.add_argument('--rule', action='append', dest='rules',
                         metavar='ID', choices=sorted(RULES),
                         help='run only this rule (repeatable)')
-    parser.add_argument('--format', choices=('text', 'json'),
-                        default='text')
+    parser.add_argument('--format', choices=('text', 'json', 'sarif'),
+                        default='text',
+                        help='text (one line each), json (stable '
+                             'rule/file/line/chain dicts), or sarif '
+                             '(SARIF 2.1.0 for inline CI annotation)')
     parser.add_argument('--no-jaxpr', action='store_true',
                         help='skip the (slower) jaxpr/registry pass')
     parser.add_argument('--no-ast', action='store_true',
@@ -70,6 +73,9 @@ def main(argv=None):
         from distributed_dot_product_tpu.analysis.determlint import (
             DETERM_RULES,
         )
+        from distributed_dot_product_tpu.analysis.flowlint import (
+            FLOW_RULES,
+        )
         from distributed_dot_product_tpu.analysis.jaxpr_rules import (
             JAXPR_RULES,
         )
@@ -78,7 +84,7 @@ def main(argv=None):
         )
         static = (set(AST_RULES) | set(JAXPR_RULES) | set(PROTO_RULES)
                   | set(CONC_RULES) | set(DETERM_RULES)
-                  | {'parse-error'})
+                  | set(FLOW_RULES) | {'parse-error'})
         runtime_only = [r for r in args.rules if r not in static]
         if runtime_only:
             parser.error(
@@ -100,8 +106,8 @@ def main(argv=None):
             print(f'graphlint: no .py files changed vs '
                   f'{args.changed_only} — nothing to lint',
                   file=sys.stderr)
-            if args.format == 'json':
-                print('[]')
+            if args.format != 'text':
+                print(format_violations([], fmt=args.format))
             return 0
         args.paths = changed
         if not args.no_jaxpr and not any(

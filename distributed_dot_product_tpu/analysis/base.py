@@ -13,7 +13,9 @@ actionable from its one-line form.
 Suppression: a trailing ``# graphlint: allow[<rule-id>]`` comment on
 the offending line (or the line directly above) waives that rule for
 that site — deliberate exceptions stay visible and greppable in the
-source instead of accumulating in a config file.
+source instead of accumulating in a config file. The flowlint family
+spells the same pragma ``# flowlint: allow[<rule-id>]``; both prefixes
+parse identically.
 """
 
 import dataclasses
@@ -119,9 +121,39 @@ RULES = {
         'intra-module call closure) — the seeded bit-reproducible '
         'replay contract; intentional real-time sites live in '
         'determlint\'s REAL_TIME_CONTRACT table'),
+    # -- flowlint: interprocedural typed-failure flow (PR 19) -----------
+    'typed-escape': (
+        'flowlint (analysis/flowlint.py): every exception class that '
+        'can escape a declared serving root (SERVING_ROOTS — '
+        'Scheduler.step/submit, Router.step/submit, KernelEngine.step/'
+        'prefill/verify_step, run_trace) must be in the typed failure '
+        'contract (TYPED_CONTRACT: RejectedError, PageCorruptionError, '
+        'shard-exhaustion RuntimeError, ServeContractError, '
+        'UnknownReplicaError) — a raw KeyError/IndexError/ValueError '
+        'escape flags with its propagation chain file:line → file:line '
+        '(the PR 17 deque.remove bug class, mechanized)'),
+    'handler-totality': (
+        'flowlint: an `except` of a typed serving error (RejectedError/'
+        'PageCorruptionError or a subclass) must re-raise, route the '
+        'failure into the event/metric ladder (emit/log_exception/'
+        'count_reject/reject — directly or transitively), or consume '
+        'the typed payload (.reason/.pages/.site) — silently dropping '
+        'a typed failure un-types it'),
+    'reason-coverage': (
+        'flowlint: every RejectReason member needs ≥ 1 raise/convert '
+        'reference site plus serve.reject emit and per-reason counter '
+        'coverage — a dead enum member is taxonomy the operator '
+        'dashboards promise but no code path can produce'),
+    'shard-ownership': (
+        'flowlint: host code outside models/decode.py must reach '
+        'ShardedPageTable geometry through its helpers (gpage/gsplit/'
+        'page_shard/owner/owned_range/tracked_pages), never raw '
+        '`pages_per_shard + 1` stride arithmetic — the PR 18 '
+        'contiguous-ownership layout has exactly one home'),
 }
 
-_PRAGMA = re.compile(r'#\s*graphlint:\s*allow\[([a-z0-9_,\s-]+)\]')
+_PRAGMA = re.compile(
+    r'#\s*(?:graphlint|flowlint):\s*allow\[([a-z0-9_,\s-]+)\]')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,8 +167,13 @@ class Violation:
     # .allow — the flax Dense bf16-accum debt) keeps the record in
     # `--format json` output without failing the CLI or the gate, so
     # known debt stays enumerable instead of disappearing into a
-    # pragma.
+    # pragma. flowlint pragma waivers ride the same flag — a waived
+    # failure-flow site is debt, not absence.
     allowed: bool = False
+    # typed-escape only: the propagation chain root → origin raise as
+    # ('file:line', ...) hops — the `--format json` shape README
+    # documents (rule/file/line/chain are the stable keys).
+    chain: Optional[tuple] = None
 
     def render(self):
         where = f'{self.file}:{self.line}' if self.file else '<registry>'
@@ -164,12 +201,48 @@ def active_violations(violations):
 
 
 def format_violations(violations, fmt='text'):
-    """Render a violation list for the CLI: ``text`` (one line each) or
-    ``json`` (a list of plain dicts, ``allowed`` records included)."""
+    """Render a violation list for the CLI: ``text`` (one line each),
+    ``json`` (a list of plain dicts, ``allowed`` records included), or
+    ``sarif`` (a minimal SARIF 2.1.0 log — one run, ruleId/level/
+    message/location per result — so CI can annotate findings inline;
+    ``allowed`` records carry level ``note``, active ones ``error``)."""
     if fmt == 'json':
         import json
         return json.dumps([dataclasses.asdict(v) for v in violations],
                           indent=2)
+    if fmt == 'sarif':
+        import json
+        results = []
+        for v in violations:
+            entry = f' [{v.entrypoint}]' if v.entrypoint else ''
+            res = {
+                'ruleId': v.rule,
+                'level': 'note' if v.allowed else 'error',
+                'message': {'text': f'{v.message}{entry}'},
+            }
+            if v.file:
+                res['locations'] = [{'physicalLocation': {
+                    'artifactLocation': {
+                        'uri': v.file.replace('\\', '/')},
+                    'region': {'startLine': int(v.line or 1)},
+                }}]
+            results.append(res)
+        used = sorted({v.rule for v in violations})
+        log = {
+            '$schema': 'https://json.schemastore.org/sarif-2.1.0.json',
+            'version': '2.1.0',
+            'runs': [{
+                'tool': {'driver': {
+                    'name': 'graphlint',
+                    'rules': [{'id': r,
+                               'shortDescription':
+                                   {'text': RULES.get(r, r)}}
+                              for r in used],
+                }},
+                'results': results,
+            }],
+        }
+        return json.dumps(log, indent=2)
     act = active_violations(violations)
     n_allowed = len(violations) - len(act)
     lines = [v.render() for v in violations]

@@ -968,8 +968,16 @@ class PagePool:
             if page in self.quarantined:
                 continue
             self.quarantined.add(page)
-            if self.refcount[page] == 0 and page in self._free:
-                self._free.remove(page)
+            if self.refcount[page] == 0:
+                # Delete by INDEX, never list.remove: .remove raises an
+                # untyped ValueError when the element is missing (the
+                # PR 17 deque.remove bug class; flowlint typed-escape
+                # flags it even behind a membership guard).
+                idx = next(
+                    (i for i, f in enumerate(self._free) if f == page), None
+                )
+                if idx is not None:
+                    self._free.pop(idx)
             fresh.append(page)
         return fresh
 
@@ -1132,8 +1140,14 @@ class PagePool:
         −1s when no tail copy is needed; on exhaustion nothing is
         changed."""
         if self.counts[slot] or self.lengths[slot]:
-            raise ValueError(f'attach needs an empty slot, slot {slot} '
-                             f'holds {self.counts[slot]} pages')
+            # Pool-state invariant, not an argument check: the serving
+            # stack attaches only onto a just-reset slot, so a non-empty
+            # one means the bookkeeping broke — RuntimeError, the typed
+            # internal-state shape (flowlint typed-escape: this raise is
+            # reachable from Scheduler.submit via start_with_prefix).
+            raise RuntimeError(f'attach needs an empty slot, slot '
+                               f'{slot} holds {self.counts[slot]} '
+                               f'pages')
         full = length // self.page_size
         rem = length % self.page_size
         tail_src = tail_dst = -1
@@ -1332,6 +1346,25 @@ class ShardedPageTable:
                            for o in range(self.pages_per_slot)],
                           np.int32)
 
+    # The stacked-pool row layout: each shard contributes
+    # ``pages_per_shard`` allocatable rows PLUS its own sink row, so a
+    # shard's block in the stacked device pool is
+    # ``pages_per_shard + 1`` rows wide. These three helpers are the
+    # ONLY place that stride may appear — host code elsewhere goes
+    # through them (flowlint's shard-ownership rule enforces it).
+    def gpage(self, shard, page):
+        """Shard-local page id → GLOBAL stacked-pool row id."""
+        return shard * (self.pages_per_shard + 1) + page
+
+    def gsplit(self, gpage):
+        """GLOBAL stacked-pool row id → ``(shard, local page)``."""
+        stride = self.pages_per_shard + 1
+        return int(gpage) // stride, int(gpage) % stride
+
+    def page_shard(self, gpage):
+        """Mesh member owning GLOBAL stacked-pool row id ``gpage``."""
+        return int(gpage) // (self.pages_per_shard + 1)
+
     # -- aggregate introspection ---------------------------------------
     @property
     def pages(self):
@@ -1513,8 +1546,9 @@ class ShardedPageTable:
         tail-page exhaustion nothing is changed."""
         if self.lengths[slot] or any(int(p.counts[slot])
                                      for p in self.shards):
-            raise ValueError(f'attach needs an empty slot, slot {slot} '
-                             f'is in use')
+            # Same internal-state shape as PagePool.attach above.
+            raise RuntimeError(f'attach needs an empty slot, slot '
+                               f'{slot} is in use')
         full = length // self.page_size
         rem = length % self.page_size
         tail_shard = tail_src = tail_dst = -1
@@ -1730,8 +1764,30 @@ def decode_kernel_eligible(cache, n=1, segment_ids=None, qk_quant=None,
     return verdict(None)
 
 
+def _axis_env_size(axis_name):
+    """Static size of ``axis_name`` when tracing inside its shard_map
+    (the axis env records the mesh axis size — a host int, no traced
+    value involved); 2 — "sharded, count unknown" — when no axis env
+    is active (a direct host-side probe outside any mesh: every
+    sharded gate keys on ``n_shards > 1``, not the count)."""
+    if axis_name is None:
+        return 1
+    try:
+        frame = jax.core.axis_frame(axis_name)
+    except NameError:       # no axis env: probed outside the mesh
+        return 2
+    # 0.4.x returns the size directly; older envs a frame object.
+    return int(getattr(frame, 'size', frame))
+
+
 def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
                          axis_name=None):
+    # Thread the mesh geometry into EVERY eligibility probe so the
+    # explain string names every gate this resolver actually tests —
+    # before this, a forced-kernel sharded verify-k passed the
+    # (unsharded) probe here and only blew up at the late kernel-path
+    # check, with no geometry in the error.
+    n_shards = _axis_env_size(axis_name)
     if impl in (None, 'auto'):
         # Mirror the flash-kernel gating: the kernel is the TPU path;
         # elsewhere it would run interpreted (covered by tests that
@@ -1739,9 +1795,9 @@ def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
         # Sharded verify-k (axis_name + n > 1) is XLA-only — the
         # kernel's flash-decoding merge carries one row per shard —
         # so 'auto' must fall back rather than resolve to a path that
-        # raises.
-        if (decode_kernel_eligible(cache, n, segment_ids, qk_quant)
-                and not (axis_name is not None and n != 1)
+        # raises; the n_shards-aware probe encodes that gate.
+        if (decode_kernel_eligible(cache, n, segment_ids, qk_quant,
+                                   n_shards=n_shards)
                 and jax.default_backend() == 'tpu'):
             return 'kernel'
         return 'xla'
@@ -1750,7 +1806,8 @@ def _resolve_decode_impl(impl, cache, n, segment_ids, qk_quant,
                          f"'xla', got {impl!r}")
     if impl == 'kernel':
         ok, reason = decode_kernel_eligible(cache, n, segment_ids,
-                                            qk_quant, explain=True)
+                                            qk_quant, explain=True,
+                                            n_shards=n_shards)
         if not ok:
             raise ValueError(
                 f'decode_step: the fused kernel does not cover this '

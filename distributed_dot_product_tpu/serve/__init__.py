@@ -45,7 +45,10 @@ from distributed_dot_product_tpu.serve.control import (  # noqa: F401
     ControlConfig, Controller,
 )
 from distributed_dot_product_tpu.serve.engine import (  # noqa: F401
-    KernelEngine,
+    KernelEngine, PageCorruptionError,
+)
+from distributed_dot_product_tpu.serve.errors import (  # noqa: F401
+    ServeContractError, UnknownReplicaError,
 )
 from distributed_dot_product_tpu.serve.health import (  # noqa: F401
     HealthMonitor, Liveness, Readiness,
@@ -80,4 +83,5 @@ __all__ = ['AdmissionController', 'RejectReason', 'RejectedError',
            'parse_topology', 'Router', 'RouterConfig',
            'build_serving', 'PolicyConfig', 'TenantPolicy',
            'SchedulingPolicy', 'ControlConfig', 'Controller',
-           'ChaosSchedule']
+           'ChaosSchedule', 'PageCorruptionError',
+           'ServeContractError', 'UnknownReplicaError']

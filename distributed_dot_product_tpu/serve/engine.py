@@ -58,6 +58,7 @@ from distributed_dot_product_tpu.models.decode import (
 )
 from distributed_dot_product_tpu.obs import spans as obs_spans
 from distributed_dot_product_tpu.obs.spans import span
+from distributed_dot_product_tpu.serve.errors import ServeContractError
 
 __all__ = ['KernelEngine', 'PageCorruptionError']
 
@@ -568,17 +569,17 @@ class KernelEngine:
 
     def _gpage(self, shard, page):
         """Shard-local page id → GLOBAL page id (= the page's stacked
-        pool row, ``shard·(pages_per_shard+1)+page`` — each member's
-        block ends with its own sink row). Global ids are what the
-        kv_shards engine's host surface speaks (registry, checksums
-        verdicts, quarantine), so the router/scheduler page arithmetic
-        works unchanged."""
-        return shard * (self.pool.pages_per_shard + 1) + page
+        pool row — each member's block ends with its own sink row).
+        Global ids are what the kv_shards engine's host surface speaks
+        (registry, checksums verdicts, quarantine), so the router/
+        scheduler page arithmetic works unchanged. The stride layout
+        itself lives in :meth:`ShardedPageTable.gpage` — flowlint's
+        shard-ownership rule keeps it from leaking back here."""
+        return self.pool.gpage(shard, page)
 
     def _gsplit(self, gpage):
         """GLOBAL page id → ``(shard, local page)``."""
-        stride = self.pool.pages_per_shard + 1
-        return int(gpage) // stride, int(gpage) % stride
+        return self.pool.gsplit(gpage)
 
     def page_shard(self, page):
         """Mesh member owning GLOBAL page id ``page`` on a kv_shards
@@ -586,7 +587,7 @@ class KernelEngine:
         shard naming probes any engine through this)."""
         if self.kv_shards <= 1:
             return None
-        return int(page) // (self.pool.pages_per_shard + 1)
+        return self.pool.page_shard(page)
 
     # -- host surface (numpy in, numpy out) -----------------------------
     def step(self, tokens, active, poison=None, request_ids=None):
@@ -673,14 +674,15 @@ class KernelEngine:
         engines auto-reserve the pages, raising on exhaustion — the
         Scheduler reserves through its evict/preempt ladder instead)."""
         if self.kv_shards > 1:
-            raise ValueError(
+            raise ServeContractError(
                 'verify_step (speculative decoding) is not supported '
                 'with kv_shards > 1 — the sharded ring-decode step is '
                 'single-token; run spec decode on unsharded replicas')
         tokens = np.asarray(tokens, np.int32)
         s, w = tokens.shape
         if s != self.slots:
-            raise ValueError(f'tokens rows {s} != slots {self.slots}')
+            raise ServeContractError(
+                f'tokens rows {s} != slots {self.slots}')
         counts = np.clip(np.asarray(counts, np.int64), 0, w)
         act = np.asarray(active, bool)
         poison = (np.zeros(self.slots, bool) if poison is None
@@ -788,8 +790,9 @@ class KernelEngine:
         (see :meth:`step`)."""
         n = len(tokens)
         if n > self.prefill_chunk:
-            raise ValueError(f'chunk of {n} exceeds prefill_chunk='
-                             f'{self.prefill_chunk}')
+            raise ServeContractError(
+                f'chunk of {n} exceeds prefill_chunk='
+                f'{self.prefill_chunk}')
         buf = np.zeros(self.prefill_chunk, np.int32)
         buf[:n] = np.asarray(tokens, np.int32)
         if self.cache_mode == 'paged':

@@ -1,0 +1,36 @@
+# -*- coding: utf-8 -*-
+"""
+Typed narrowings of builtin exceptions on the serving host surface —
+the classes flowlint's ``typed-escape`` rule admits through a serving
+root (see ``analysis/flowlint.py``'s ``TYPED_CONTRACT``).
+
+The contract: every exception leaving ``Scheduler.step/submit``,
+``Router.step/submit``, ``KernelEngine.step/prefill/verify_step`` or
+``run_trace`` carries a type the operator can dispatch on.
+``RejectedError`` (typed reasons) and ``PageCorruptionError``
+(integrity verdicts) already did; the remaining escapes were bare
+``ValueError``/``KeyError`` caller-contract raises. These subclasses
+keep every existing ``except ValueError`` / ``except KeyError``
+caller working (they ARE the builtin) while making the serving stack's
+own raises distinguishable from a stray builtin leaking out of library
+code — the distinction the PR 17 ``deque.remove`` bug hid behind.
+"""
+
+__all__ = ['ServeContractError', 'UnknownReplicaError']
+
+
+class ServeContractError(ValueError):
+    """The caller broke a serving-surface contract (an unsupported
+    argument combination, a mis-shaped batch, a paged-only feature on
+    a slab engine). A subclass of ValueError so existing callers'
+    ``except ValueError`` handlers keep working."""
+
+
+class UnknownReplicaError(KeyError):
+    """A replica name that is not (or no longer) a pool member. A
+    subclass of KeyError so existing ``except KeyError`` callers keep
+    working; ``str()`` renders the message without KeyError's repr
+    quoting."""
+
+    def __str__(self):
+        return self.args[0] if self.args else ''

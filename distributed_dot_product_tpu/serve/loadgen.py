@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from distributed_dot_product_tpu.serve.admission import RejectedError
+from distributed_dot_product_tpu.serve.errors import ServeContractError
 from distributed_dot_product_tpu.serve.scheduler import (
     Scheduler, ServeConfig,
 )
@@ -338,7 +339,8 @@ def run_trace(scheduler: Scheduler, trace: List[Arrival],
     .Controller` rides a router-driven run (a plain Scheduler's own
     ``on_tick`` hook covers the single-scheduler case)."""
     if tick_seconds <= 0:
-        raise ValueError(f'tick_seconds must be > 0, got {tick_seconds}')
+        raise ServeContractError(
+            f'tick_seconds must be > 0, got {tick_seconds}')
     t0 = time.perf_counter()
     start = clock()
     submitted, rejected = [], {}

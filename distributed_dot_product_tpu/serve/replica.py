@@ -51,6 +51,7 @@ from distributed_dot_product_tpu.obs.events import EventLog
 from distributed_dot_product_tpu.obs.spans import span
 from distributed_dot_product_tpu.parallel.mesh import seq_mesh
 from distributed_dot_product_tpu.serve.engine import KernelEngine
+from distributed_dot_product_tpu.serve.errors import UnknownReplicaError
 from distributed_dot_product_tpu.serve.scheduler import (
     Scheduler, ServeConfig,
 )
@@ -469,15 +470,19 @@ class ReplicaPool:
         resubmit elsewhere — nothing is dropped without a typed
         reason. The member's event log stays in :meth:`logs` and its
         finalized results stay readable under :attr:`retired`."""
-        replica = next((r for r in self.replicas if r.name == name),
-                       None)
-        if replica is None:
-            raise KeyError(f'no replica named {name!r} in the pool')
+        idx = next((i for i, r in enumerate(self.replicas)
+                    if r.name == name), None)
+        if idx is None:
+            raise UnknownReplicaError(
+                f'no replica named {name!r} in the pool')
         if len(self.replicas) <= 1:
             raise ValueError('cannot remove the last decode replica')
+        # Delete by INDEX, never list.remove: .remove walks __eq__ and
+        # raises untyped ValueError — the PR 17 deque.remove bug class
+        # (flowlint typed-escape flags it).
+        replica = self.replicas.pop(idx)
         drained = replica.scheduler.drain()
         replica.close()
-        self.replicas.remove(replica)
         self.retired.append(replica)
         return drained
 
@@ -490,12 +495,15 @@ class ReplicaPool:
         :meth:`DecodeReplica.kill` runs here if the crash seam has not
         fired already (probe-declared losses arrive with the member
         already dead)."""
-        replica = next((r for r in self.replicas if r.name == name),
-                       None)
-        if replica is None:
-            raise KeyError(f'no replica named {name!r} in the pool')
+        idx = next((i for i, r in enumerate(self.replicas)
+                    if r.name == name), None)
+        if idx is None:
+            raise UnknownReplicaError(
+                f'no replica named {name!r} in the pool')
+        # Delete by INDEX (see remove_replica): list.remove raises
+        # untyped ValueError through Router.step's probe path.
+        replica = self.replicas.pop(idx)
         replica.kill()
-        self.replicas.remove(replica)
         self.lost.append(replica)
         return replica
 

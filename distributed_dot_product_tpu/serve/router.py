@@ -85,6 +85,7 @@ from distributed_dot_product_tpu.serve.admission import (
     RejectedError, RejectReason, Request, RequestResult,
 )
 from distributed_dot_product_tpu.serve.engine import PageCorruptionError
+from distributed_dot_product_tpu.serve.errors import UnknownReplicaError
 from distributed_dot_product_tpu.serve.replica import (
     ReplicaPool, TopologyConfig,
 )
@@ -564,7 +565,7 @@ class Router:
         without a typed reason, with or without survivors). Returns
         the number of streams re-dispatched."""
         if name not in self._by_name:
-            raise KeyError(f'no replica named {name!r}')
+            raise UnknownReplicaError(f'no replica named {name!r}')
         victim = self.pool.mark_lost(name)   # kills it if still alive
         del self._by_name[name]
         self._probe_state.pop(name, None)
@@ -884,7 +885,7 @@ class Router:
         PREFIX_UNREGISTERED reason (never a stripped-prompt
         resubmission)."""
         if name not in self._by_name:
-            raise KeyError(f'no replica named {name!r}')
+            raise UnknownReplicaError(f'no replica named {name!r}')
         if len(self.pool.replicas) <= 1:
             raise ValueError('cannot drain the last decode replica')
         # Re-expansion table BEFORE the pool drops the member (the

@@ -69,6 +69,7 @@ from distributed_dot_product_tpu.serve.admission import (
     RequestResult,
 )
 from distributed_dot_product_tpu.serve.engine import PageCorruptionError
+from distributed_dot_product_tpu.serve.errors import ServeContractError
 from distributed_dot_product_tpu.serve.health import (
     HealthMonitor, Liveness, Readiness,
 )
@@ -476,8 +477,8 @@ class Scheduler:
         request for multi-tenant accounting (admit/reject events,
         tenant-labeled metrics; default tenant ``'default'``)."""
         if prefix_id is not None and not self._paged:
-            raise ValueError("prefix_id needs a paged engine "
-                             "(cache_mode='paged')")
+            raise ServeContractError(
+                "prefix_id needs a paged engine (cache_mode='paged')")
         req = Request(prompt=prompt,
                       max_new_tokens=max_new_tokens
                       or self.cfg.max_new_tokens,

@@ -27,14 +27,18 @@ else
     echo 'ruff not installed in this image — skipping (graphlint still runs)'
 fi
 
-echo '=== [2/13] graphlint + servelint (jaxpr/domain/serving contracts) ==='
+echo '=== [2/13] graphlint + servelint + flowlint (jaxpr/domain/serving contracts) ==='
 # Full pass: jaxpr rules over every registered entrypoint (incl. the
 # bf16 serving-dtype and int8-weight twins — the owned dense retired
 # the flax-Dense f32-accum waivers, so zero allowed records remain)
 # + the AST families (host-pull/traced-bool/clock/
 # silent-except) + servelint (protolint event-schema call sites,
 # conclint guarded-by/thread discipline, determlint tick-path
-# determinism). Fast pre-commit twin:
+# determinism) + flowlint (interprocedural typed-failure flow: typed
+# escapes at the serving roots with propagation chains, handler
+# totality, RejectReason liveness, shard-stride ownership; pragma
+# waivers stay visible and the gate keeps them at zero). Fast
+# pre-commit twin:
 #   python -m distributed_dot_product_tpu.analysis --changed-only origin/main
 JAX_PLATFORMS=cpu python -m distributed_dot_product_tpu.analysis || rc=1
 

@@ -1,0 +1,372 @@
+# -*- coding: utf-8 -*-
+"""
+flowlint (analysis/flowlint.py) — the interprocedural typed-failure-
+flow engine's own gate and rule tests, plus the pinning tests for the
+real violations the first repo-wide sweep found and fixed in-diff.
+
+Mirrors tests/test_servelint.py's structure:
+
+- **Clean-tree gate**: zero flowlint records repo-wide — ACTIVE and
+  WAIVED both: the typed-failure contract carries no pragma debt.
+- **Negative fixtures, one per rule** (tests/graphlint_fixtures/
+  serve/fx_flow_*.py): each seeded line carries a ``# VIOLATION:
+  <rule>`` marker; each fixture trips exactly its own rule. The
+  typed-escape fixture reproduces PR 17's ``deque.remove`` untyped
+  ValueError and renders a two-hop propagation chain.
+- **CLI**: exit 1 over the fixture set; ``--rule`` filtering;
+  ``--format json``'s stable rule/file/line/chain shape; ``--format
+  sarif``'s minimal SARIF 2.1.0 log with waived records at level
+  ``note``.
+- **Sweep pins**: the typed narrowings (ServeContractError /
+  UnknownReplicaError), pop-by-index container deletes, the attach
+  pool-state RuntimeError, and the ``decode_kernel_eligible`` sharded
+  explain threading stay fixed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.analysis import (
+    active_violations, run_analysis,
+)
+from distributed_dot_product_tpu.analysis import flowlint
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, 'tests', 'graphlint_fixtures', 'serve')
+
+ESCAPE = os.path.join(FIXTURES, 'fx_flow_escape.py')
+ESCAPE_REL = 'tests/graphlint_fixtures/serve/fx_flow_escape.py'
+
+
+def _expected(path):
+    """``{(rule, line)}`` from the fixture's own ``# VIOLATION: rule``
+    markers — the file annotates its seeded regressions."""
+    out = set()
+    with open(path, encoding='utf-8') as f:
+        for i, line in enumerate(f, 1):
+            if '# VIOLATION:' in line:
+                rule = line.split('# VIOLATION:')[1].strip().split()[0]
+                out.add((rule, i))
+    return out
+
+
+# -- clean-tree gate ----------------------------------------------------
+
+def test_flowlint_clean_tree_gate_zero_debt():
+    """Zero flowlint records repo-wide — including WAIVED ones: every
+    exception escaping a serving root is in the typed contract, every
+    typed handler routes its failure, the RejectReason taxonomy is
+    live, the ownership stride has one home, and none of that rests on
+    a pragma."""
+    violations = run_analysis(rules=list(flowlint.FLOW_RULES),
+                              jaxpr=False)
+    assert violations == [], '\n'.join(v.render() for v in violations)
+
+
+# -- negative fixtures --------------------------------------------------
+
+@pytest.mark.parametrize('fixture', [
+    'fx_flow_escape.py', 'fx_flow_totality.py', 'fx_flow_reason.py',
+    'fx_flow_shard.py',
+])
+def test_rule_catches_fixture(fixture):
+    path = os.path.join(FIXTURES, fixture)
+    violations = flowlint.lint_file(path, repo_root=REPO)
+    active = active_violations(violations)
+    got = {(v.rule, v.line) for v in active}
+    want = _expected(path)
+    assert want == got, (f'{fixture}: expected exactly {sorted(want)}, '
+                         f'got {sorted(got)}')
+    assert all(v.file and v.file.endswith(fixture) for v in violations)
+
+
+def test_each_fixture_trips_exactly_its_rule():
+    """The fixtures are rule-pure: no cross-contamination between the
+    four checkers on any of them."""
+    rule_of = {
+        'fx_flow_escape.py': 'typed-escape',
+        'fx_flow_totality.py': 'handler-totality',
+        'fx_flow_reason.py': 'reason-coverage',
+        'fx_flow_shard.py': 'shard-ownership',
+    }
+    for fixture, rule in rule_of.items():
+        path = os.path.join(FIXTURES, fixture)
+        vs = flowlint.lint_file(path, repo_root=REPO)
+        assert {v.rule for v in vs} == {rule}, (
+            f'{fixture}: {sorted({v.rule for v in vs})}')
+
+
+def test_typed_escape_renders_transitive_chain():
+    """The KeyError escapes Server.step through TWO intermediate
+    frames (step → _drain → _pop_head): the violation anchors at the
+    origin raise and carries the whole chain, rendered in the message
+    as file:line → file:line."""
+    vs = active_violations(flowlint.lint_file(ESCAPE, repo_root=REPO))
+    key = [v for v in vs if 'KeyError' in v.message]
+    assert len(key) == 1, '\n'.join(v.render() for v in vs)
+    v = key[0]
+    assert 'Server.step' in v.message
+    assert v.chain is not None and len(v.chain) == 3, v.chain
+    assert all(h.startswith(f'{ESCAPE_REL}:') for h in v.chain)
+    assert v.chain[-1] == f'{v.file}:{v.line}'   # anchored at origin
+    assert ' → '.join(v.chain) in v.message
+
+
+def test_pr17_deque_remove_shape_is_caught():
+    """The regression fixture reproduces PR 17's drive-found bug —
+    ``deque.remove`` walking ``__eq__`` out of a serving root — and
+    flowlint names both the root and the implicit-ValueError cause."""
+    vs = active_violations(flowlint.lint_file(ESCAPE, repo_root=REPO))
+    hits = [v for v in vs if '.remove()' in v.message]
+    assert len(hits) == 1, '\n'.join(v.render() for v in vs)
+    v = hits[0]
+    assert 'Server.submit' in v.message
+    assert 'ValueError' in v.message
+    assert 'delete by index' in v.message
+
+
+def test_pragma_waiver_stays_visible_as_allowed_record():
+    """``# flowlint: allow[typed-escape]`` waives the site but the
+    record STAYS in the output with ``allowed=True`` — waived
+    failure-flow debt is enumerable, not invisible (and the clean-tree
+    gate above asserts the real tree carries none)."""
+    vs = flowlint.lint_file(ESCAPE, repo_root=REPO)
+    waived = [v for v in vs if v.allowed]
+    assert len(waived) == 1, '\n'.join(v.render() for v in vs)
+    v = waived[0]
+    assert v.rule == 'typed-escape'
+    assert 'IndexError' in v.message and 'run_ok' in v.message
+    assert '(allowed)' in v.render()
+
+
+# -- CLI ----------------------------------------------------------------
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    return subprocess.run(
+        [sys.executable, '-m', 'distributed_dot_product_tpu.analysis',
+         *args], capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=540)
+
+
+def _fx(name):
+    return os.path.join('tests', 'graphlint_fixtures', 'serve', name)
+
+
+def test_cli_nonzero_on_flow_fixtures():
+    res = _cli('--no-jaxpr',
+               _fx('fx_flow_escape.py'), _fx('fx_flow_totality.py'),
+               _fx('fx_flow_reason.py'), _fx('fx_flow_shard.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    for rule in flowlint.FLOW_RULES:
+        assert rule in res.stdout, f'{rule} missing from CLI output'
+
+
+def test_cli_rule_filter_isolates_one_rule():
+    res = _cli('--no-jaxpr', '--rule', 'typed-escape',
+               _fx('fx_flow_escape.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert 'typed-escape' in res.stdout
+    # The same fixture under a non-matching flow rule is clean.
+    res = _cli('--no-jaxpr', '--rule', 'shard-ownership',
+               _fx('fx_flow_escape.py'))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_list_rules_names_flowlint():
+    res = _cli('--list-rules')
+    assert res.returncode == 0
+    for rule in flowlint.FLOW_RULES:
+        assert rule in res.stdout
+
+
+def test_cli_json_shape_carries_chain():
+    """The documented stable JSON shape: every record has rule/file/
+    line/chain keys; typed-escape chains are file:line hop lists
+    ordered root call site → origin raise."""
+    res = _cli('--no-jaxpr', '--format', 'json', '--rule',
+               'typed-escape', _fx('fx_flow_escape.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    records = json.loads(res.stdout)
+    assert records, 'expected typed-escape records'
+    for r in records:
+        assert {'rule', 'file', 'line', 'chain', 'allowed',
+                'message'} <= set(r)
+        assert r['rule'] == 'typed-escape'
+        if r['chain'] is not None:
+            for hop in r['chain']:
+                f, ln = hop.rsplit(':', 1)
+                assert f.endswith('.py') and ln.isdigit(), hop
+    assert any(r['chain'] and len(r['chain']) == 3 for r in records)
+    # The waived site rides along, flagged: debt is enumerable.
+    assert any(r['allowed'] for r in records)
+
+
+def test_cli_sarif_shape():
+    res = _cli('--no-jaxpr', '--format', 'sarif',
+               _fx('fx_flow_escape.py'), _fx('fx_flow_totality.py'),
+               _fx('fx_flow_reason.py'), _fx('fx_flow_shard.py'))
+    assert res.returncode == 1, res.stdout + res.stderr
+    log = json.loads(res.stdout)
+    assert log['version'] == '2.1.0'
+    assert 'sarif-2.1.0' in log['$schema']
+    run = log['runs'][0]
+    driver = run['tool']['driver']
+    assert driver['name'] == 'graphlint'
+    assert set(flowlint.FLOW_RULES) <= {r['id'] for r in
+                                        driver['rules']}
+    results = run['results']
+    assert {r['ruleId'] for r in results} >= set(flowlint.FLOW_RULES)
+    for r in results:
+        assert r['level'] in ('error', 'note')
+        loc = r['locations'][0]['physicalLocation']
+        assert loc['artifactLocation']['uri'].endswith('.py')
+        assert loc['region']['startLine'] >= 1
+    # The pragma-waived escape site downgrades to 'note', not gone.
+    assert any(r['level'] == 'note' and r['ruleId'] == 'typed-escape'
+               for r in results)
+
+
+def test_cli_sarif_empty_run_is_valid():
+    res = _cli('--no-jaxpr', '--format', 'sarif', '--rule',
+               'shard-ownership', _fx('fx_flow_reason.py'))
+    assert res.returncode == 0, res.stdout + res.stderr
+    log = json.loads(res.stdout)
+    assert log['runs'][0]['results'] == []
+
+
+# -- sweep pins: the in-diff fixes stay fixed ---------------------------
+
+def test_typed_narrowings_subclass_the_builtins():
+    """ServeContractError/UnknownReplicaError narrow the caller-
+    contract ValueError/KeyError raises flowlint forced out of the
+    serving surfaces — as SUBCLASSES, so pre-existing catches keep
+    working, and UnknownReplicaError renders without KeyError's
+    repr-quoting."""
+    from distributed_dot_product_tpu.serve import (
+        ServeContractError, UnknownReplicaError,
+    )
+    assert issubclass(ServeContractError, ValueError)
+    assert issubclass(UnknownReplicaError, KeyError)
+    assert str(UnknownReplicaError('no replica named r9')) == \
+        'no replica named r9'
+
+
+def test_run_trace_tick_contract_is_typed():
+    from distributed_dot_product_tpu.serve import (
+        ServeContractError, run_trace,
+    )
+    with pytest.raises(ServeContractError):
+        run_trace(None, [], lambda: 0.0, tick_seconds=0)
+    with pytest.raises(ValueError):    # the pre-narrowing catch shape
+        run_trace(None, [], lambda: 0.0, tick_seconds=-1)
+
+
+def test_scheduler_prefix_contract_is_typed():
+    from distributed_dot_product_tpu.serve import (
+        Scheduler, ServeConfig, ServeContractError,
+    )
+    from distributed_dot_product_tpu.serve.engine import KernelEngine
+    eng = KernelEngine(slots=1, t_max=32, vocab=16, heads=1,
+                       head_dim=8, seed=0)
+    sched = Scheduler(eng, ServeConfig(watchdog=False))
+    with pytest.raises(ServeContractError, match='paged engine'):
+        sched.submit(np.array([1, 2, 3]), prefix_id='p0')
+
+
+def test_replica_pool_unknown_name_is_typed():
+    from distributed_dot_product_tpu.serve import TopologyConfig
+    from distributed_dot_product_tpu.serve.replica import ReplicaPool
+    from distributed_dot_product_tpu.serve import UnknownReplicaError
+    pool = ReplicaPool(TopologyConfig(
+        decode_replicas=2, slots=2, t_max=64, page_size=16, vocab=32,
+        seed=3))
+    try:
+        with pytest.raises(UnknownReplicaError):
+            pool.mark_lost('ghost')
+        with pytest.raises(KeyError):   # subclass: old catches hold
+            pool.remove_replica('ghost')
+        # Pop-by-index delete still works end to end: the member moves
+        # to `lost` and the roster shrinks — no untyped ValueError from
+        # a container .remove walking replica equality.
+        lost = pool.mark_lost('r0')
+        assert lost.name == 'r0'
+        assert [r.name for r in pool.replicas] == ['r1']
+        assert pool.lost == [lost]
+        with pytest.raises(ValueError):
+            pool.remove_replica('r1')   # last member stays refusable
+    finally:
+        pool.close()
+
+
+def test_pagepool_attach_pool_state_is_runtime_error():
+    """attach on a non-empty slot is a pool-state invariant break
+    (reachable from Scheduler.submit via start_with_prefix), typed as
+    RuntimeError — the shard/pool internal-state shape in
+    TYPED_CONTRACT — not a bare ValueError."""
+    from distributed_dot_product_tpu.models.decode import PagePool
+    pool = PagePool(4, 16, 1, 2)
+    pool.counts[0] = 1      # simulate an occupied slot
+    with pytest.raises(RuntimeError, match='empty slot'):
+        pool.attach(0, [0, 1], 16)
+
+
+def test_pagepool_quarantine_free_list_delete_by_index():
+    from distributed_dot_product_tpu.models.decode import PagePool
+    pool = PagePool(4, 16, 1, 2)
+    free_before = set(pool._free)
+    fresh = pool.quarantine([2])
+    assert fresh == [2]
+    assert set(pool._free) == free_before - {2}
+    # Idempotent, and a still-referenced page (left on the free list
+    # for _unref to withhold) cannot raise: there is no .remove to
+    # miss.
+    pool.refcount[1] = 1
+    assert pool.quarantine([2, 1]) == [1]
+    assert 1 in pool.quarantined
+
+
+def test_kernel_eligible_sharded_verify_k_names_the_gate():
+    """The sharded single-token gate shows up in explain() WITH the
+    mesh geometry — the error-text drift fix: the explain string names
+    every gate the code actually tests."""
+    from distributed_dot_product_tpu.models.decode import (
+        decode_kernel_eligible, init_cache,
+    )
+    cache = init_cache(1, 1, 128, 8)
+    ok, reason = decode_kernel_eligible(cache, n=4, explain=True,
+                                        n_shards=2)
+    assert not ok
+    assert 'single-token' in reason and 'n=4' in reason
+    assert 'sequence-sharded' in reason     # geometry prefix
+    # Unsharded verify-k within the K split stays eligible.
+    ok, reason = decode_kernel_eligible(cache, n=4, explain=True)
+    assert ok and reason is None
+
+
+def test_resolve_decode_impl_threads_axis_size_into_probe():
+    """Forced-kernel sharded verify-k fails AT RESOLUTION with the
+    single-token gate named (geometry included) — previously it passed
+    the unsharded probe here and only blew up at the late kernel-path
+    check with no geometry in the error."""
+    from distributed_dot_product_tpu.models.decode import (
+        _axis_env_size, _resolve_decode_impl, init_cache,
+    )
+    assert _axis_env_size(None) == 1
+    # Outside any axis env the count is unknowable: 2 = "sharded" —
+    # every gate keys on n_shards > 1, not the count.
+    assert _axis_env_size('not-a-live-axis') == 2
+    cache = init_cache(1, 1, 128, 8)
+    with pytest.raises(ValueError, match='single-token'):
+        _resolve_decode_impl('kernel', cache, 4, None, None,
+                             axis_name='not-a-live-axis')
+    # The same call unsharded resolves: the gate is the axis, not n.
+    assert _resolve_decode_impl('kernel', cache, 4, None, None) == \
+        'kernel'

@@ -58,14 +58,16 @@ def _negatives_module():
 
 def test_clean_tree_gate(devices):
     """THE gate: zero ACTIVE violations across the package AST scan
-    (astlint + the servelint families) and every registered
-    entrypoint's jaxpr — AND zero WAIVED ``f32-accum`` records. The
-    owned dense (models/dense.py) retired the flax ``linen.Dense``
-    bf16-accumulation debt the bf16 serving-dtype twins used to waive
-    (14 allowed records across three entries); asserting the waiver
-    set EMPTY is what keeps the debt from silently returning — a new
-    ``TraceSpec.allow=('f32-accum',)`` anywhere fails here and must be
-    argued in review, not slipped in as an "allowed" record."""
+    (astlint + the servelint families + flowlint's typed-failure-flow
+    rules) and every registered entrypoint's jaxpr — AND zero WAIVED
+    records of any kind. The owned dense (models/dense.py) retired the
+    flax ``linen.Dense`` bf16-accumulation debt the bf16 serving-dtype
+    twins used to waive (14 allowed records across three entries), and
+    flowlint reports pragma-waived sites as visible ``allowed``
+    records, so this assertion also pins the tree at ZERO
+    ``# flowlint: allow[...]`` waivers; new waived debt of either kind
+    fails here and must be argued in review, not slipped in as an
+    "allowed" record."""
     from distributed_dot_product_tpu.analysis import active_violations
     violations = run_analysis()
     active = active_violations(violations)
