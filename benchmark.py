@@ -1050,6 +1050,33 @@ def run_decode(args):
     return record
 
 
+def _dispatch_split(registry, n_tokens):
+    """Dispatch-floor columns from a scheduler run's registry: the
+    host-dispatch vs device-compute split the scheduler's per-tick
+    accounting observed (``serve.dispatch_overhead_seconds`` /
+    ``serve.device_seconds`` histograms — the same numbers /metrics
+    exports and ``obs critpath`` folds from serve.dispatch events).
+    Empty dict when the scheduler recorded no decode ticks."""
+    h_over = registry.peek('histogram',
+                           'serve.dispatch_overhead_seconds')
+    h_dev = registry.peek('histogram', 'serve.device_seconds')
+    if h_over is None or not h_over.total_count:
+        return {}
+    over_s = h_over.total_sum
+    dev_s = h_dev.total_sum if h_dev is not None else 0.0
+    tick_s = over_s + dev_s
+    return {
+        'dispatch_ticks': h_over.total_count,
+        'dispatch_overhead_s': over_s,
+        'dispatch_device_s': dev_s,
+        'dispatch_overhead_pct': (100.0 * over_s / tick_s
+                                  if tick_s > 0 else None),
+        'dispatch_overhead_ms_per_token': (over_s / n_tokens * 1e3
+                                           if n_tokens else None),
+        'dispatch_overhead_p99_ms': h_over.percentile(99) * 1e3,
+    }
+
+
 def run_decode_serve(args):
     """``--mode decode-serve``: what the continuous-batching scheduler
     COSTS over the bare kernels. Two measurements on the same
@@ -1281,6 +1308,7 @@ def run_decode_serve(args):
         'devices_reporting': registry.gauge(
             'device.memory.devices_reporting').value,
     }
+    record.update(_dispatch_split(registry, n_tok))
     if paged:
         record.update({
             'page_size': page_size, 'pages': pages,
@@ -1296,6 +1324,12 @@ def run_decode_serve(args):
                   f"peak"
                   + (f', kv_shards={kv_shards}' if kv_shards > 1
                      else '') + ')')
+    disp_note = ''
+    if record.get('dispatch_overhead_ms_per_token') is not None:
+        disp_note = (f", dispatch overhead "
+                     f"{record['dispatch_overhead_ms_per_token']:.3f} "
+                     f"ms/tok "
+                     f"({record['dispatch_overhead_pct']:.0f}% of tick)")
     print(f"decode-serve[{impl_resolved}/{args.cache_mode}] "
           f"slots={slots} t_max={t_max} "
           f"req={n_requests}: scheduler {sched_tps:,.0f} tok/s vs bare "
@@ -1303,7 +1337,8 @@ def run_decode_serve(args):
           f"({record['sched_overhead_pct']:.1f}% overhead, "
           f"TTFT {record['ttft_ms']:.1f} ms, "
           f"peak {peak['busy']} concurrent at "
-          f"{kv_budget_bytes / 2**20:.1f} MiB KV{paged_note})")
+          f"{kv_budget_bytes / 2**20:.1f} MiB KV{paged_note}"
+          f"{disp_note})")
     _append_record(args.file, record)
     return record
 
@@ -1878,6 +1913,23 @@ def run_serve_load_topology(args):
                             if controller else []),
         'replicas_final': len(router.pool.replicas),
     }
+    # Dispatch-floor split: the topology's replicas run on separate
+    # registries, so the merged JSONL serve.dispatch stream is the
+    # source of truth here (same numbers `obs critpath` reports).
+    from distributed_dot_product_tpu.obs import critpath as _critpath
+    disp = _critpath.dispatch_floor(sources)
+    if disp['total']['ticks']:
+        tot = disp['total']
+        record['dispatch_ticks'] = tot['ticks']
+        record['dispatch_overhead_s'] = tot['overhead_seconds']
+        record['dispatch_overhead_ms_per_token'] = (
+            None if tot['overhead_per_token'] is None
+            else tot['overhead_per_token'] * 1e3)
+        record['dispatch_per_replica'] = {
+            name: {'ticks': agg['ticks'],
+                   'overhead_s': agg['overhead_seconds'],
+                   'overhead_share': agg['overhead_share']}
+            for name, agg in sorted(disp['per_replica'].items())}
     record.update(chaos_extra)
     record.update(corrupt_extra)
     record.update(prefill_extra)
@@ -2077,6 +2129,12 @@ def run_serve_load(args):
         'devices_reporting': registry.gauge(
             'device.memory.devices_reporting').value,
     }
+    # Dispatch-floor split: host-loop overhead vs device-program time
+    # per decode tick, from the scheduler's histograms on this
+    # registry (REAL seconds — reporting only, never the timeline).
+    tok_c = registry.peek('counter', 'serve.tokens_generated')
+    record.update(_dispatch_split(
+        registry, tok_c.value if tok_c is not None else 0))
     print(f"serve-load[{args.cache_mode}/"
           f"{args.spec}] seed={args.load_seed} "
           f"{cfg.arrival}@{cfg.rate:.0f}/s x{report.requests}: "

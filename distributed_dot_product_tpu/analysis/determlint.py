@@ -94,6 +94,13 @@ REAL_TIME_CONTRACT = {
             '(time.perf_counter) — it never feeds control flow or the '
             'virtual timeline',
     },
+    'serve/router.py': {
+        'Router._handoff':
+            'the prefill.handoff build/transfer split measures the '
+            'REAL cost of KV compute vs page movement '
+            '(time.perf_counter) — reporting-only additive event '
+            'fields, never control flow or the virtual timeline',
+    },
 }
 
 _TIME_FNS = {'time', 'monotonic', 'sleep', 'perf_counter',

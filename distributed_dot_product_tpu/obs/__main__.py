@@ -15,6 +15,10 @@ Offline event-log tooling::
         --against SLO_BASELINE.json [--json]
     python -m distributed_dot_product_tpu.obs doctor BUNDLE
         [BUNDLE...] [--json]
+    python -m distributed_dot_product_tpu.obs critpath LOG
+        [replica=LOG ...] [--json]
+    python -m distributed_dot_product_tpu.obs trace export LOG
+        [replica=LOG ...] -o trace.json
 
 ``validate`` schema-checks every record of each log's rotated set
 against :data:`~distributed_dot_product_tpu.obs.events.EVENT_SCHEMA`
@@ -39,6 +43,19 @@ log against the committed ``SLO_BASELINE.json`` with tolerances (exit 1
 on violation, each naming the metric and tenant) — scripts/ci.sh runs
 it over the seeded serve-load smoke. Multi-replica log sets merge:
 pass several paths, optionally labeled ``replica=path``.
+
+``critpath`` is the latency-attribution observatory (obs/critpath.py):
+per-request causal phase chains (queue / handoff / prefill / decode /
+stall / commit) whose durations PARTITION each request's e2e latency
+exactly, aggregated into per-tenant / per-replica profiles and the
+p99 tail cohorts, plus the dispatch-floor split folded from
+``serve.dispatch`` records — exit 1 when any completed request fails
+the partition check (scripts/smoke_router.sh gates on it).
+
+``trace export`` emits Chrome-trace/Perfetto JSON from the same merged
+sources (obs/trace.py): one process track per replica, one thread per
+slot, phase slices per request, instant markers for faults / preempts /
+anomalies / handoffs — load the file in ``ui.perfetto.dev``.
 
 ``timeline`` prints one request's reconstructed lifecycle; ``--json``
 switches to compact machine-readable output with the FULL event
@@ -123,7 +140,9 @@ def _cmd_validate(args):
 def _cmd_stats(args):
     rc = 0
     reports = []
-    for path in args.logs:
+    parsed, labeled = _parse_labeled(args.logs)
+    multi = labeled or len(parsed) > 1
+    for label, path in parsed:
         if not _log_files(path):
             print(f'{path}: no such log (nor rotated set)',
                   file=sys.stderr)
@@ -151,7 +170,7 @@ def _cmd_stats(args):
                           'bytes': os.path.getsize(fname),
                           'lines': n_lines})
         rep = {
-            'log': path, 'events': len(records),
+            'log': path, 'replica': label, 'events': len(records),
             'wall_span_seconds': span_s,
             'events_per_second': (len(records) / span_s if span_s
                                   else None),
@@ -178,12 +197,45 @@ def _cmd_stats(args):
                                    ('queue_wait', waits),
                                    ('gap', gaps))}
         reports.append(rep)
+    merged = None
+    if multi and reports:
+        # Per-replica breakdown of the MERGED source set: the counts
+        # table keyed by replica label, so a disaggregated run's
+        # router/prefill/replica event mix is visible without opening
+        # each log (before this, merging collapsed the labels away).
+        events = sorted({ev for rep in reports
+                         for ev in rep['by_event']})
+        merged = {
+            'log': '<merged>',
+            'events': sum(rep['events'] for rep in reports),
+            'by_replica': {
+                rep['replica']: {'events': rep['events'],
+                                 'by_event': rep['by_event']}
+                for rep in reports},
+            'event_names': events,
+        }
+        reports.append(merged)
     if args.json:
-        # Always a list — one element per readable log — so consumers
-        # get a stable shape regardless of how many paths were passed.
+        # Always a list — one element per readable log (plus one
+        # trailing '<merged>' per-replica breakdown object when
+        # several / labeled sources were passed) — so consumers get a
+        # stable shape regardless of how many paths were passed.
         print(json.dumps(reports, indent=2, default=str))
         return rc
     for rep in reports:
+        if rep.get('log') == '<merged>':
+            print(f'merged ({len(rep["by_replica"])} replicas, '
+                  f'{rep["events"]} events) — per-replica breakdown:')
+            width = max(len(ev) for ev in rep['event_names']) + 2
+            names = list(rep['by_replica'])
+            print('  ' + ' ' * width
+                  + ' '.join(f'{n:>10}' for n in names))
+            for ev in rep['event_names']:
+                row = ' '.join(
+                    f'{rep["by_replica"][n]["by_event"].get(ev, 0):>10}'
+                    for n in names)
+                print(f'  {ev:<{width}}{row}')
+            continue
         rate = (f'{rep["events_per_second"]:.1f}/s'
                 if rep['events_per_second'] else 'n/a')
         print(f'{rep["log"]}: {rep["events"]} events over '
@@ -271,6 +323,43 @@ def _cmd_doctor(args):
     else:
         print(obs_doctor.render_incident(incident))
     return 0
+
+
+def _cmd_critpath(args):
+    from distributed_dot_product_tpu.obs import critpath as obs_critpath
+    source = _parse_log_args(args.logs)
+    try:
+        chains = obs_critpath.attribute(source)
+        dispatch = obs_critpath.dispatch_floor(source)
+    except (ValueError, OSError) as e:
+        print(f'critpath: unreadable source: {e}', file=sys.stderr)
+        return 1
+    prof = obs_critpath.profile(chains, dispatch=dispatch)
+    if args.json:
+        print(obs_critpath.to_json(prof))
+    else:
+        print(obs_critpath.render_report(prof))
+    # The CI contract: every COMPLETED request's phases partition its
+    # e2e within tolerance (partial chains — torn logs — are reported,
+    # never asserted against).
+    return 1 if prof['partition_failures'] else 0
+
+
+def _cmd_trace_export(args):
+    from distributed_dot_product_tpu.obs import trace as obs_trace
+    source = _parse_log_args(args.logs)
+    try:
+        trace = obs_trace.write_trace(source, args.out)
+    except (ValueError, OSError) as e:
+        print(f'trace export: {e}', file=sys.stderr)
+        return 1
+    errors = obs_trace.validate_trace(trace)
+    for err in errors:
+        print(f'trace export: INVALID: {err}', file=sys.stderr)
+    n = len(trace['traceEvents'])
+    print(f'{args.out}: {n} trace events '
+          f'({"OK" if not errors else "INVALID"})')
+    return 1 if errors else 0
 
 
 def _cmd_timeline(args):
@@ -366,6 +455,35 @@ def main(argv=None):
     d.add_argument('--json', action='store_true',
                    help='machine-readable incident object')
     d.set_defaults(fn=_cmd_doctor)
+
+    cp = sub.add_parser(
+        'critpath',
+        help='critical-path latency attribution: per-request phase '
+             'chains (queue/handoff/prefill/decode/stall/commit) that '
+             'partition e2e exactly, aggregated per tenant/replica, '
+             'plus the dispatch-floor split (exit 1 when any '
+             'completed request fails the partition check)')
+    cp.add_argument('logs', nargs='+',
+                    help='log path(s); several merge as replicas '
+                         '(optionally labeled replica=path)')
+    cp.add_argument('--json', action='store_true',
+                    help='machine-readable profile object')
+    cp.set_defaults(fn=_cmd_critpath)
+
+    tr = sub.add_parser(
+        'trace', help='Chrome-trace / Perfetto export of the event log')
+    tr_sub = tr.add_subparsers(dest='trace_cmd', required=True)
+    te = tr_sub.add_parser(
+        'export',
+        help='emit Chrome-trace JSON: one track per replica/slot, '
+             'phase slices per request, instant markers for faults/'
+             'preempts/anomalies/handoffs (load in ui.perfetto.dev)')
+    te.add_argument('logs', nargs='+',
+                    help='log path(s); several merge as replicas '
+                         '(optionally labeled replica=path)')
+    te.add_argument('-o', '--out', required=True,
+                    help='output trace JSON path')
+    te.set_defaults(fn=_cmd_trace_export)
 
     t = sub.add_parser('timeline', help='print one request lifecycle')
     t.add_argument('log')

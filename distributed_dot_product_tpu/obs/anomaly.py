@@ -342,6 +342,11 @@ def default_watches(*, queue_limit=None, paged=False,
     - ``kv_corrupt``: static threshold on the router's corruption
       counter — ANY checksum-failed page chains a flight dump (the
       post-mortem bundle is how the doctor attributes the verdict).
+    - ``dispatch_overhead_p99``: EWMA z-score on the
+      ``serve.dispatch_overhead_seconds`` reservoir p99 — a
+      dispatch-floor regression (host loop suddenly eating more of
+      each decode tick) auto-captures a flight bundle carrying the
+      critpath summary that names where the time went.
     """
     watches = [
         Watch(name='ttft_p99', metric='serve.ttft_seconds',
@@ -361,6 +366,10 @@ def default_watches(*, queue_limit=None, paged=False,
         Watch(name='kv_corrupt', metric='router.kv_corrupt',
               signal='counter', detector=StaticThreshold(above=0),
               cooldown=cooldown, actions=('dump',)),
+        Watch(name='dispatch_overhead_p99',
+              metric='serve.dispatch_overhead_seconds', signal='p99',
+              detector=EwmaZScore(z=ttft_z), cooldown=cooldown,
+              actions=('dump',)),
     ]
     if paged:
         watches.append(

@@ -343,6 +343,37 @@ def diagnose(bundle) -> Incident:
             vote('cache_exhaustion', 0.5,
                  f'anomaly detector tripped on {watch}')
 
+    # -- critpath section: where the window's time actually went --------
+    # The flight recorder's stock provider (obs/flight.py) embeds the
+    # ring's critical-path summary; the dominant phase is evidence in
+    # its own right (queue-dominant windows are overload, stall-
+    # dominant ones point at the pool's preempt/requeue churn) and the
+    # verdict names it either way.
+    crit = (bundle.get('sections') or {}).get('critpath') or {}
+    crit_phases = crit.get('phases') or {}
+    if crit_phases:
+        dominant = max(crit_phases, key=crit_phases.get)
+        total_s = sum(crit_phases.values()) or 1.0
+        share = 100.0 * crit_phases[dominant] / total_s
+        notes.append(
+            f'critpath: dominant phase of the incident window is '
+            f'{dominant!r} ({share:.0f}% of the attributed time over '
+            f'{crit.get("requests", 0)} request(s))')
+        if dominant == 'queue':
+            vote('overload', 1.0,
+                 f'critpath: queue is the dominant phase '
+                 f'({share:.0f}% of attributed time)')
+        elif dominant == 'stall':
+            vote('cache_exhaustion', 1.0,
+                 f'critpath: requeue stalls dominate '
+                 f'({share:.0f}% of attributed time)')
+        disp = (crit.get('dispatch') or {}).get('total') or {}
+        if disp.get('overhead_per_token') is not None:
+            notes.append(
+                f'critpath: host dispatch overhead '
+                f'{disp["overhead_per_token"] * 1e3:.3f} ms/token '
+                f'over {disp.get("ticks", 0)} decode tick(s)')
+
     ranked = sorted(CLASSES,
                     key=lambda c: (-scores[c]['score'],
                                    CLASSES.index(c)))

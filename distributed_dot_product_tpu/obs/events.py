@@ -169,6 +169,16 @@ EVENT_SCHEMA = {
     # `perf check` found a per-entry tolerance violation against the
     # committed baseline (entry = registry name, metric = which gate).
     'perf.regression': ('entry', 'metric'),
+    # Dispatch-floor accounting: one record per decode tick that ran a
+    # device program. `tick_seconds` is the REAL wall time of the whole
+    # scheduler tick body, `device_seconds` the slice spent inside
+    # compiled-program invocations (engine.program_seconds delta), so
+    # `overhead = tick_seconds - device_seconds` is the host-loop share
+    # ROADMAP item 5 targets. `tokens` counts tokens committed by the
+    # tick. Carries NO request_id: the floor is a per-tick property of
+    # the loop, not of any one stream — timeline reconstruction skips
+    # it, `obs critpath` aggregates it into the dispatch-floor section.
+    'serve.dispatch': ('step', 'tick_seconds', 'device_seconds'),
     # -- incident layer (obs/anomaly.py, obs/flight.py) ----------------
     # An online detector flagged a metric stream: `metric` is the
     # registry family watched, `detector` the detector class that

@@ -446,6 +446,32 @@ def remove_provider(name, fn=None):
         _PROVIDERS.pop(name, None)
 
 
+def _critpath_section():
+    """Stock provider: the critpath summary of the requests in the
+    ring's event window — every post-mortem bundle answers "where was
+    the time going when this happened" without the operator replaying
+    the full log (``obs doctor`` names the dominant phase from this
+    section). Reads the installed recorder's ring under its lock; an
+    empty/absent ring yields an empty summary, never an error."""
+    rec = _RECORDER
+    if rec is None:
+        return {'requests': 0, 'complete': 0, 'partial': 0,
+                'partition_failures': [], 'phases': {}}
+    with rec._lock:
+        lines = [line for kind, line in rec._ring if kind == 'event']
+    records = []
+    for line in lines:
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue            # torn tee line: skip, never block a dump
+    from distributed_dot_product_tpu.obs import critpath as obs_critpath
+    return obs_critpath.summarize_records(records)
+
+
+add_provider('critpath', _critpath_section)
+
+
 def get_recorder() -> Optional[FlightRecorder]:
     return _RECORDER
 

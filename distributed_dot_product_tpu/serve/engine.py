@@ -316,6 +316,14 @@ class KernelEngine:
             self.cache = init_slot_cache(slots, heads, t_max, head_dim,
                                          dtype=dtype)
         self.verify_seconds = 0.0   # host wall time spent digesting
+        # Dispatch-floor accounting (ROADMAP item 5): cumulative REAL
+        # wall seconds spent INSIDE compiled-program invocations
+        # (decode / verify / prefill / rollback). The scheduler diffs
+        # this across a tick to split tick wall time into device
+        # compute vs host-loop overhead (serve.dispatch events,
+        # serve.dispatch_overhead_seconds histogram). Monotone
+        # counter, never reset — consumers take deltas.
+        self.program_seconds = 0.0
         # Donated caches: appends write in place — see models/decode.py's
         # performance note. One compiled program each for the lifetime —
         # and the retrace sentinel (analysis/retrace.py) enforces it:
@@ -634,12 +642,19 @@ class KernelEngine:
         ids = (tuple(r for r in (request_ids or ()) if r)
                if obs_spans.enabled() else ())
         with span('engine.decode_step', requests=ids):
+            # Timed through the host round-trip (np.asarray blocks on
+            # the async dispatch) — program_seconds measures the wall
+            # time the loop actually waits on the device, the quantity
+            # the dispatch-floor split subtracts from tick time.
+            t0 = time.perf_counter()
             self.cache, tok, finite = self._decode(
                 self.cache, jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(active, bool), jnp.asarray(poison))
+            out = np.asarray(tok), np.asarray(finite)
+            self.program_seconds += time.perf_counter() - t0
             if self.cache_mode == 'paged':
                 self.pool.lengths[np.asarray(active, bool)] += 1
-            return np.asarray(tok), np.asarray(finite)
+            return out
 
     def _verify_program(self, w):
         """One compiled verify program per width W = k+1, built lazily
@@ -701,13 +716,16 @@ class KernelEngine:
         ids = (tuple(r for r in (request_ids or ()) if r)
                if obs_spans.enabled() else ())
         with span('engine.verify_step', requests=ids, width=w):
+            t0 = time.perf_counter()
             self.cache, tok, finite = self._verify_program(w)(
                 self.cache, jnp.asarray(tokens),
                 jnp.asarray(counts, jnp.int32), jnp.asarray(act),
                 jnp.asarray(poison))
+            out = np.asarray(tok), np.asarray(finite)
+            self.program_seconds += time.perf_counter() - t0
             if self.cache_mode == 'paged':
                 self.pool.lengths[act] += counts[act]
-            return np.asarray(tok), np.asarray(finite)
+            return out
 
     def _rollback_program(self, span_rows):
         prog = self._rollbacks.get(span_rows)
@@ -764,8 +782,10 @@ class KernelEngine:
             return
         bucket = 1 << (need - 1).bit_length()
         with span('engine.rollback', rows=need):
+            t0 = time.perf_counter()
             self.cache = self._rollback_program(bucket)(
                 self.cache, jnp.asarray(new, jnp.int32))
+            self.program_seconds += time.perf_counter() - t0
         if self.cache_mode == 'paged':
             if self.kv_shards > 1:
                 freed = {}
@@ -810,8 +830,10 @@ class KernelEngine:
             self._sync_page_table()
         with span('engine.prefill', slot=int(slot),
                   request=request_id or ''):
+            t0 = time.perf_counter()
             self.cache = self._prefill(self.cache, jnp.int32(slot),
                                        jnp.asarray(buf), jnp.int32(n))
+            self.program_seconds += time.perf_counter() - t0
         if self.cache_mode == 'paged':
             self.pool.lengths[slot] += n
 

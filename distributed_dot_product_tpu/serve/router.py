@@ -288,13 +288,17 @@ class Router:
             # prompt to the replica, whose admission produces the same
             # typed PROMPT_TOO_LONG reject the non-routed path records
             # — the offload must never turn a shed into a crash.
+            t0 = time.perf_counter()
             handle = prefill.build(np.asarray(key, np.int32))
+            build_s = time.perf_counter() - t0
         except (RuntimeError, ValueError):
             return None
         try:
+            t0 = time.perf_counter()
             pid = replica.engine.adopt_prefix(
                 prefill.engine.cache, handle.pages, handle.length,
                 src_checksums=prefill.engine.checksums)
+            transfer_s = time.perf_counter() - t0
         except PageCorruptionError as exc:
             if exc.site == 'handoff_src':
                 # The flip landed in the PREFILL pool's staging pages
@@ -332,9 +336,15 @@ class Router:
         self._c_handoff_pages.inc(needed)
         shard_extra = ({'kv_shards': replica.engine.kv_shards}
                        if replica.engine.kv_shards > 1 else {})
+        # build/transfer split (REAL seconds, additive fields): how
+        # the handoff's wall cost divides between computing the KV in
+        # the prefill pool and moving the pages to the replica — the
+        # communication-vs-compute trade the paper is about, now a
+        # per-handoff record `obs critpath` folds into phase profiles.
         self._emit('prefill.handoff', _log=prefill.event_log,
                    request_id=rid, target=replica.name, pages=needed,
-                   rows=rows, tenant=tenant, **shard_extra)
+                   rows=rows, tenant=tenant, build_seconds=build_s,
+                   transfer_seconds=transfer_s, **shard_extra)
         return pid
 
     def _shed_no_replica(self, rid, tenant):

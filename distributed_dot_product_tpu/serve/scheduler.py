@@ -281,6 +281,20 @@ class Scheduler:
                 'serve.cache.request_pages', buckets=())
         self._c_profile = reg.counter('serve.profile_triggers')
         self._h_step = reg.histogram('serve.step_seconds')
+        # Dispatch-floor split (ROADMAP item 5): per decode tick, REAL
+        # tick wall time partitions into device-program seconds (the
+        # engine.program_seconds delta across the tick) and host-loop
+        # overhead — the ~0.212 ms/step floor multi-tick decode would
+        # attack. Mirrored per tick into serve.dispatch events so the
+        # split survives in the JSONL (obs critpath reads it back).
+        self._h_device = reg.histogram('serve.device_seconds')
+        self._h_dispatch = reg.histogram(
+            'serve.dispatch_overhead_seconds')
+        # Device seconds of the CURRENT tick's decode/verify dispatch,
+        # set right after the program returns and cleared at tick end —
+        # _commit_token stamps it on every serve.decode it emits (the
+        # per-token device share, additive field).
+        self._tick_device = None
         # Speculative decoding: an explicit `proposer` object wins,
         # else cfg.spec names a built-in, else the DDP_TPU_SPEC env
         # knob (the smoke/CI hook). A spec tick runs the fused
@@ -536,7 +550,8 @@ class Scheduler:
             # never a retire (it never held a slot).
             self._emit('serve.reject', request_id=req.id,
                        reason=reason.value if reason else None,
-                       queued=True, tenant=req.tenant)
+                       queued=True, total_seconds=total,
+                       tenant=req.tenant)
         else:
             self._emit('serve.retire', request_id=req.id, status=status,
                        reason=reason.value if reason else None,
@@ -978,6 +993,11 @@ class Scheduler:
                               req.tenant).observe(gap)
             token_fields['gap'] = gap
         slot.last_token_at = now
+        if self._tick_device is not None:
+            # Device share of the dispatch this token rode (REAL
+            # seconds, the whole batch's program — per-token division
+            # is the reader's policy choice, not the log's).
+            token_fields['device_seconds'] = self._tick_device
         self._emit('serve.decode', **token_fields)
         if req.cancelled or (
                 self.injector is not None
@@ -1056,8 +1076,10 @@ class Scheduler:
             tokens[slot.index, 0] = slot.input_token
             tokens[slot.index, 1:1 + len(p)] = p
             counts[slot.index] = 1 + len(p)
+        dev0 = eng.program_seconds
         toks, finite = eng.verify_step(tokens, counts, active, poison,
                                        request_ids=request_ids)
+        self._tick_device = eng.program_seconds - dev0
         self.health.beat()   # the step returned: not stuck
         self._c['decode_steps'].inc()
         now = self.clock()
@@ -1246,6 +1268,14 @@ class Scheduler:
             raise
 
     def _step_impl(self) -> bool:
+        # Dispatch-floor anchors: REAL tick start and the engine's
+        # cumulative program-seconds odometer. Ticks that run a decode
+        # dispatch close the loop at the bottom of this method —
+        # tick wall time minus the program delta IS the host overhead.
+        tick_t0 = time.perf_counter()
+        dev_anchor = self.engine.program_seconds
+        toks_anchor = self._c['tokens_generated'].value
+        ran_decode = False
         now = self.clock()
         self.health.beat()
         self._admit_into_free_slots()
@@ -1314,6 +1344,7 @@ class Scheduler:
             if self._proposer is not None:
                 lens = self.engine.lengths()
                 props = self._propose(lens)
+            ran_decode = True
             t0 = time.perf_counter()
             if props:
                 with span('serve.decode_step', step=self._step_idx,
@@ -1324,10 +1355,12 @@ class Scheduler:
             else:
                 tokens_in = np.array(
                     [s.input_token for s in self._slots], np.int32)
+                dev0 = self.engine.program_seconds
                 with span('serve.decode_step', step=self._step_idx):
                     toks, finite = self.engine.step(
                         tokens_in, active, poison,
                         request_ids=request_ids)
+                self._tick_device = self.engine.program_seconds - dev0
                 self._h_step.observe(time.perf_counter() - t0)
                 self.health.beat()   # the step returned: not stuck
                 self._c['decode_steps'].inc()
@@ -1376,6 +1409,24 @@ class Scheduler:
                 tracing.log_exception('scheduler.anomaly_tick', e,
                                       registry=self.registry)
         self._update_readiness()
+        if ran_decode:
+            # Close the dispatch-floor loop for this tick: the REAL
+            # wall time the whole tick body took vs the slice spent
+            # inside compiled programs (prefill chunks included — they
+            # are device work this tick paid for). Emitted per tick,
+            # not per token: the floor is a loop property; critpath
+            # divides by tokens when it reports per-token overhead.
+            tick_s = time.perf_counter() - tick_t0
+            dev_s = max(0.0, self.engine.program_seconds - dev_anchor)
+            overhead = max(0.0, tick_s - dev_s)
+            self._h_device.observe(dev_s)
+            self._h_dispatch.observe(overhead)
+            self._emit('serve.dispatch', step=self._step_idx - 1,
+                       tick_seconds=tick_s, device_seconds=dev_s,
+                       overhead=overhead,
+                       tokens=self._c['tokens_generated'].value
+                       - toks_anchor)
+        self._tick_device = None
         if self.on_tick is not None:
             self.on_tick(self)
         return bool(self.admission.depth) or any(

@@ -249,10 +249,18 @@ def test_broken_detector_is_contained():
 def test_default_watches_catalog():
     watches = default_watches(queue_limit=8, paged=True)
     names = {w.name for w in watches}
-    assert names == {'ttft_p99', 'tokens_per_s', 'queue_depth',
-                     'reject_rate', 'pages_free', 'kv_corrupt'}
+    assert names == {'ttft_p99', 'dispatch_overhead_p99',
+                     'tokens_per_s', 'queue_depth', 'reject_rate',
+                     'pages_free', 'kv_corrupt'}
     by_name = {w.name: w for w in watches}
     assert by_name['ttft_p99'].actions == ('profile', 'dump')
+    # Dispatch-floor watch: a host-loop stall chains a post-mortem
+    # dump (no profile — the overhead spike IS host-side already).
+    assert by_name['dispatch_overhead_p99'].metric == \
+        'serve.dispatch_overhead_seconds'
+    assert by_name['dispatch_overhead_p99'].actions == ('dump',)
+    assert isinstance(by_name['dispatch_overhead_p99'].detector,
+                      EwmaZScore)
     assert isinstance(by_name['queue_depth'].detector, StaticThreshold)
     assert by_name['queue_depth'].detector.above == pytest.approx(7.2)
     assert isinstance(by_name['pages_free'].detector, StaticThreshold)

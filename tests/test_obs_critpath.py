@@ -1,0 +1,409 @@
+# -*- coding: utf-8 -*-
+"""
+Critical-path latency attribution (obs/critpath.py): every request's
+causal phase chain reconstructs from the merged JSONL alone with the
+phases PARTITIONING its e2e latency exactly (virtual clock → exact to
+float rounding), across the hard arcs — ring-decode (`kv_shards`)
+scheduler runs, preempt→requeue stalls, typed rejects — plus merge
+determinism when three sources tie on `ts`, and the dispatch-floor
+fold over `serve.dispatch` records.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.obs import critpath
+from distributed_dot_product_tpu.obs.critpath import (
+    PARTITION_TOL, PHASES, attribute, dispatch_floor, profile,
+    render_report, summarize_records,
+)
+from distributed_dot_product_tpu.obs.events import (
+    EventLog, merge_events, validate_file,
+)
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, Scheduler, ServeConfig, VirtualClock,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+VOCAB = 16
+
+
+def _sched(tmp_path, name='serve.jsonl', *, tick_dt=0.01, slots=2,
+           t_max=32, engine_kw=None, **cfg_kw):
+    """A virtual-clock scheduler with an attached event log — the
+    clock drives BOTH the log ts and the latency stamps, so the
+    partition check is exact, not approximate."""
+    clock = VirtualClock()
+    log = EventLog(tmp_path / name, clock=clock)
+    cfg_kw.setdefault('queue_limit', 8)
+    cfg_kw.setdefault('max_new_tokens', 6)
+    eng_kw = dict(heads=2, head_dim=4, prefill_chunk=4, seed=5,
+                  decode_impl='xla')
+    eng_kw.update(engine_kw or {})
+    eng = KernelEngine(slots=slots, t_max=t_max, vocab=VOCAB, **eng_kw)
+    sched = Scheduler(eng, ServeConfig(watchdog=False, **cfg_kw),
+                      clock=clock, registry=MetricsRegistry(),
+                      fault_injector=False, event_log=log,
+                      on_tick=lambda s: clock.advance(tick_dt))
+    return sched, clock, log
+
+
+def _assert_partitions(chains):
+    """The module's headline contract, asserted chain by chain."""
+    anchored = [c for c in chains.values() if not c.partial]
+    assert anchored, 'no chain carried an e2e anchor'
+    for c in anchored:
+        assert c.ok, (c.request_id, c.errors, c.partition_error)
+        assert c.partition_error <= PARTITION_TOL, (
+            f'{c.request_id}: sum(phases)={sum(c.phases.values())} '
+            f'!= e2e={c.e2e}')
+        # Segments are adjacent and cover [submit_ts, terminal_ts].
+        for (_, s0, e0), (_, s1, _) in zip(c.segments, c.segments[1:]):
+            assert e0 == s1, f'{c.request_id}: gap {e0} -> {s1}'
+        if c.segments:
+            assert c.segments[0][1] == pytest.approx(c.submit_ts)
+        assert set(c.phases) <= set(PHASES)
+
+
+# -- synthetic arcs: the attribution state machine in isolation ---------
+
+def _rec(seq, ts, event, **fields):
+    rec = {'schema': 2, 'seq': seq, 'ts': ts, 'event': event}
+    rec.update(fields)
+    return rec
+
+
+def test_synthetic_chain_partitions_exactly():
+    """Hand-built lifecycle with known phase durations: queue 1s
+    (submit→admit), prefill 2s (admit→first token), decode 3s (the
+    inter-token gap), commit 0.5s — the chain must recover those exact
+    numbers and sum to the stamped total_seconds."""
+    recs = [
+        _rec(0, 11.0, 'serve.admit', request_id='r', slot=0,
+             tenant='t0', queue_wait=1.0),
+        _rec(1, 12.0, 'serve.prefill', request_id='r', slot=0, pos=4),
+        _rec(2, 13.0, 'serve.decode', request_id='r', slot=0,
+             token_index=0),
+        _rec(3, 16.0, 'serve.decode', request_id='r', slot=0,
+             token_index=1),
+        _rec(4, 16.5, 'serve.retire', request_id='r',
+             status='completed', total_seconds=6.5),
+    ]
+    chains = attribute(recs)
+    c = chains['r']
+    assert not c.partial and c.ok
+    assert c.submit_ts == pytest.approx(10.0)
+    assert c.phases == pytest.approx(
+        {'queue': 1.0, 'prefill': 2.0, 'decode': 3.0, 'commit': 0.5})
+    assert c.e2e == 6.5
+    assert c.partition_error <= PARTITION_TOL
+    assert c.tenant == 't0'
+    assert c.tokens == 2
+
+
+def test_synthetic_requeue_stall_attributed():
+    """A preempt(requeued)→re-admit window is a `stall` segment, not
+    queue and not decode — the partition still closes."""
+    recs = [
+        _rec(0, 1.0, 'serve.admit', request_id='r', slot=0,
+             tenant='t'),
+        _rec(1, 2.0, 'serve.decode', request_id='r', slot=0,
+             token_index=0),
+        _rec(2, 3.0, 'serve.preempt', request_id='r', slot=0,
+             requeued=True),
+        _rec(3, 5.0, 'serve.admit', request_id='r', slot=1,
+             tenant='t'),
+        _rec(4, 6.0, 'serve.decode', request_id='r', slot=1,
+             token_index=1),
+        _rec(5, 6.5, 'serve.retire', request_id='r',
+             status='completed', total_seconds=6.0),
+    ]
+    c = attribute(recs)['r']
+    assert c.ok and c.stalls == 1
+    assert c.phases['stall'] == pytest.approx(2.0)   # preempt→re-admit
+    assert c.phases['decode'] == pytest.approx(1.0)
+    # The re-admitted attempt re-prefills before its next token.
+    assert c.phases['prefill'] == pytest.approx(2.0)
+    assert sum(c.phases.values()) == pytest.approx(6.0)
+
+
+def test_synthetic_reject_collapses_to_queue():
+    """A queue-death reject never left the queue: its whole e2e lands
+    in the `queue` phase."""
+    recs = [
+        _rec(0, 4.0, 'serve.reject', request_id='r',
+             reason='deadline_exceeded', tenant='t',
+             total_seconds=3.0),
+    ]
+    c = attribute(recs)['r']
+    assert not c.partial and c.ok
+    assert c.status == 'rejected' and c.reason == 'deadline_exceeded'
+    assert c.phases == pytest.approx({'queue': 3.0})
+
+
+def test_torn_chain_is_partial_never_asserted():
+    """No terminal record → best-effort attribution flagged partial;
+    profile() counts it but excludes it from partition failures."""
+    recs = [
+        _rec(0, 1.0, 'serve.admit', request_id='r', slot=0,
+             tenant='t', queue_wait=0.5),
+        _rec(1, 2.0, 'serve.decode', request_id='r', slot=0,
+             token_index=0),
+    ]
+    c = attribute(recs)['r']
+    assert c.partial and c.e2e is None
+    prof = profile({'r': c})
+    assert prof['partial'] == 1
+    assert prof['partition_failures'] == []
+
+
+def test_handoff_phase_and_real_split():
+    """prefill.handoff cuts its own phase; the REAL build/transfer
+    stamps ride alongside without entering the virtual partition."""
+    recs = [
+        _rec(0, 1.0, 'router.route', request_id='r', target='r0'),
+        _rec(1, 3.0, 'prefill.handoff', request_id='r', target='r0',
+             pages=2, build_seconds=0.25, transfer_seconds=0.125),
+        _rec(2, 4.0, 'serve.admit', request_id='r', slot=0,
+             tenant='t'),
+        _rec(3, 5.0, 'serve.decode', request_id='r', slot=0,
+             token_index=0),
+        _rec(4, 5.5, 'serve.retire', request_id='r',
+             status='completed', total_seconds=5.0),
+    ]
+    c = attribute(recs)['r']
+    assert c.ok
+    # queue = submit→route (0.5) + post-handoff wait for a slot (1.0).
+    assert c.phases['queue'] == pytest.approx(1.5)
+    assert c.phases['handoff'] == pytest.approx(2.0)
+    assert c.phases['prefill'] == pytest.approx(1.0)
+    assert c.handoff_build == pytest.approx(0.25)
+    assert c.handoff_transfer == pytest.approx(0.125)
+    assert sum(c.phases.values()) == pytest.approx(5.0)
+
+
+# -- merge determinism: three sources tying on ts -----------------------
+
+def test_three_source_ts_tie_merge_is_stable(tmp_path):
+    """Records from router/prefill/replica logs sharing identical
+    virtual timestamps must merge in SOURCE order, every run — the
+    attribution is a function of the log set, not of dict/iteration
+    luck."""
+    t = [10.0]
+    clock = lambda: t[0]            # noqa: E731 — frozen clock: ties
+    router = EventLog(tmp_path / 'router.jsonl', clock=clock)
+    prefill = EventLog(tmp_path / 'prefill.jsonl', clock=clock)
+    rep = EventLog(tmp_path / 'r0.jsonl', clock=clock)
+    router.emit('router.route', request_id='x', target='r0')
+    prefill.emit('prefill.handoff', request_id='x', target='r0',
+                 pages=1)
+    rep.emit('serve.admit', request_id='x', slot=0, tenant='t')
+    t[0] = 11.0
+    rep.emit('serve.decode', request_id='x', slot=0, token_index=0)
+    t[0] = 11.5
+    rep.emit('serve.retire', request_id='x', status='completed',
+             total_seconds=1.5)
+    for log in (router, prefill, rep):
+        log.close()
+
+    sources = [('router', router.path), ('prefill', prefill.path),
+               ('r0', rep.path)]
+    merged = merge_events(sources)
+    ties = [r['replica'] for r in merged if r['ts'] == 10.0]
+    assert ties == ['router', 'prefill', 'r0']   # source order, always
+
+    first = attribute(sources)['x']
+    again = attribute(list(sources))['x']
+    assert first.segments == again.segments
+    assert first.ok
+    # The tied records collapse to zero-width segments; the decode and
+    # commit spans carry all the time.
+    assert sum(first.phases.values()) == pytest.approx(1.5)
+    assert first.replicas[-1] == 'r0'
+
+
+# -- real scheduler arcs ------------------------------------------------
+
+def test_scheduler_run_partitions_every_request(tmp_path, devices):
+    sched, clock, log = _sched(tmp_path)
+    for i in range(5):
+        sched.submit(np.asarray([i + 1], np.int32),
+                     request_id=f'r{i}')
+    results = sched.run_until_idle()
+    sched.close()
+    log.close()
+    assert all(r.status == 'completed' for r in results.values())
+    _, errors = validate_file(log.path)
+    assert errors == [], errors
+
+    chains = attribute(log.path)
+    assert set(chains) == {f'r{i}' for i in range(5)}
+    _assert_partitions(chains)
+    prof = profile(chains, dispatch=dispatch_floor(log.path))
+    assert prof['partition_failures'] == []
+    assert prof['phases'].get('decode', 0) > 0
+    assert prof['dispatch']['total']['ticks'] > 0
+    assert 'phase totals' in render_report(prof)
+
+
+def test_preempt_requeue_arc_attributes_stall(tmp_path, devices):
+    """Page-pool exhaustion preempts a stream; its requeue window must
+    land in `stall` and the partition must still close on the ORIGINAL
+    submit anchor (the requeue never resets the clock)."""
+    sched, clock, log = _sched(
+        tmp_path, max_new_tokens=8, max_requeues=6, spec='ngram',
+        spec_k=3, evict_before_reject=False,
+        engine_kw=dict(cache_mode='paged', page_size=2, pages=5),
+        t_max=16)
+    sched.submit([1], request_id='a')
+    sched.submit([2], request_id='b')
+    results = sched.run_until_idle()
+    sched.close()
+    log.close()
+    assert {r.status for r in results.values()} == {'completed'}
+
+    chains = attribute(log.path)
+    _assert_partitions(chains)
+    stalled = [c for c in chains.values() if c.stalls]
+    assert stalled, 'page exhaustion never preempted anyone'
+    for c in stalled:
+        assert c.phases.get('stall', 0) > 0, (
+            'a requeued request must carry stall time')
+
+
+def test_ring_decode_kv_shards_partitions(tmp_path, devices):
+    """ISSUE acceptance: the `kv_shards` ring-decode engine emits the
+    same lifecycle vocabulary — attribution neither knows nor cares
+    that attention ran as a ring, and the partition stays exact."""
+    sched, clock, log = _sched(
+        tmp_path, t_max=64,
+        engine_kw=dict(cache_mode='paged', page_size=16, pages=None,
+                       head_dim=8, kv_shards=2))
+    for i in range(3):
+        sched.submit(((np.arange(6) * 3 + i) % (VOCAB - 1) + 1)
+                     .astype(np.int32), request_id=f'r{i}')
+    results = sched.run_until_idle()
+    sched.close()
+    log.close()
+    assert all(r.status == 'completed' for r in results.values())
+    chains = attribute(log.path)
+    assert len(chains) == 3
+    _assert_partitions(chains)
+    for c in chains.values():
+        assert c.phases.get('decode', 0) > 0
+
+
+# -- dispatch floor + record-list summarizer ----------------------------
+
+def test_dispatch_floor_folds_serve_dispatch(tmp_path, devices):
+    sched, clock, log = _sched(tmp_path)
+    sched.submit(np.asarray([1, 2, 3], np.int32), request_id='r')
+    res = sched.run_until_idle()
+    sched.close()
+    log.close()
+    from distributed_dot_product_tpu.obs.events import read_events
+    recs = read_events(log.path)
+    disp_recs = [r for r in recs if r['event'] == 'serve.dispatch']
+    assert disp_recs, 'no dispatch-floor records on a decode run'
+    for r in disp_recs:
+        # The program slice is timed INSIDE the tick window, so the
+        # tick wall time bounds it (1ns slack for clock granularity).
+        assert 0 <= r['device_seconds'] <= r['tick_seconds'] + 1e-9
+        assert r['overhead'] >= 0
+        assert 'request_id' not in r
+
+    floor = dispatch_floor(log.path)
+    agg = floor['per_replica']['unlabeled']
+    assert agg['ticks'] == len(disp_recs)
+    assert agg['tokens'] == len(res['r'].tokens)
+    assert floor['total']['overhead_per_token'] is not None
+
+    # The ring-style record-list path (flight recorder) agrees.
+    prof = summarize_records(recs)
+    assert prof['requests'] == 1
+    assert prof['partition_failures'] == []
+    assert prof['dispatch']['total']['ticks'] == len(disp_recs)
+
+
+# -- flight-recorder provider + doctor evidence -------------------------
+
+def test_flight_bundle_carries_critpath_section(tmp_path, devices):
+    """Post-mortem bundles must answer 'where was the time going' for
+    the ring's in-window requests, and `obs doctor` must cite the
+    dominant phase as evidence."""
+    from distributed_dot_product_tpu.obs import doctor, flight
+
+    clock = VirtualClock()
+    log = EventLog(tmp_path / 'serve.jsonl', clock=clock)
+    rec = flight.FlightRecorder(base_dir=str(tmp_path),
+                                registry=MetricsRegistry())
+    flight.install(rec)
+    try:
+        sched = Scheduler(
+            KernelEngine(slots=2, t_max=32, vocab=VOCAB, heads=2,
+                         head_dim=4, prefill_chunk=4, seed=5,
+                         decode_impl='xla'),
+            ServeConfig(watchdog=False, queue_limit=8,
+                        max_new_tokens=5),
+            clock=clock, registry=MetricsRegistry(),
+            fault_injector=False, event_log=log,
+            on_tick=lambda s: clock.advance(0.01))
+        for i in range(3):
+            sched.submit(np.asarray([i + 1], np.int32),
+                         request_id=f'r{i}')
+        sched.run_until_idle()
+        sched.close()
+        log.close()
+        path = rec.dump_bundle(trigger='manual', reason='test')
+    finally:
+        flight.install(None)
+
+    crit = json.load(open(os.path.join(path, 'critpath.json')))
+    assert crit['requests'] == 3
+    assert crit['partition_failures'] == []
+    assert crit['dispatch']['total']['ticks'] > 0
+
+    diag = doctor.diagnose(flight.load_bundle(path))
+    notes = [n for n in diag.notes if 'critpath' in n]
+    assert any('dominant phase' in n for n in notes), diag.notes
+    assert any('dispatch overhead' in n for n in notes), diag.notes
+
+
+def test_flight_provider_empty_without_recorder():
+    """The provider never crashes a dump when no recorder is live —
+    it reports an empty summary instead."""
+    from distributed_dot_product_tpu.obs import flight
+
+    section = flight._critpath_section()
+    assert section['requests'] == 0
+    assert section['partition_failures'] == []
+
+
+# -- CLI ----------------------------------------------------------------
+
+def _cli(argv, capsys):
+    from distributed_dot_product_tpu.obs.__main__ import main
+    rc = main(argv)
+    return rc, capsys.readouterr().out
+
+
+def test_cli_critpath_gates_on_partition(tmp_path, capsys, devices):
+    sched, clock, log = _sched(tmp_path)
+    sched.submit(np.asarray([1], np.int32), request_id='r')
+    sched.run_until_idle()
+    sched.close()
+    log.close()
+
+    rc, out = _cli(['critpath', str(log.path)], capsys)
+    assert rc == 0
+    assert 'partition_failures=0' in out
+    rc, out = _cli(['critpath', str(log.path), '--json'], capsys)
+    assert rc == 0
+    prof = json.loads(out)
+    assert prof['requests'] == 1 and not prof['partition_failures']
+    assert prof['dispatch']['total']['ticks'] > 0

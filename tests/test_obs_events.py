@@ -376,3 +376,39 @@ def test_cli_stats_percentiles(tmp_path, capsys):
     rc, out = _cli_main(['stats', str(path), '--percentiles'], capsys)
     assert rc == 0
     assert 'ttft' in out and 'p95=' in out and 'queue_wait' in out
+
+
+def test_cli_stats_merged_per_replica_breakdown(tmp_path, capsys):
+    """`stats` over a labeled multi-replica set appends the merged
+    per-replica event-count breakdown — who actually emitted what —
+    in both renderings."""
+    a = EventLog(tmp_path / 'a.jsonl')
+    b = EventLog(tmp_path / 'b.jsonl')
+    a.emit('serve.admit', request_id='x', slot=0, tenant='t')
+    a.emit('serve.retire', request_id='x', status='completed',
+           total_seconds=0.1)
+    b.emit('serve.admit', request_id='y', slot=0, tenant='t')
+    a.close(), b.close()
+
+    rc, out = _cli_main(['stats', f'r0={a.path}', f'r1={b.path}'],
+                        capsys)
+    assert rc == 0
+    assert 'per-replica breakdown' in out
+    assert 'r0' in out and 'r1' in out
+
+    rc, out = _cli_main(['stats', '--json', f'r0={a.path}',
+                         f'r1={b.path}'], capsys)
+    assert rc == 0
+    reps = json.loads(out)
+    merged = reps[-1]
+    assert merged['log'] == '<merged>'
+    assert merged['events'] == 3
+    assert merged['by_replica']['r0']['by_event'] == {
+        'serve.admit': 1, 'serve.retire': 1}
+    assert merged['by_replica']['r1']['by_event'] == {
+        'serve.admit': 1}
+    # Single unlabeled log: no merged report, shape unchanged.
+    rc, out = _cli_main(['stats', '--json', str(a.path)], capsys)
+    assert rc == 0
+    [only] = json.loads(out)
+    assert only['log'] == str(a.path)

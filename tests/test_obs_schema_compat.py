@@ -1,0 +1,140 @@
+# -*- coding: utf-8 -*-
+"""
+Additive-schema regression (obs/events.py v1/v2 + the dispatch-floor
+fields): the new accounting fields (`serve.dispatch` records,
+`device_seconds` on serve.decode, `build_seconds`/`transfer_seconds`
+on prefill.handoff, `total_seconds` on serve.reject) are ADDITIVE —
+v1 logs (pre-tenancy) and v2 logs written before this change still
+schema-validate, timeline-reconstruct, and critpath-attribute, and
+the new records validate against the same closed vocabulary.
+"""
+
+import json
+
+import pytest
+
+from distributed_dot_product_tpu.obs.critpath import attribute, profile
+from distributed_dot_product_tpu.obs.events import (
+    EVENT_SCHEMA, SCHEMA_VERSION, SUPPORTED_SCHEMAS, validate_file,
+    validate_record,
+)
+from distributed_dot_product_tpu.obs.timeline import reconstruct
+
+pytestmark = pytest.mark.obs
+
+
+def _write(path, recs):
+    with open(path, 'w', encoding='utf-8') as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + '\n')
+    return str(path)
+
+
+def _v1_lifecycle():
+    """A pre-tenancy (schema 1) lifecycle, exactly as an old log wrote
+    it: no `tenant`, no dispatch records, no device stamps."""
+    return [
+        {'schema': 1, 'seq': 0, 'ts': 1.0, 'event': 'serve.admit',
+         'request_id': 'r', 'slot': 0, 'queue_wait': 0.5},
+        {'schema': 1, 'seq': 1, 'ts': 1.5, 'event': 'serve.prefill',
+         'request_id': 'r', 'slot': 0, 'pos': 4},
+        {'schema': 1, 'seq': 2, 'ts': 2.0, 'event': 'serve.decode',
+         'request_id': 'r', 'slot': 0, 'token_index': 0},
+        {'schema': 1, 'seq': 3, 'ts': 3.0, 'event': 'serve.retire',
+         'request_id': 'r', 'status': 'completed',
+         'total_seconds': 2.5},
+    ]
+
+
+def _v2_pre_dispatch():
+    """A schema-2 log written BEFORE dispatch-floor accounting: tenant
+    present, none of the new additive fields."""
+    return [
+        {'schema': 2, 'seq': 0, 'ts': 1.0, 'event': 'serve.admit',
+         'request_id': 'r', 'slot': 0, 'tenant': 'default'},
+        {'schema': 2, 'seq': 1, 'ts': 2.0, 'event': 'serve.decode',
+         'request_id': 'r', 'slot': 0, 'token_index': 0},
+        {'schema': 2, 'seq': 2, 'ts': 2.25, 'event': 'serve.reject',
+         'request_id': 'q', 'reason': 'queue_full',
+         'tenant': 'default'},
+        {'schema': 2, 'seq': 3, 'ts': 3.0, 'event': 'serve.retire',
+         'request_id': 'r', 'status': 'completed',
+         'total_seconds': 2.0},
+    ]
+
+
+def test_v1_log_still_validates_and_reconstructs(tmp_path):
+    path = _write(tmp_path / 'v1.jsonl', _v1_lifecycle())
+    records, errors = validate_file(path)
+    assert errors == [], errors
+    tls = reconstruct(records)
+    assert tls['r'].complete and tls['r'].status == 'completed'
+    # And critpath-attributes: the new module asks nothing of old logs
+    # beyond what they always carried.
+    chains = attribute(path)
+    assert chains['r'].ok
+    assert sum(chains['r'].phases.values()) == pytest.approx(2.5)
+
+
+def test_v2_pre_dispatch_log_still_validates(tmp_path):
+    path = _write(tmp_path / 'v2.jsonl', _v2_pre_dispatch())
+    records, errors = validate_file(path)
+    assert errors == [], errors
+    chains = attribute(path)
+    assert chains['r'].ok
+    # The reject without total_seconds is a PARTIAL chain (old logs
+    # did not stamp it) — attributed best-effort, never asserted.
+    assert chains['q'].partial
+    prof = profile(chains)
+    assert prof['partition_failures'] == []
+
+
+def test_v1_tenant_exemption_is_versioned():
+    """`tenant` is required at v2, exempt at v1 — the exemption must
+    key on the RECORD's version, not the writer's."""
+    v1 = {'schema': 1, 'seq': 0, 'ts': 1.0, 'event': 'serve.admit',
+          'request_id': 'r', 'slot': 0}
+    assert validate_record(v1) == []
+    v2 = dict(v1, schema=2)
+    assert any('tenant' in e for e in validate_record(v2))
+
+
+def test_dispatch_event_is_in_the_closed_vocabulary():
+    assert 'serve.dispatch' in EVENT_SCHEMA
+    rec = {'schema': SCHEMA_VERSION, 'seq': 0, 'ts': 1.0,
+           'event': 'serve.dispatch', 'step': 3,
+           'tick_seconds': 0.01, 'device_seconds': 0.004,
+           'overhead': 0.006, 'tokens': 2}
+    assert validate_record(rec) == []
+    # Required fields enforced.
+    missing = {k: v for k, v in rec.items() if k != 'device_seconds'}
+    assert any('device_seconds' in e for e in validate_record(missing))
+
+
+def test_additive_fields_need_no_schema_bump(tmp_path):
+    """The new stamps ride as EXTRA fields on existing events — the
+    schema version did not move, and both supported versions accept
+    records with or without them."""
+    assert SCHEMA_VERSION == 2
+    assert SUPPORTED_SCHEMAS == (1, 2)
+    recs = _v2_pre_dispatch()
+    # The same events as a fresh log writes them, stamps included.
+    recs[1] = dict(recs[1], device_seconds=0.004)
+    recs[2] = dict(recs[2], total_seconds=0.25, queued=True)
+    path = _write(tmp_path / 'new.jsonl', recs)
+    records, errors = validate_file(path)
+    assert errors == [], errors
+    chains = attribute(path)
+    assert chains['r'].ok
+    # The stamped reject now anchors: its whole e2e is queue time.
+    assert not chains['q'].partial
+    assert chains['q'].phases == pytest.approx({'queue': 0.25})
+
+
+def test_handoff_split_fields_are_optional(tmp_path):
+    base = {'schema': 2, 'seq': 0, 'ts': 1.0,
+            'event': 'prefill.handoff', 'request_id': 'r',
+            'target': 'r0', 'pages': 2}
+    assert validate_record(base) == []
+    assert validate_record(dict(base, build_seconds=0.1,
+                                transfer_seconds=0.05)) == []

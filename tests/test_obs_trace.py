@@ -1,0 +1,193 @@
+# -*- coding: utf-8 -*-
+"""
+Chrome-trace / Perfetto export (obs/trace.py): a real scheduler run
+(and a faulted one) exports to schema-valid Chrome Trace Event JSON —
+phase slices partitioning each request's lane, instant markers for the
+discrete incidents (faults, preempts, quarantines, handoffs), one
+process track per replica label, per-track monotone timestamps — and
+the validator actually rejects the malformed shapes CI gates on.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_dot_product_tpu.obs.events import EventLog
+from distributed_dot_product_tpu.obs.trace import (
+    INSTANT_EVENTS, export_trace, validate_trace, write_trace,
+)
+from distributed_dot_product_tpu.serve import (
+    KernelEngine, Scheduler, ServeConfig, VirtualClock,
+)
+from distributed_dot_product_tpu.utils.faults import (
+    ServeFaultInjector, ServeFaultPlan,
+)
+from distributed_dot_product_tpu.utils.tracing import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+VOCAB = 16
+
+
+def _run(tmp_path, *, injector=None, engine_kw=None, **cfg_kw):
+    clock = VirtualClock()
+    log = EventLog(tmp_path / 'serve.jsonl', clock=clock)
+    cfg_kw.setdefault('queue_limit', 8)
+    cfg_kw.setdefault('max_new_tokens', 5)
+    engine_kw = dict(engine_kw or {})
+    engine_kw.setdefault('t_max', 32)
+    sched = Scheduler(
+        KernelEngine(slots=2, vocab=VOCAB, heads=2,
+                     head_dim=4, prefill_chunk=4, seed=5,
+                     decode_impl='xla', **engine_kw),
+        ServeConfig(watchdog=False, **cfg_kw), clock=clock,
+        registry=MetricsRegistry(),
+        fault_injector=injector if injector is not None else False,
+        event_log=log, on_tick=lambda s: clock.advance(0.01))
+    for i in range(4):
+        sched.submit(np.asarray([i + 1], np.int32),
+                     request_id=f'r{i}')
+    results = sched.run_until_idle()
+    sched.close()
+    log.close()
+    return log.path, results
+
+
+def test_export_is_valid_and_carries_phase_slices(tmp_path, devices):
+    path, results = _run(tmp_path)
+    trace = export_trace(path)
+    assert validate_trace(trace) == []
+    evs = trace['traceEvents']
+    slices = [e for e in evs if e['ph'] == 'X']
+    assert slices, 'no phase slices'
+    # Every completed request owns decode slices; args name it.
+    rids = {e['args']['request_id'] for e in slices}
+    assert rids == set(results)
+    assert all(e['dur'] >= 0 for e in slices)
+    # Rebased microsecond timestamps: earliest record at ts 0.
+    assert min(e['ts'] for e in evs if e['ph'] != 'M') == 0.0
+    # One metadata record names each process track.
+    metas = [e for e in evs if e['ph'] == 'M']
+    assert metas and metas[0]['name'] == 'process_name'
+
+
+def test_faulted_run_gets_instant_markers(tmp_path, devices):
+    """NaN quarantine + fault injection render as 'i' markers on the
+    victim's track — the incidents an operator scrubs for."""
+    plan = ServeFaultPlan(nan_at_step=2, nan_slot=0)
+    path, _ = _run(tmp_path,
+                   injector=ServeFaultInjector(plan))
+    trace = export_trace(path)
+    assert validate_trace(trace) == []
+    marks = [e for e in trace['traceEvents'] if e['ph'] == 'i']
+    names = {e['name'] for e in marks}
+    assert 'fault' in names, names
+    assert 'quarantine' in names, names
+    for e in marks:
+        assert e['s'] in ('t', 'p')
+        assert e['args']['event'] in INSTANT_EVENTS
+
+
+def test_preempt_marker_on_paged_exhaustion(tmp_path, devices):
+    path, _ = _run(tmp_path, max_new_tokens=8, max_requeues=6,
+                   spec='ngram', spec_k=3, evict_before_reject=False,
+                   engine_kw=dict(cache_mode='paged', page_size=2,
+                                  pages=5, t_max=16))
+    trace = export_trace(path)
+    assert validate_trace(trace) == []
+    marks = {e['name'] for e in trace['traceEvents']
+             if e['ph'] == 'i'}
+    assert 'preempt' in marks, marks
+    # The requeue arc also renders its stall slice.
+    assert any(e['ph'] == 'X' and e['name'] == 'stall'
+               for e in trace['traceEvents'])
+
+
+def test_multi_source_tracks_one_pid_per_replica(tmp_path):
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    a = EventLog(tmp_path / 'a.jsonl', clock=clock)
+    b = EventLog(tmp_path / 'b.jsonl', clock=clock)
+    a.emit('serve.admit', request_id='x', slot=0, tenant='t')
+    a.emit('serve.decode', request_id='x', slot=0, token_index=0)
+    a.emit('serve.retire', request_id='x', status='completed',
+           total_seconds=2.5)
+    b.emit('serve.admit', request_id='y', slot=1, tenant='t')
+    b.emit('serve.retire', request_id='y', status='completed',
+           total_seconds=1.0)
+    a.close(), b.close()
+
+    trace = export_trace([('r0', a.path), ('r1', b.path)])
+    assert validate_trace(trace) == []
+    evs = trace['traceEvents']
+    names = {e['args']['name'] for e in evs if e['ph'] == 'M'}
+    assert names == {'r0', 'r1'}
+    pids = {e['args']['name']: e['pid'] for e in evs
+            if e['ph'] == 'M'}
+    xs = [e for e in evs if e['ph'] == 'X']
+    assert {e['pid'] for e in xs if e['args']['request_id'] == 'x'} \
+        == {pids['r0']}
+    assert {e['pid'] for e in xs if e['args']['request_id'] == 'y'} \
+        == {pids['r1']}
+
+
+def test_write_trace_round_trips(tmp_path, devices):
+    path, _ = _run(tmp_path)
+    out = tmp_path / 'trace.json'
+    trace = write_trace(path, out)
+    on_disk = json.loads(out.read_text())
+    assert on_disk == json.loads(json.dumps(trace))
+    assert validate_trace(on_disk) == []
+    assert on_disk['displayTimeUnit'] == 'ms'
+
+
+def test_validator_rejects_malformed_traces():
+    ok = {'traceEvents': [
+        {'name': 'a', 'ph': 'X', 'ts': 0.0, 'dur': 1.0,
+         'pid': 1, 'tid': 0},
+        {'name': 'b', 'ph': 'i', 'ts': 2.0, 'pid': 1, 'tid': 0},
+    ]}
+    assert validate_trace(ok) == []
+    [err] = validate_trace('{nope')
+    assert err.startswith('not JSON')
+    assert validate_trace({}) == ["missing top-level 'traceEvents'"]
+    # Missing required key.
+    bad = {'traceEvents': [{'ph': 'X', 'ts': 0.0, 'dur': 1.0,
+                            'pid': 1, 'tid': 0}]}
+    assert any('missing' in e for e in validate_trace(bad))
+    # Negative duration.
+    bad = {'traceEvents': [{'name': 'a', 'ph': 'X', 'ts': 0.0,
+                            'dur': -1.0, 'pid': 1, 'tid': 0}]}
+    assert any('dur' in e for e in validate_trace(bad))
+    # Non-monotone ts on one track regresses; separate tracks don't.
+    bad = {'traceEvents': [
+        {'name': 'a', 'ph': 'i', 'ts': 5.0, 'pid': 1, 'tid': 0},
+        {'name': 'b', 'ph': 'i', 'ts': 1.0, 'pid': 1, 'tid': 0},
+    ]}
+    assert any('regresses' in e for e in validate_trace(bad))
+    fine = {'traceEvents': [
+        {'name': 'a', 'ph': 'i', 'ts': 5.0, 'pid': 1, 'tid': 0},
+        {'name': 'b', 'ph': 'i', 'ts': 1.0, 'pid': 2, 'tid': 0},
+    ]}
+    assert validate_trace(fine) == []
+
+
+def _cli(argv, capsys):
+    from distributed_dot_product_tpu.obs.__main__ import main
+    rc = main(argv)
+    return rc, capsys.readouterr().out
+
+
+def test_cli_trace_export(tmp_path, capsys, devices):
+    path, _ = _run(tmp_path)
+    out = tmp_path / 'trace.json'
+    rc, text = _cli(['trace', 'export', str(path), '-o', str(out)],
+                    capsys)
+    assert rc == 0
+    assert 'OK' in text
+    assert validate_trace(json.loads(out.read_text())) == []
